@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Benchmark: positions-solved/sec/chip (BASELINE.json tracked metric).
+
+Runs a full strong solve of a Connect-4 board on the available accelerator
+and reports throughput over the complete solve (forward discovery + backward
+value/remoteness propagation, all reachable positions).
+
+Board selection: BASELINE.json's primary-metric config is Connect-4 6x6 on a
+v4-16; on a single chip we default to the largest board that solves in a
+benchmark-friendly time and raise it as kernels speed up (override with
+BENCH_GAME). The metric (positions/sec/chip) is comparable across boards.
+
+`vs_baseline`: the reference publishes no numbers (BASELINE.md), so the ratio
+is computed against the north-star-implied per-chip rate: 4.5e12 states in
+1 hour on 32 chips = 39.06M positions/sec/chip. vs_baseline = value / 39.06e6.
+
+Prints exactly ONE JSON line on stdout; everything else goes to stderr.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    import gamesmanmpi_tpu  # noqa: F401  (enables x64 before first trace)
+    import jax
+
+    from gamesmanmpi_tpu.games import get_game
+    from gamesmanmpi_tpu.solve import Solver
+
+    spec = os.environ.get("BENCH_GAME", "connect4:w=5,h=4")
+    repeats = int(os.environ.get("BENCH_REPEATS", "2"))
+
+    dev = jax.devices()[0]
+    print(f"bench device: {dev.platform} ({dev})", file=sys.stderr)
+
+    game = get_game(spec)
+    best = None
+    for i in range(max(repeats, 1)):
+        solver = Solver(game)
+        t0 = time.perf_counter()
+        result = solver.solve()
+        dt = time.perf_counter() - t0
+        pps = result.num_positions / dt
+        print(
+            f"run {i}: {result.num_positions} positions in {dt:.3f}s "
+            f"= {pps:,.0f} pos/s (value={result.value}, "
+            f"remoteness={result.remoteness})",
+            file=sys.stderr,
+        )
+        best = max(best or 0.0, pps)
+
+    north_star_per_chip = 4.5e12 / 3600.0 / 32.0  # 39.06M pos/s/chip
+    print(
+        json.dumps(
+            {
+                "metric": f"{game.name}_positions_solved_per_sec_per_chip",
+                "value": round(best, 1),
+                "unit": "positions/sec/chip",
+                "vs_baseline": round(best / north_star_per_chip, 6),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,56 @@
+"""Connect-4 on a 4x4 board as a reference-style scalar module.
+
+Same guard-bit column encoding as gamesmanmpi_tpu.games.connect4 (5 bits per
+column: stones of the player to move below a guard bit at the column height),
+so tables can be compared entry-for-entry with the tensor engine.
+"""
+
+W, H, K = 4, 4, 4
+H1 = H + 1
+_COL = (1 << H1) - 1
+
+initial_position = sum(1 << (c * H1) for c in range(W))
+
+
+def _decompose(pos):
+    guards = filled = 0
+    for c in range(W):
+        colv = (pos >> (c * H1)) & _COL
+        g = 1 << (colv.bit_length() - 1)
+        guards |= g << (c * H1)
+        filled |= ((g - 1) & _COL) << (c * H1)
+    current = pos ^ guards
+    return guards, filled, current, filled ^ current
+
+
+def gen_moves(pos):
+    guards, _, _, _ = _decompose(pos)
+    return [c for c in range(W) if not (guards >> (c * H1 + H)) & 1]
+
+
+def do_move(pos, move):
+    guards, _, _, opponent = _decompose(pos)
+    g = guards & (_COL << (move * H1))
+    return opponent | (guards + g)
+
+
+def _connected(stones):
+    for d in (1, H, H1, H + 2):
+        x = stones
+        for i in range(1, K):
+            x &= stones >> (d * i)
+        if x:
+            return True
+    return False
+
+
+_FULL = sum(((1 << H) - 1) << (c * H1) for c in range(W))
+
+
+def primitive(pos):
+    _, filled, _, opponent = _decompose(pos)
+    if _connected(opponent):
+        return "LOSE"
+    if filled == _FULL:
+        return "TIE"
+    return "UNDECIDED"

@@ -1,0 +1,32 @@
+"""Nim with heaps (3, 4, 5) as a reference-style scalar module.
+
+Positions use the same packed encoding as gamesmanmpi_tpu.games.nim (3 bits
+per heap here) so tables can be compared entry-for-entry.
+"""
+
+HEAPS = (3, 4, 5)
+BITS = 3
+_MASK = (1 << BITS) - 1
+
+initial_position = sum(h << (i * BITS) for i, h in enumerate(HEAPS))
+
+
+def _heaps(pos):
+    return [(pos >> (i * BITS)) & _MASK for i in range(len(HEAPS))]
+
+
+def gen_moves(pos):
+    moves = []
+    for i, h in enumerate(_heaps(pos)):
+        for take in range(1, h + 1):
+            moves.append((i, take))
+    return moves
+
+
+def do_move(pos, move):
+    i, take = move
+    return pos - (take << (i * BITS))
+
+
+def primitive(pos):
+    return "LOSE" if pos == 0 else "UNDECIDED"

@@ -1,0 +1,20 @@
+"""The 1-2-...-10 subtraction game ("ten to zero") as a reference-style module.
+
+Shape of a swerwath/GamesmanMPI game plugin (SURVEY.md §2.2: games/1210.py).
+Subtract 1 or 2 from the count; whoever faces 0 has lost (normal play).
+"""
+
+initial_position = 10
+MOVES = (1, 2)
+
+
+def gen_moves(pos):
+    return [m for m in MOVES if pos >= m]
+
+
+def do_move(pos, move):
+    return pos - move
+
+
+def primitive(pos):
+    return "LOSE" if pos == 0 else "UNDECIDED"

@@ -1,0 +1,39 @@
+"""gamesmanmpi_tpu — a TPU-native strong game solver.
+
+A from-scratch rebuild of the capabilities of swerwath/GamesmanMPI (a distributed
+mpi4py strong solver for abstract two-player games): computes the game-theoretic
+value (WIN / LOSE / TIE) and remoteness of every reachable position, behind the
+same minimal game-plugin boundary, re-expressed as a level-synchronous retrograde
+sweep over bit-packed state tensors in JAX/XLA.
+
+Reference architecture mapping (see SURVEY.md; the reference mount was empty this
+session, so citations are to SURVEY sections rather than file:line):
+
+  reference (SURVEY.md §2.2)          this package
+  ---------------------------------   -------------------------------------------
+  solver_launcher.py  (CLI)           solve_launcher.py / gamesmanmpi_tpu.cli
+  src/process.py      (event loop)    gamesmanmpi_tpu.solve.engine (level sweep)
+                                      gamesmanmpi_tpu.parallel.sharded (multi-chip)
+  src/job.py          (Job types)     replaced by level-synchronous phases; see
+                                      solve/engine.py docstring for the mapping
+  src/game_state.py   (GameState)     gamesmanmpi_tpu.core (bit-packed states,
+                                      owner hashing) + games.base (expand)
+  src/utils.py        (value algebra) gamesmanmpi_tpu.core.values / ops.combine
+  games/*.py          (plugins)       gamesmanmpi_tpu.games.* (tensorized) and
+                                      gamesmanmpi_tpu.compat (unmodified modules)
+  mpi4py transport                    jax.lax.all_to_all / psum over the ICI mesh
+
+States are packed uint64; we therefore require 64-bit mode in JAX. This must be
+configured before any tracing happens, which is why it lives at package import.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from gamesmanmpi_tpu.core.values import WIN, LOSE, TIE, UNDECIDED  # noqa: E402
+from gamesmanmpi_tpu.games import get_game  # noqa: E402
+
+__version__ = "0.1.0"
+
+__all__ = ["WIN", "LOSE", "TIE", "UNDECIDED", "get_game", "__version__"]

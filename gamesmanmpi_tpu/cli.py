@@ -1,0 +1,197 @@
+"""CLI: the rebuild of the reference's solver_launcher.py (SURVEY.md §2.2, §3.1).
+
+The reference is launched as
+    mpirun -np N python solver_launcher.py games/tictactoe.py
+and prints the solved value + remoteness of the initial position (plus elapsed
+time) from rank 0. Here there is no mpirun: device parallelism comes from the
+JAX mesh, so the same solve is
+    python solve_launcher.py tictactoe
+    python solve_launcher.py connect4:w=5,h=4 --devices 4
+    python solve_launcher.py path/to/ref_style_game.py      (compat path)
+
+A file path argument is the reference's dynamic game-module import: the module
+is loaded, validated for the 4-function API, and solved unmodified via the
+compat layer. Built-in tensorized games are selected by spec string
+(gamesmanmpi_tpu.games.get_game).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="solve_launcher",
+        description="Strongly solve a two-player abstract game (value + remoteness).",
+    )
+    p.add_argument(
+        "game",
+        help="built-in game spec (e.g. tictactoe, connect4:w=5,h=4, nim:heaps=3-4-5) "
+        "or a path to a reference-style game module file",
+    )
+    p.add_argument(
+        "--devices",
+        type=int,
+        default=1,
+        help="number of devices to shard the solve over (1 = single device)",
+    )
+    p.add_argument(
+        "--paranoid",
+        action="store_true",
+        help="enable internal consistency re-verification (SURVEY.md §5.2)",
+    )
+    p.add_argument(
+        "--jsonl",
+        default=None,
+        help="write per-level structured metrics to this JSONL file (§5.5)",
+    )
+    p.add_argument(
+        "--checkpoint-dir",
+        default=None,
+        help="save per-level solved tables for restart-from-level (§5.4)",
+    )
+    p.add_argument(
+        "--profile-dir",
+        default=None,
+        help="capture a jax.profiler trace of the solve into this dir (§5.1)",
+    )
+    p.add_argument(
+        "--table-out",
+        default=None,
+        help="dump the full solved table as .npz (packed cells per level)",
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from gamesmanmpi_tpu.utils.platform import apply_platform_env
+
+    # Honor GAMESMAN_PLATFORM=cpu|tpu|axon (and GAMESMAN_FAKE_DEVICES) before
+    # any backend init; --devices N on a faked-CPU run needs >= N devices.
+    apply_platform_env(default_fake_devices=max(args.devices, 1))
+    t0 = time.perf_counter()
+
+    import pathlib
+
+    from gamesmanmpi_tpu.core.values import value_name
+    from gamesmanmpi_tpu.utils.metrics import JsonlLogger
+    from gamesmanmpi_tpu.utils.profiling import maybe_profile
+
+    logger = JsonlLogger(args.jsonl) if args.jsonl else None
+    checkpointer = None
+    if args.checkpoint_dir:
+        from gamesmanmpi_tpu.utils.checkpoint import LevelCheckpointer
+
+        checkpointer = LevelCheckpointer(args.checkpoint_dir)
+
+    if pathlib.Path(args.game).is_file():
+        # Reference-style plugin module: runs unmodified (compat path).
+        from gamesmanmpi_tpu.compat import load_game_module, solve_module
+
+        try:
+            module = load_game_module(args.game)
+        except AttributeError as e:
+            # Module validation, solver_launcher.py-style (SURVEY.md §3.1).
+            print(f"error: invalid game module {args.game!r}: {e}", file=sys.stderr)
+            return 2
+        for flag, name in (
+            (args.devices > 1, "--devices"),
+            (args.paranoid, "--paranoid"),
+            (args.checkpoint_dir, "--checkpoint-dir"),
+        ):
+            if flag:
+                print(
+                    f"warning: {name} is not supported on the compat "
+                    "(host-solve) path and is ignored; wrap the module with "
+                    "gamesmanmpi_tpu.compat.TensorizedModule to drive the "
+                    "TPU engine",
+                    file=sys.stderr,
+                )
+        with maybe_profile(args.profile_dir):
+            value, remoteness, table = solve_module(module)
+        elapsed = time.perf_counter() - t0
+        print(f"game: {pathlib.Path(args.game).stem} (compat module)")
+        print(f"positions: {len(table)}")
+        print(f"value: {value_name(value)}")
+        print(f"remoteness: {remoteness}")
+        print(f"elapsed: {elapsed:.3f}s")
+        if args.table_out:
+            from gamesmanmpi_tpu.utils.checkpoint import save_table_npz
+
+            save_table_npz(args.table_out, table)
+            print(f"table written: {args.table_out}")
+        if logger is not None:
+            logger.log(
+                {
+                    "phase": "done",
+                    "game": pathlib.Path(args.game).stem,
+                    "compat": True,
+                    "positions": len(table),
+                    "secs_total": elapsed,
+                }
+            )
+            logger.close()
+        return 0
+
+    from gamesmanmpi_tpu.games import get_game
+
+    try:
+        game = get_game(args.game)
+    except (KeyError, ValueError) as e:
+        print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
+        print(
+            "known games: tictactoe[:m=,n=,k=], connect4[:w=,h=,k=], "
+            "subtract[:total=,moves=,misere=], nim[:heaps=,misere=] — or a "
+            "path to a reference-style game module file",
+            file=sys.stderr,
+        )
+        return 2
+    if args.devices > 1:
+        from gamesmanmpi_tpu.parallel import ShardedSolver
+
+        solver = ShardedSolver(
+            game,
+            num_shards=args.devices,
+            paranoid=args.paranoid,
+            logger=logger,
+            checkpointer=checkpointer,
+        )
+    else:
+        from gamesmanmpi_tpu.solve import Solver
+
+        solver = Solver(
+            game,
+            paranoid=args.paranoid,
+            logger=logger,
+            checkpointer=checkpointer,
+        )
+    with maybe_profile(args.profile_dir):
+        result = solver.solve()
+    elapsed = time.perf_counter() - t0
+
+    print(f"game: {game.name}")
+    print(f"devices: {args.devices}")
+    print(f"positions: {result.num_positions}")
+    print(f"value: {value_name(result.value)}")
+    print(f"remoteness: {result.remoteness}")
+    print(f"elapsed: {elapsed:.3f}s")
+    print(
+        f"throughput: {result.stats['positions_per_sec']:.0f} positions/sec"
+    )
+    if args.table_out:
+        from gamesmanmpi_tpu.utils.checkpoint import save_result_npz
+
+        save_result_npz(args.table_out, result)
+        print(f"table written: {args.table_out}")
+    if logger is not None:
+        logger.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

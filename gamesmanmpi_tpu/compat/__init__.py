@@ -1,0 +1,23 @@
+"""compat: run unmodified reference-style game modules.
+
+BASELINE.json's north star requires the plugin boundary preserved "so any
+game plugin (TicTacToe, Connect4, ...) runs unmodified". A reference-style
+module (scalar `initial_position` / `gen_moves` / `do_move` / `primitive`,
+SURVEY.md §2.1.1) can be:
+
+  - solved directly on host (solve_module) — the compat execution path,
+    correct for any acyclic game, deliberately simple and clearly not the
+    benchmarked TPU path (SURVEY.md §7: "never let it leak into the
+    benchmarked path");
+  - lifted onto the batched TensorGame protocol (TensorizedModule) via
+    host callbacks, so the same jitted engine drives it — the boundary
+    proof, used by the parity tests.
+"""
+
+from gamesmanmpi_tpu.compat.shim import (
+    load_game_module,
+    solve_module,
+    TensorizedModule,
+)
+
+__all__ = ["load_game_module", "solve_module", "TensorizedModule"]

@@ -1,0 +1,171 @@
+"""Compat shim: unmodified reference-style game modules.
+
+Two paths (see package docstring):
+
+  solve_module(module)   — host solve via the memoized-negamax oracle. The
+                           reference's own execution model (per-position
+                           Python calls) at single-process scale; correct for
+                           any acyclic game with hashable positions.
+  TensorizedModule(...)  — lifts a scalar module onto the TensorGame protocol
+                           with jax.pure_callback, so the *same jitted
+                           level-synchronous engine* (and sharded solver)
+                           drives an unmodified plugin. Positions must be
+                           ints (they are in the reference's shipped games:
+                           "position packed as int", SURVEY.md §2.2), and a
+                           topological `level_fn` must exist — module
+                           attribute `level_of`, or passed explicitly.
+                           Deliberately slow (host round-trip per batch,
+                           SURVEY.md §7) and excluded from benchmarks.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gamesmanmpi_tpu.core.bitops import SENTINEL
+from gamesmanmpi_tpu.core.values import UNDECIDED
+from gamesmanmpi_tpu.games.base import TensorGame
+from gamesmanmpi_tpu.solve.oracle import (
+    module_api,
+    normalize_value,
+    oracle_solve,
+)
+
+
+def load_game_module(path):
+    """Dynamic plugin import, the solver_launcher.py way (SURVEY.md §3.1):
+    load a Python file, validate the 4-function API, return the module."""
+    path = pathlib.Path(path)
+    spec = importlib.util.spec_from_file_location(path.stem, path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    module_api(module)  # validates required attributes
+    return module
+
+
+def solve_module(module):
+    """Solve an unmodified reference-style module on host.
+
+    Returns (value, remoteness, table) — table maps every reachable position
+    to (value, remoteness), the same observable output as the reference.
+    """
+    return oracle_solve(module)
+
+
+class TensorizedModule(TensorGame):
+    """A scalar 4-function module lifted onto the batched TensorGame API."""
+
+    def __init__(
+        self,
+        module,
+        *,
+        max_moves: int | None = None,
+        level_fn=None,
+        max_level_jump: int | None = None,
+        num_levels: int | None = None,
+    ):
+        initial, gen, do, prim = module_api(module)
+        if not isinstance(initial, (int, np.integer)):
+            raise TypeError(
+                "TensorizedModule needs int-packed positions; use "
+                "solve_module() for arbitrary hashable positions"
+            )
+        self._gen, self._do, self._prim = gen, do, prim
+        self._initial = np.uint64(initial)
+        self.name = f"compat_{getattr(module, '__name__', 'module')}"
+        level_fn = level_fn or getattr(module, "level_of", None)
+        if level_fn is None:
+            raise ValueError(
+                "a topological level function is required: pass level_fn= or "
+                "define level_of(pos) in the module (see games/base.py)"
+            )
+        self._level_fn = level_fn
+        if max_moves is None:
+            max_moves = getattr(module, "max_moves", None)
+        if max_moves is None:
+            # Guessing from one position would under-size boards where moves
+            # open up later and abort mid-solve from inside pure_callback.
+            raise ValueError(
+                "max_moves is required: pass max_moves= or define max_moves "
+                "in the module (the static [B, M] kernel width)"
+            )
+        self.max_moves = int(max_moves)
+        self.max_level_jump = int(
+            max_level_jump or getattr(module, "max_level_jump", 1)
+        )
+        self.num_levels = int(num_levels or getattr(module, "num_levels", 1 << 20))
+
+    def initial_state(self) -> np.uint64:
+        return self._initial
+
+    # Host callbacks — one python round-trip per batch, not per position.
+
+    def _expand_host(self, states):
+        states = np.asarray(states, np.uint64)
+        B = states.shape[0]
+        kids = np.full((B, self.max_moves), SENTINEL, dtype=np.uint64)
+        mask = np.zeros((B, self.max_moves), dtype=bool)
+        for i, s in enumerate(states):
+            if s == SENTINEL:
+                continue
+            pos = int(s)
+            if normalize_value(self._prim(pos)) != UNDECIDED:
+                continue
+            moves = list(self._gen(pos))
+            if len(moves) > self.max_moves:
+                raise ValueError(
+                    f"position {pos:#x} has {len(moves)} moves > "
+                    f"max_moves={self.max_moves}; raise max_moves"
+                )
+            for j, m in enumerate(moves):
+                kids[i, j] = self._do(pos, m)
+                mask[i, j] = True
+        return kids, mask
+
+    def _primitive_host(self, states):
+        states = np.asarray(states, np.uint64)
+        out = np.zeros(states.shape, dtype=np.uint8)
+        for i, s in enumerate(states):
+            if s != SENTINEL:
+                out[i] = normalize_value(self._prim(int(s)))
+        return out
+
+    def _level_host(self, states):
+        states = np.asarray(states, np.uint64)
+        out = np.zeros(states.shape, dtype=np.int32)
+        for i, s in enumerate(states):
+            if s != SENTINEL:
+                out[i] = self._level_fn(int(s))
+        return out
+
+    # TensorGame protocol: pure_callback keeps the engine jittable.
+
+    def expand(self, states):
+        shape = states.shape + (self.max_moves,)
+        return jax.pure_callback(
+            self._expand_host,
+            (
+                jax.ShapeDtypeStruct(shape, jnp.uint64),
+                jax.ShapeDtypeStruct(shape, jnp.bool_),
+            ),
+            states,
+        )
+
+    def primitive(self, states):
+        return jax.pure_callback(
+            self._primitive_host,
+            jax.ShapeDtypeStruct(states.shape, jnp.uint8),
+            states,
+        )
+
+    def level_of(self, states):
+        return jax.pure_callback(
+            self._level_host,
+            jax.ShapeDtypeStruct(states.shape, jnp.int32),
+            states,
+        )

@@ -1,0 +1,35 @@
+"""Core: value algebra, state codecs, hashing, bit ops.
+
+TPU-native counterpart of the reference's src/utils.py (value constants, negate)
+and the representation half of src/game_state.py (SURVEY.md §2.2).
+"""
+
+from gamesmanmpi_tpu.core.values import (
+    WIN,
+    LOSE,
+    TIE,
+    UNDECIDED,
+    VALUE_NAMES,
+    negate,
+    value_name,
+)
+from gamesmanmpi_tpu.core.codec import pack_cells, unpack_cells
+from gamesmanmpi_tpu.core.hashing import splitmix64, owner_shard
+from gamesmanmpi_tpu.core.bitops import popcount64, msb_index64, SENTINEL
+
+__all__ = [
+    "WIN",
+    "LOSE",
+    "TIE",
+    "UNDECIDED",
+    "VALUE_NAMES",
+    "negate",
+    "value_name",
+    "pack_cells",
+    "unpack_cells",
+    "splitmix64",
+    "owner_shard",
+    "popcount64",
+    "msb_index64",
+    "SENTINEL",
+]

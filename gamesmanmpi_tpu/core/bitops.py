@@ -1,0 +1,31 @@
+"""uint64 bit primitives shared by state codecs and games.
+
+All positions in this framework are bit-packed uint64 scalars (SURVEY.md §7:
+"bit-packed state codecs"); these helpers are the common vocabulary.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Padding sentinel for frontiers/tables: sorts after every real state, so
+# sorted arrays keep their sentinel tail and searchsorted stays correct.
+SENTINEL = np.uint64(0xFFFF_FFFF_FFFF_FFFF)
+
+U64_ONE = np.uint64(1)
+
+
+def u64(x) -> jnp.ndarray:
+    """A uint64 jnp scalar/array from a Python int or array."""
+    return jnp.asarray(x, dtype=jnp.uint64)
+
+
+def popcount64(x):
+    """Population count of a uint64 array."""
+    return jax.lax.population_count(jnp.asarray(x, jnp.uint64)).astype(jnp.int32)
+
+
+def msb_index64(x):
+    """Index of the most-significant set bit of x (x must be nonzero)."""
+    clz = jax.lax.clz(jnp.asarray(x, jnp.uint64)).astype(jnp.int32)
+    return 63 - clz

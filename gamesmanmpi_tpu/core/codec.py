@@ -1,0 +1,34 @@
+"""Packed table-cell codec: (value, remoteness) <-> uint32.
+
+The reference keeps two Python dicts per rank (`resolved: {pos: value}` and
+`remote: {pos: remoteness}`, src/process.py per SURVEY.md §2.2). Here a solved
+position's record is a single uint32 cell — value in the low 2 bits, remoteness
+in the remaining 30 — so a billion-position table shard is 4 bytes/cell in HBM
+and checkpoints are flat arrays (utils/checkpoint.py).
+"""
+
+import jax.numpy as jnp
+
+from gamesmanmpi_tpu.core.values import MAX_REMOTENESS
+
+_VALUE_BITS = 2
+_VALUE_MASK = (1 << _VALUE_BITS) - 1
+
+CELL_DTYPE = jnp.uint32
+
+
+def pack_cells(values, remoteness):
+    """Pack uint8 values + int32 remoteness into uint32 cells.
+
+    Remoteness must be in [0, MAX_REMOTENESS]; values in [0, 3].
+    """
+    v = values.astype(jnp.uint32) & _VALUE_MASK
+    r = jnp.clip(remoteness, 0, MAX_REMOTENESS).astype(jnp.uint32)
+    return v | (r << _VALUE_BITS)
+
+
+def unpack_cells(cells):
+    """Inverse of pack_cells -> (values uint8, remoteness int32)."""
+    values = (cells & _VALUE_MASK).astype(jnp.uint8)
+    remoteness = (cells >> _VALUE_BITS).astype(jnp.int32)
+    return values, remoteness

@@ -1,0 +1,48 @@
+"""Owner hashing: which shard stores/answers a position.
+
+The reference routes every position to a single owner rank via
+`hash(pos) % world_size` (src/game_state.py `get_hash`, SURVEY.md §2.2 / §2.4
+"hash-partitioned state-space parallelism"). Python's `hash` of an int is the
+int itself, which shards the reference's tables badly for structured encodings;
+here we use splitmix64 — a cheap, well-mixed uint64 permutation that runs
+vectorized on-device — before the modulo, preserving the contract (total,
+deterministic, single owner per position) while load-balancing structured
+bitboard keys.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+_C1 = np.uint64(0x9E3779B97F4A7C15)
+_C2 = np.uint64(0xBF58476D1CE4E5B9)
+_C3 = np.uint64(0x94D049BB133111EB)
+
+
+def splitmix64(x):
+    """splitmix64 finalizer: a bijective mix of uint64 (vectorized)."""
+    z = jnp.asarray(x, jnp.uint64) + _C1
+    z = (z ^ (z >> np.uint64(30))) * _C2
+    z = (z ^ (z >> np.uint64(27))) * _C3
+    return z ^ (z >> np.uint64(31))
+
+
+def owner_shard(states, num_shards: int):
+    """Owner shard index in [0, num_shards) for each packed state.
+
+    The TPU analog of the reference's `hash(pos) % world_size` rank routing.
+    """
+    return (splitmix64(states) % np.uint64(num_shards)).astype(jnp.int32)
+
+
+def splitmix64_np(x):
+    """NumPy twin of splitmix64 for host-side partition checks/tests."""
+    with np.errstate(over="ignore"):
+        z = np.asarray(x, np.uint64) + _C1
+        z = (z ^ (z >> np.uint64(30))) * _C2
+        z = (z ^ (z >> np.uint64(27))) * _C3
+        return z ^ (z >> np.uint64(31))
+
+
+def owner_shard_np(states, num_shards: int):
+    """NumPy twin of owner_shard."""
+    return (splitmix64_np(states) % np.uint64(num_shards)).astype(np.int32)

@@ -1,0 +1,64 @@
+"""Game-theoretic value algebra.
+
+Rebuild of the reference's value constants and `negate` (src/utils.py per
+SURVEY.md §2.2; the reference stores values as strings — here they are uint8 so
+whole frontiers of them live in TPU registers/HBM).
+
+Semantics (SURVEY.md §2.1, items 2-3):
+
+  A position's value is from the perspective of the player to move (negamax):
+    WIN  iff at least one child is LOSE
+    TIE  iff no child is LOSE and at least one child is TIE
+    LOSE iff all children are WIN (vacuously LOSE with zero children)
+
+  Remoteness (GamesCrafters convention; moves-to-end under optimal play):
+    primitive positions have remoteness 0
+    WIN  -> 1 + min remoteness over LOSE children   (win as fast as possible)
+    LOSE -> 1 + max remoteness over all children    (delay losing)
+    TIE  -> 1 + max remoteness over TIE children
+
+The TIE min/max choice is flagged [MED] in SURVEY.md §2.1.3; the convention used
+here (max) is applied consistently in both the JAX kernels (ops/combine.py) and
+the pure-Python oracle (solve/oracle.py), and gives the known 3x3 tic-tac-toe
+answer (TIE, remoteness 9).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+# uint8 encodings. UNDECIDED doubles as "not yet resolved" in tables.
+UNDECIDED = 0
+WIN = 1
+LOSE = 2
+TIE = 3
+
+VALUE_NAMES = {UNDECIDED: "UNDECIDED", WIN: "WIN", LOSE: "LOSE", TIE: "TIE"}
+
+# negate: value from the parent's perspective of a child's value.
+# WIN <-> LOSE, TIE -> TIE, UNDECIDED -> UNDECIDED (src/utils.py `negate`).
+# NB: no module-level jnp constants anywhere in this package — they would
+# initialize the JAX backend at import time, before callers (tests, the
+# multichip dry run) can select a platform.
+_NEGATE_TABLE = np.array([UNDECIDED, LOSE, WIN, TIE], dtype=np.uint8)
+
+VALUE_DTYPE = jnp.uint8
+REMOTENESS_DTYPE = jnp.int32
+
+# Remoteness values are packed into 30 bits in core/codec.py; this bound also
+# serves as the +inf pad for masked min-reductions in ops/combine.py.
+MAX_REMOTENESS = (1 << 30) - 1
+
+
+def negate(values):
+    """Vectorized negate over a uint8 value array (or scalar)."""
+    return jnp.asarray(_NEGATE_TABLE)[values]
+
+
+def negate_np(values):
+    """NumPy twin of `negate` for host-side code (oracle, compat shim)."""
+    return _NEGATE_TABLE[values]
+
+
+def value_name(v) -> str:
+    """Human-readable name of a value constant (rank-0 output formatting)."""
+    return VALUE_NAMES[int(v)]

@@ -1,0 +1,65 @@
+"""Game registry: the tensorized counterparts of the reference's games/ dir.
+
+The reference CLI takes a path to a game module (solver_launcher.py,
+SURVEY.md §3.1); here built-in games are constructed from a spec string, and
+reference-style module files are still accepted via gamesmanmpi_tpu.compat.
+
+Spec grammar: "name" or "name:key=value,key=value", e.g.
+    tictactoe            tictactoe:m=4,n=4,k=4
+    connect4:w=5,h=4     subtract:total=10,moves=1-2,misere=1
+    nim:heaps=3-4-5      nim:heaps=1-2-10,misere=1
+"""
+
+from __future__ import annotations
+
+from gamesmanmpi_tpu.games.base import TensorGame
+from gamesmanmpi_tpu.games.tictactoe import TicTacToe
+from gamesmanmpi_tpu.games.subtract import Subtract
+from gamesmanmpi_tpu.games.nim import Nim
+from gamesmanmpi_tpu.games.connect4 import Connect4
+
+
+def _parse_kwargs(spec: str) -> dict:
+    out = {}
+    if not spec:
+        return out
+    for item in spec.split(","):
+        k, _, v = item.partition("=")
+        out[k.strip()] = v.strip()
+    return out
+
+
+def _intlist(v: str):
+    return tuple(int(x) for x in v.replace("-", " ").split())
+
+
+def get_game(spec: str) -> TensorGame:
+    """Construct a built-in game from a spec string (see module docstring)."""
+    name, _, rest = spec.partition(":")
+    kw = _parse_kwargs(rest)
+    name = name.strip().lower()
+    if name in ("tictactoe", "ttt", "mnk"):
+        return TicTacToe(
+            m=int(kw.get("m", 3)), n=int(kw.get("n", 3)), k=int(kw.get("k", 3))
+        )
+    if name in ("connect4", "c4", "win4", "connectn"):
+        return Connect4(
+            width=int(kw.get("w", kw.get("width", 7))),
+            height=int(kw.get("h", kw.get("height", 6))),
+            connect=int(kw.get("k", kw.get("connect", 4))),
+        )
+    if name in ("subtract", "1210", "tentozero"):
+        return Subtract(
+            total=int(kw.get("total", kw.get("n", 10))),
+            moves=_intlist(kw.get("moves", "1-2")),
+            misere=kw.get("misere", "0") not in ("0", "false", "False", ""),
+        )
+    if name == "nim":
+        return Nim(
+            heaps=_intlist(kw.get("heaps", "3-4-5")),
+            misere=kw.get("misere", "0") not in ("0", "false", "False", ""),
+        )
+    raise KeyError(f"unknown game spec {spec!r}")
+
+
+__all__ = ["TensorGame", "TicTacToe", "Subtract", "Nim", "Connect4", "get_game"]

@@ -1,0 +1,67 @@
+"""The tensorized game-plugin boundary.
+
+This is the rebuild of the reference's L3 plugin API (SURVEY.md §1, §2.1.1):
+a game there is a module with `initial_position`, `gen_moves(pos)`,
+`do_move(pos, move)`, `primitive(pos)` operating on one position at a time.
+On TPU the same boundary is expressed over *batches of bit-packed uint64
+positions*: `expand` fuses gen_moves+do_move over a whole frontier, and
+`primitive` is vectorized. Unmodified reference-style scalar modules are
+lifted onto this protocol by gamesmanmpi_tpu.compat.
+
+One addition relative to the reference: `level_of`. The reference's top-down
+memoized recursion needs no global ordering; a level-synchronous retrograde
+sweep does. `level_of` must be a *topological level function*: every move from
+state s leads to a state with strictly greater level, and
+level_of(child) - level_of(s) <= max_level_jump. For the shipped games this is
+just "pieces placed" / "objects removed" — the standard retrograde-analysis
+sectioning (PAPERS.md: Pentago). Games where every move advances the level by
+exactly 1 (tic-tac-toe, connect4) have max_level_jump == 1.
+
+Engine-side contracts (so game kernels stay branch-free):
+  - expand/primitive may be called on SENTINEL padding lanes; their output
+    there is garbage and the engine masks it out. Kernels must merely not
+    crash on sentinel input (uint64 arithmetic wraps; that is fine).
+  - expand returns (children [B, max_moves] uint64, mask [B, max_moves] bool);
+    lanes with mask False are ignored by the engine.
+  - primitive returns uint8 values from the perspective of the player to move
+    (WIN/LOSE/TIE/UNDECIDED), UNDECIDED meaning non-terminal.
+"""
+
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+
+class TensorGame(abc.ABC):
+    """A two-player abstract game over batches of packed uint64 states."""
+
+    #: short name used by the registry / CLI
+    name: str = "game"
+    #: static maximum number of moves from any position (M in [B, M] kernels)
+    max_moves: int
+    #: upper bound (exclusive) on level_of over reachable states
+    num_levels: int
+    #: max of level_of(child) - level_of(parent) over all moves
+    max_level_jump: int = 1
+
+    @abc.abstractmethod
+    def initial_state(self) -> np.uint64:
+        """The packed initial position (reference: `initial_position`)."""
+
+    @abc.abstractmethod
+    def expand(self, states):
+        """Batched gen_moves+do_move: [B] -> (children [B, M], mask [B, M])."""
+
+    @abc.abstractmethod
+    def primitive(self, states):
+        """Batched primitive value: [B] -> uint8 [B]."""
+
+    @abc.abstractmethod
+    def level_of(self, states):
+        """Topological level of each state: [B] -> int32 [B]."""
+
+    def describe(self, state) -> str:
+        """Optional human-readable rendering of one packed state (debugging)."""
+        return f"{self.name} state {int(state):#x}"

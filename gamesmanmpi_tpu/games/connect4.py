@@ -1,0 +1,127 @@
+"""Connect-N on a w x h board, column-drop rules (reference games/win4.py-style;
+BASELINE configs #3-4 and the 6x7 north star).
+
+State encoding (uint64): column c occupies bits [c*(h+1), c*(h+1)+h] — h cell
+bits plus one guard position. Within a column, the stones of the *player to
+move* are set bits below the guard; the guard is a single 1 at bit `height`
+(number of stones in the column). The guard is therefore always the column's
+most-significant set bit, which makes the encoding self-delimiting: height,
+filled-cell mask and both players' stones are all recoverable with clz/mask
+arithmetic, no side tables. An empty column is 0b1; the whole encoding fits
+(h+1)*w <= 63 bits — 49 bits for the 7x6 north star. This is the column-wise
+perfect encoding SURVEY.md §7 calls for ("Hashing/indexing 4.5e12 C4 states:
+perfect column-wise encoding").
+
+A move in column c is branch-free: with g the column's guard bit,
+    child = opponent_stones | (guards + g)
+— adding g slides that column's guard up one cell, and the mover's new stone
+(belonging to the player who will then be the opponent) is implicitly the hole
+below the new guard that is absent from the new current-player stones.
+
+Win test is the standard 4-direction bitboard fold on the last mover's stones:
+directions {1, h, h+1, h+2} (vertical, diagonals, horizontal) — guard bits are
+stripped first, and the per-column spare bit prevents cross-column wraps.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from gamesmanmpi_tpu.core.bitops import popcount64, msb_index64
+from gamesmanmpi_tpu.core.values import LOSE, TIE, UNDECIDED
+from gamesmanmpi_tpu.games.base import TensorGame
+
+
+class Connect4(TensorGame):
+    def __init__(self, width: int = 7, height: int = 6, connect: int = 4):
+        if (height + 1) * width > 63:
+            raise ValueError("board too large for uint64 packing")
+        self.width, self.height, self.connect = width, height, connect
+        self.name = f"connect{connect}_{width}x{height}"
+        self.max_moves = width
+        self.num_levels = width * height + 1
+        self.max_level_jump = 1
+        h1 = height + 1
+        self._col_masks = np.array(
+            [((1 << h1) - 1) << (c * h1) for c in range(width)], dtype=np.uint64
+        )
+        self._top_bits = np.array(
+            [1 << (c * h1 + height) for c in range(width)], dtype=np.uint64
+        )
+        self._full_mask = np.uint64(
+            sum(((1 << height) - 1) << (c * h1) for c in range(width))
+        )
+        self._bottom_mask = np.uint64(sum(1 << (c * h1) for c in range(width)))
+        # {vertical, diag down, horizontal, diag up} strides.
+        self._dirs = (1, height, h1, height + 2)
+
+    def initial_state(self) -> np.uint64:
+        return self._bottom_mask
+
+    def _decompose(self, states):
+        """-> (guards, filled, current, opponent) bitboards for a [B] batch."""
+        guards = jnp.zeros(states.shape, dtype=jnp.uint64)
+        filled = jnp.zeros(states.shape, dtype=jnp.uint64)
+        one = np.uint64(1)
+        for c in range(self.width):
+            colv = states & self._col_masks[c]
+            g = one << msb_index64(colv | one).astype(jnp.uint64)
+            guards = guards | g
+            filled = filled | ((g - one) & self._col_masks[c])
+        current = states ^ guards
+        opponent = filled ^ current
+        return guards, filled, current, opponent
+
+    def expand(self, states):
+        guards, _, _, opponent = self._decompose(states)
+        children = []
+        masks = []
+        for c in range(self.width):
+            g = guards & self._col_masks[c]
+            children.append(opponent | (guards + g))
+            masks.append((guards & self._top_bits[c]) == 0)
+        return jnp.stack(children, axis=-1), jnp.stack(masks, axis=-1)
+
+    def _connected(self, stones):
+        won = jnp.zeros(stones.shape, dtype=bool)
+        for d in self._dirs:
+            x = stones
+            for i in range(1, self.connect):
+                x = x & (stones >> np.uint64(d * i))
+            won = won | (x != 0)
+        return won
+
+    def primitive(self, states):
+        guards, filled, _, opponent = self._decompose(states)
+        lost = self._connected(opponent)
+        full = filled == self._full_mask
+        return jnp.where(
+            lost, jnp.uint8(LOSE), jnp.where(full, jnp.uint8(TIE), jnp.uint8(UNDECIDED))
+        )
+
+    def level_of(self, states):
+        _, filled, _, _ = self._decompose(states)
+        return popcount64(filled)
+
+    def describe(self, state) -> str:
+        s = int(state)
+        h1 = self.height + 1
+        cols = [(s >> (c * h1)) & ((1 << h1) - 1) for c in range(self.width)]
+        heights = [cv.bit_length() - 1 for cv in cols]
+        total = sum(heights)
+        # Even total stones -> first player ('X') to move; current-player
+        # stones are the set bits below each guard.
+        cur_char, opp_char = ("X", "O") if total % 2 == 0 else ("O", "X")
+        rows = []
+        for r in range(self.height - 1, -1, -1):
+            row = ""
+            for c in range(self.width):
+                if r >= heights[c]:
+                    row += "."
+                elif (cols[c] >> r) & 1:
+                    row += cur_char
+                else:
+                    row += opp_char
+            rows.append(row)
+        return "\n".join(rows)

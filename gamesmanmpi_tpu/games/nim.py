@@ -1,0 +1,69 @@
+"""Nim (multi-heap), normal and misère (BASELINE config #5 regression family).
+
+Reference counterpart: the Nim-style teaching games in games/ (SURVEY.md §2.2,
+§4.2 — "closed-form oracle for property tests": normal-play Nim is a first
+player WIN iff the XOR of heap sizes is nonzero).
+
+State layout: heap i occupies `bits` bits starting at i*bits, where `bits` is
+sized to hold the largest initial heap. A move removes 1..heap[i] objects from
+one heap; with packed heaps that is plain uint64 subtraction at the heap's
+offset. Terminal: all heaps empty — LOSE for the player to move in normal
+play, WIN in misère.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from gamesmanmpi_tpu.core.values import WIN, LOSE, UNDECIDED
+from gamesmanmpi_tpu.games.base import TensorGame
+
+
+class Nim(TensorGame):
+    def __init__(self, heaps=(3, 4, 5), misere: bool = False):
+        self.heaps = tuple(int(h) for h in heaps)
+        if not self.heaps or min(self.heaps) < 0:
+            raise ValueError("heaps must be non-negative")
+        self.misere = misere
+        self.bits = max(max(self.heaps), 1).bit_length()
+        if self.bits * len(self.heaps) > 64:
+            raise ValueError("heaps too large for uint64 packing")
+        suffix = "m" if misere else ""
+        self.name = f"nim_{'-'.join(map(str, self.heaps))}{suffix}"
+        # Moves are (heap, amount) pairs, amount in 1..initial[heap].
+        self._move_list = [
+            (i, t) for i, h in enumerate(self.heaps) for t in range(1, h + 1)
+        ]
+        self.max_moves = max(len(self._move_list), 1)
+        self.num_levels = sum(self.heaps) + 1
+        self.max_level_jump = max(max(self.heaps), 1)
+        self._heap_mask = np.uint64((1 << self.bits) - 1)
+
+    def initial_state(self) -> np.uint64:
+        s = 0
+        for i, h in enumerate(self.heaps):
+            s |= h << (i * self.bits)
+        return np.uint64(s)
+
+    def _heap(self, states, i: int):
+        return (states >> np.uint64(i * self.bits)) & self._heap_mask
+
+    def expand(self, states):
+        children = []
+        masks = []
+        for i, t in self._move_list:
+            amt = np.uint64(t << (i * self.bits))
+            masks.append(self._heap(states, i) >= np.uint64(t))
+            children.append(states - amt)
+        return jnp.stack(children, axis=-1), jnp.stack(masks, axis=-1)
+
+    def primitive(self, states):
+        terminal = np.uint8(WIN if self.misere else LOSE)
+        return jnp.where(states == 0, terminal, jnp.uint8(UNDECIDED))
+
+    def level_of(self, states):
+        total = jnp.zeros(states.shape, dtype=jnp.int32)
+        for i in range(len(self.heaps)):
+            total = total + self._heap(states, i).astype(jnp.int32)
+        return sum(self.heaps) - total

@@ -1,0 +1,52 @@
+"""Subtraction games ("1210" / ten-to-zero family; BASELINE config #5).
+
+Reference counterpart: games/1210.py-style teaching game (SURVEY.md §2.2):
+start from `total` objects, a move removes any amount in `moves`; in normal
+play the player who cannot move (0 left) has lost (primitive LOSE); in misère
+play they have won (primitive WIN).
+
+State = number of objects remaining, as uint64. This is the one shipped game
+whose moves jump levels by more than 1 (removing s objects advances the level
+by s), so it exercises the engine's multi-level lookup window.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from gamesmanmpi_tpu.core.values import WIN, LOSE, UNDECIDED
+from gamesmanmpi_tpu.games.base import TensorGame
+
+
+class Subtract(TensorGame):
+    def __init__(self, total: int = 10, moves=(1, 2), misere: bool = False):
+        self.total = int(total)
+        self.moves = tuple(sorted(int(m) for m in moves))
+        if not self.moves or self.moves[0] < 1:
+            raise ValueError("moves must be positive")
+        self.misere = misere
+        suffix = "m" if misere else ""
+        self.name = f"subtract_{total}_{'-'.join(map(str, self.moves))}{suffix}"
+        self.max_moves = len(self.moves)
+        self.num_levels = self.total + 1
+        self.max_level_jump = self.moves[-1]
+        self._terminal_value = np.uint8(WIN if misere else LOSE)
+
+    def initial_state(self) -> np.uint64:
+        return np.uint64(self.total)
+
+    def expand(self, states):
+        children = []
+        masks = []
+        for mv in self.moves:
+            amt = np.uint64(mv)
+            masks.append(states >= amt)
+            children.append(states - amt)
+        return jnp.stack(children, axis=-1), jnp.stack(masks, axis=-1)
+
+    def primitive(self, states):
+        return jnp.where(states == 0, self._terminal_value, jnp.uint8(UNDECIDED))
+
+    def level_of(self, states):
+        return (np.uint64(self.total) - states).astype(jnp.int32)

@@ -1,0 +1,114 @@
+"""Generalized m,n,k tic-tac-toe (3,3,3 = the reference's games/tictactoe.py).
+
+Reference counterpart: games/tictactoe.py — board packed as an int, 4-function
+scalar API (SURVEY.md §2.2). Same packing here, tensorized: an m x n board with
+k-in-a-row to win, X moving first.
+
+State layout (uint64): bits [0, m*n) are X's stones, bits [m*n, 2*m*n) are O's
+stones, cell index = row * n + col. Player to move: X iff popcount(X plane) ==
+popcount(O plane). The scalar twin in examples/ref_games/tictactoe.py uses the
+identical layout, which is what makes full-table oracle parity tests possible.
+
+Primitive semantics (perspective of player to move): if the *last mover* has k
+in a row the mover has lost -> LOSE; else a full board is TIE; else UNDECIDED.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from gamesmanmpi_tpu.core.bitops import popcount64
+from gamesmanmpi_tpu.core.values import LOSE, TIE, UNDECIDED
+from gamesmanmpi_tpu.games.base import TensorGame
+
+
+def _win_lines(m: int, n: int, k: int) -> np.ndarray:
+    """All k-in-a-row masks on the X bit-plane (bits 0..m*n)."""
+    lines = []
+    cells = [[r * n + c for c in range(n)] for r in range(m)]
+    for r in range(m):
+        for c in range(n):
+            for dr, dc in ((0, 1), (1, 0), (1, 1), (1, -1)):
+                rr, cc = r + dr * (k - 1), c + dc * (k - 1)
+                if 0 <= rr < m and 0 <= cc < n:
+                    mask = 0
+                    for i in range(k):
+                        mask |= 1 << cells[r + dr * i][c + dc * i]
+                    lines.append(mask)
+    return np.array(sorted(set(lines)), dtype=np.uint64)
+
+
+class TicTacToe(TensorGame):
+    def __init__(self, m: int = 3, n: int = 3, k: int = 3):
+        if 2 * m * n > 64:
+            raise ValueError("board too large for uint64 packing")
+        self.m, self.n, self.k = m, n, k
+        self.cells = m * n
+        self.name = f"tictactoe_{m}x{n}x{k}"
+        self.max_moves = self.cells
+        self.num_levels = self.cells + 1
+        self.max_level_jump = 1
+        self._lines = jnp.asarray(_win_lines(m, n, k))
+        self._plane_mask = np.uint64((1 << self.cells) - 1)
+        self._full = np.uint64((1 << self.cells) - 1)
+
+    def initial_state(self) -> np.uint64:
+        return np.uint64(0)
+
+    def _planes(self, states):
+        x = states & self._plane_mask
+        o = (states >> np.uint64(self.cells)) & self._plane_mask
+        return x, o
+
+    def _x_to_move(self, states):
+        x, o = self._planes(states)
+        return popcount64(x) == popcount64(o)
+
+    def expand(self, states):
+        x, o = self._planes(states)
+        occupied = x | o
+        x_to_move = self._x_to_move(states)
+        # The mover's stone lands at cell i on their own plane.
+        shift = jnp.where(x_to_move, 0, self.cells).astype(jnp.uint64)
+        children = []
+        masks = []
+        for i in range(self.cells):
+            bit = np.uint64(1 << i)
+            empty = (occupied & bit) == 0
+            child = states | (bit << shift)
+            children.append(child)
+            masks.append(empty)
+        return jnp.stack(children, axis=-1), jnp.stack(masks, axis=-1)
+
+    def primitive(self, states):
+        x, o = self._planes(states)
+        # Last mover is the player NOT to move.
+        last = jnp.where(self._x_to_move(states), o, x)
+        won = jnp.zeros(states.shape, dtype=bool)
+        for i in range(self._lines.shape[0]):
+            line = self._lines[i]
+            won = won | ((last & line) == line)
+        full = (x | o) == self._full
+        return jnp.where(
+            won, jnp.uint8(LOSE), jnp.where(full, jnp.uint8(TIE), jnp.uint8(UNDECIDED))
+        )
+
+    def level_of(self, states):
+        return popcount64(states)
+
+    def describe(self, state) -> str:
+        s = int(state)
+        rows = []
+        for r in range(self.m):
+            row = ""
+            for c in range(self.n):
+                i = r * self.n + c
+                if (s >> i) & 1:
+                    row += "X"
+                elif (s >> (self.cells + i)) & 1:
+                    row += "O"
+                else:
+                    row += "."
+            rows.append(row)
+        return "\n".join(rows)

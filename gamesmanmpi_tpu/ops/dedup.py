@@ -1,0 +1,27 @@
+"""Frontier deduplication: sort-unique over packed states.
+
+The reference dedups implicitly through its per-rank memo dict — a position
+seen twice hits `resolved`/`pending` and is not re-expanded (src/process.py,
+SURVEY.md §3.2). A dict is hostile to TPUs; the level-synchronous rebuild
+dedups each frontier wholesale with sort + neighbor-compare + resort, a
+static-shape O(n log n) pattern XLA maps well (SURVEY.md §7 "Dedup at scale").
+"""
+
+import jax.numpy as jnp
+
+from gamesmanmpi_tpu.core.bitops import SENTINEL
+
+
+def sort_unique(states):
+    """Sort states, replace duplicates with SENTINEL, resort, count uniques.
+
+    Input: [N] uint64 (may contain SENTINEL padding).
+    Returns (sorted_unique [N] with all uniques first then SENTINEL tail,
+             count of unique non-sentinel entries, int32).
+    """
+    s = jnp.sort(states)
+    dup = jnp.concatenate([jnp.zeros((1,), bool), s[1:] == s[:-1]])
+    s = jnp.where(dup, SENTINEL, s)
+    s = jnp.sort(s)
+    count = jnp.sum(s != SENTINEL).astype(jnp.int32)
+    return s, count

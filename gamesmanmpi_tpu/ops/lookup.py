@@ -1,0 +1,47 @@
+"""Memo-table lookup: child-value queries against solved levels.
+
+Reference counterpart: `pos in resolved` dict probes plus the SEND_BACK
+round-trip to the owner rank (src/process.py LOOK_UP path, SURVEY.md §3.2-3.3).
+Here solved levels are sorted uint64 arrays with SENTINEL tails, and a whole
+frontier's child queries become one vectorized binary search (searchsorted +
+gather) per level of the lookup window — no messages, no dict.
+"""
+
+import jax.numpy as jnp
+
+from gamesmanmpi_tpu.core.bitops import SENTINEL
+from gamesmanmpi_tpu.core.values import UNDECIDED
+
+
+def lookup_sorted(keys, table_states, table_values, table_remoteness):
+    """Look keys up in one sorted solved level.
+
+    keys: [K] uint64 (SENTINEL entries allowed; they miss).
+    table_states: [N] sorted uint64 with SENTINEL tail.
+    Returns (values [K] uint8 — UNDECIDED on miss, remoteness [K] int32, hit [K] bool).
+    """
+    idx = jnp.searchsorted(table_states, keys)
+    idx = jnp.clip(idx, 0, table_states.shape[0] - 1)
+    hit = (table_states[idx] == keys) & (keys != SENTINEL)
+    values = jnp.where(hit, table_values[idx], jnp.uint8(UNDECIDED))
+    remoteness = jnp.where(hit, table_remoteness[idx], 0)
+    return values, remoteness, hit
+
+
+def lookup_window(keys, window):
+    """Look keys up across a window of solved levels.
+
+    window: sequence of (states, values, remoteness) triples (each as in
+    lookup_sorted). Each key hits at most one level (a state's level is a
+    function of the state). Returns (values, remoteness, hit) like lookup_sorted.
+    """
+    shape = keys.shape
+    values = jnp.full(shape, UNDECIDED, dtype=jnp.uint8)
+    remoteness = jnp.zeros(shape, dtype=jnp.int32)
+    hit = jnp.zeros(shape, dtype=bool)
+    for ts, tv, tr in window:
+        v, r, h = lookup_sorted(keys, ts, tv, tr)
+        values = jnp.where(h, v, values)
+        remoteness = jnp.where(h, r, remoteness)
+        hit = hit | h
+    return values, remoteness, hit

@@ -1,0 +1,28 @@
+"""Static-shape capacity planning: bucketed padding.
+
+XLA compiles one program per shape; per-level frontier sizes vary wildly
+(SURVEY.md §7 "Dynamic frontier vs static shapes"). We round every frontier up
+to a power-of-two bucket and pad with SENTINEL, so the whole solve reuses a
+small, bounded set of compiled programs regardless of level sizes.
+"""
+
+import numpy as np
+
+from gamesmanmpi_tpu.core.bitops import SENTINEL
+
+# Smallest bucket: keeps tiny levels from generating many near-empty programs.
+MIN_BUCKET = 256
+
+
+def bucket_size(n: int, minimum: int = MIN_BUCKET) -> int:
+    """Smallest power-of-two >= max(n, minimum)."""
+    return 1 << int(max(n, minimum, 1) - 1).bit_length()
+
+
+def pad_to_bucket(states: np.ndarray, minimum: int = MIN_BUCKET) -> np.ndarray:
+    """Pad a 1-D uint64 host array to its bucket size with SENTINEL."""
+    states = np.asarray(states, dtype=np.uint64)
+    cap = bucket_size(states.shape[0], minimum)
+    out = np.full(cap, SENTINEL, dtype=np.uint64)
+    out[: states.shape[0]] = states
+    return out

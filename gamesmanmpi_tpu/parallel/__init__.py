@@ -1,0 +1,14 @@
+"""parallel: the multi-device (hash-partitioned) solver.
+
+TPU-native rebuild of the reference's distributed layer (SURVEY.md §2.4):
+the one real parallelism strategy — hash-partitioned state-space SPMD — is
+re-expressed as a 1-D jax.sharding.Mesh, with the reference's point-to-point
+owner routing (`comm.send(dest=hash(pos) % world_size)`) replaced by one
+jax.lax.all_to_all bucket shuffle per BFS level inside shard_map, and the
+per-rank memo dicts replaced by sharded sorted-array tables.
+"""
+
+from gamesmanmpi_tpu.parallel.mesh import make_mesh
+from gamesmanmpi_tpu.parallel.sharded import ShardedSolver
+
+__all__ = ["make_mesh", "ShardedSolver"]

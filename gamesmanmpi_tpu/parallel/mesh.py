@@ -1,0 +1,38 @@
+"""Mesh construction: the rebuild of MPI.COMM_WORLD bring-up.
+
+The reference gets (rank, size) from mpi4py at launch (SURVEY.md §3.1); here
+the "world" is a 1-D device mesh. Multi-host bring-up is
+jax.distributed.initialize over DCN (SURVEY.md §5.8 control plane) before
+building the mesh over all addressable devices; single-host is just the local
+devices. The solver only sees the Mesh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+AXIS = "shards"
+
+
+def make_mesh(num_shards: int | None = None, devices=None) -> Mesh:
+    """A 1-D mesh over `num_shards` devices (default: all available)."""
+    if devices is None:
+        devices = jax.devices()
+    if num_shards is None:
+        num_shards = len(devices)
+    if num_shards > len(devices):
+        raise ValueError(
+            f"requested {num_shards} shards but only {len(devices)} devices"
+        )
+    return Mesh(np.array(devices[:num_shards]), (AXIS,))
+
+
+def init_distributed(**kwargs) -> None:
+    """Multi-host process-group bring-up (DCN): jax.distributed.initialize.
+
+    No-op convenience wrapper so launchers can call it unconditionally;
+    kwargs pass through (coordinator_address, num_processes, process_id).
+    """
+    jax.distributed.initialize(**kwargs)

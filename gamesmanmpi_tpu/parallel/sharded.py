@@ -1,0 +1,385 @@
+"""The sharded level-synchronous solver (multi-device).
+
+This is the TPU rebuild of the reference's distributed runtime proper
+(src/process.py's cross-rank behavior, SURVEY.md §3.2-3.3 and §5.8):
+
+  reference (per message/position)      here (per level, per shard)
+  ------------------------------------  --------------------------------------
+  comm.send(Job(LOOK_UP, child),        forward: expand locally, bucket all
+     dest=hash(child) % world_size)     children by owner_shard(child), one
+                                        lax.all_to_all over the ICI mesh,
+                                        then sort-unique locally (dedup is
+                                        local after owner routing)
+  per-rank memo dict {pos: value}       per-shard sorted (states, cells)
+                                        arrays — the hash-partitioned
+                                        position table in sharded HBM
+  SEND_BACK child result to parent      backward: all_gather the (tiny,
+                                        transient) solved window of deeper
+                                        levels, look child values up locally
+  FINISHED broadcast                    backward loop reaching the root level
+
+Capacity planning: all_to_all buffers are [num_shards, capacity] with
+SENTINEL padding. Overflow (a shard receiving more than capacity from one
+peer) is detected on host via returned per-destination counts and retried
+with a doubled capacity — the "capacity counters + host-side spill loop
+(rare path)" design of SURVEY.md §5.8.
+
+Shard-count invariance (same tables for 1 and N shards) is the test contract
+replacing the reference's `mpirun -np 1` vs `-np N` (SURVEY.md §4.2).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from gamesmanmpi_tpu.core.bitops import SENTINEL
+from gamesmanmpi_tpu.core.hashing import owner_shard, owner_shard_np
+from gamesmanmpi_tpu.core.values import UNDECIDED
+from gamesmanmpi_tpu.games.base import TensorGame
+from gamesmanmpi_tpu.ops.combine import combine_children
+from gamesmanmpi_tpu.ops.dedup import sort_unique
+from gamesmanmpi_tpu.ops.lookup import lookup_window
+from gamesmanmpi_tpu.ops.padding import bucket_size
+from gamesmanmpi_tpu.parallel.mesh import AXIS, make_mesh
+from gamesmanmpi_tpu.solve.engine import LevelTable, SolveResult, SolverError
+
+
+def _pad_shards(shard_arrays: List[np.ndarray], cap: int) -> np.ndarray:
+    """Stack per-shard 1-D uint64 arrays into [S, cap] with SENTINEL pad."""
+    S = len(shard_arrays)
+    out = np.full((S, cap), SENTINEL, dtype=np.uint64)
+    for s, arr in enumerate(shard_arrays):
+        out[s, : arr.shape[0]] = arr
+    return out
+
+
+class ShardedSolver:
+    """Hash-partitioned solver over a 1-D device mesh."""
+
+    def __init__(
+        self,
+        game: TensorGame,
+        *,
+        num_shards: int | None = None,
+        mesh=None,
+        min_bucket: int = 256,
+        paranoid: bool = False,
+        logger=None,
+        checkpointer=None,
+    ):
+        self.game = game
+        self.mesh = mesh if mesh is not None else make_mesh(num_shards)
+        self.S = self.mesh.devices.shape[0]
+        self.min_bucket = min_bucket
+        self.paranoid = paranoid
+        self.logger = logger
+        self.checkpointer = checkpointer
+        # Per-instance caches of jitted steps keyed on static shapes (a
+        # class-level functools.cache would pin instances for process life).
+        self._forward_cache: dict = {}
+        self._backward_cache: dict = {}
+
+    # ------------------------------------------------------------- jit builds
+
+    def _forward_fn(self, cap: int, route_cap: int):
+        """Compiled forward step: [S, cap] states -> routed unique children."""
+        key = (cap, route_cap)
+        if key in self._forward_cache:
+            return self._forward_cache[key]
+        g = self.game
+        S = self.S
+
+        def per_shard(local):  # local: [1, cap]
+            local = local[0]
+            valid = local != SENTINEL
+            prim = g.primitive(local)
+            children, mask = g.expand(local)
+            mask = mask & (valid & (prim == UNDECIDED))[:, None]
+            flat = jnp.where(mask, children, SENTINEL).reshape(-1)
+            owner = jnp.where(
+                flat == SENTINEL, S, owner_shard(flat, S)
+            ).astype(jnp.int32)
+            # Bucket by owner: stable-sort children by destination shard.
+            order = jnp.argsort(owner, stable=True)
+            s_owner = owner[order]
+            s_kids = flat[order]
+            # Position of each element within its destination bucket.
+            first = jnp.searchsorted(s_owner, jnp.arange(S + 1))
+            pos = jnp.arange(s_owner.shape[0]) - first[jnp.clip(s_owner, 0, S)]
+            counts = first[1:] - first[:-1]  # per-destination send counts [S]
+            out = jnp.full((S, route_cap), SENTINEL, dtype=jnp.uint64)
+            # Out-of-range rows (owner==S) and overflow (pos>=route_cap) drop.
+            out = out.at[s_owner, pos].set(s_kids, mode="drop")
+            routed = jax.lax.all_to_all(
+                out, AXIS, split_axis=0, concat_axis=0, tiled=True
+            )
+            uniq, count = sort_unique(routed.reshape(-1))
+            levels = jnp.where(uniq != SENTINEL, g.level_of(uniq), -1)
+            return (
+                uniq[None],
+                levels[None],
+                count[None],
+                counts[None],
+            )
+
+        fn = jax.shard_map(
+            per_shard,
+            mesh=self.mesh,
+            in_specs=P(AXIS),
+            out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        )
+        self._forward_cache[key] = jax.jit(fn)
+        return self._forward_cache[key]
+
+    def _backward_fn(self, cap: int, window_caps: tuple):
+        """Compiled backward step for one level against a solved window."""
+        key = (cap, window_caps)
+        if key in self._backward_cache:
+            return self._backward_cache[key]
+        g = self.game
+        S = self.S
+
+        def per_shard(local, *window_flat):  # local: [1, cap]
+            local = local[0]
+            valid = local != SENTINEL
+            prim = g.primitive(local)
+            undecided = valid & (prim == UNDECIDED)
+            children, mask = g.expand(local)
+            mask = mask & undecided[:, None]
+            children = jnp.where(mask, children, SENTINEL)
+            # Gather the solved window from all shards; each shard's slice is
+            # sorted, so lookups are per-chunk binary searches.
+            tables = []
+            for i in range(0, len(window_flat), 3):
+                ts = jax.lax.all_gather(window_flat[i][0], AXIS)  # [S, capL]
+                tv = jax.lax.all_gather(window_flat[i + 1][0], AXIS)
+                tr = jax.lax.all_gather(window_flat[i + 2][0], AXIS)
+                for s in range(S):
+                    tables.append((ts[s], tv[s], tr[s]))
+            child_vals, child_rem, hit = lookup_window(children, tuple(tables))
+            values, remoteness = combine_children(child_vals, child_rem, mask)
+            values = jnp.where(undecided, values, jnp.where(valid, prim, UNDECIDED))
+            remoteness = jnp.where(undecided, remoteness, 0)
+            # Misses + zero-move UNDECIDED positions (see engine._resolve_impl).
+            misses = jnp.sum(mask & ~hit) + jnp.sum(
+                undecided & ~jnp.any(mask, axis=-1)
+            )
+            return values[None], remoteness[None], misses[None]
+
+        n_windows = len(window_caps)
+        fn = jax.shard_map(
+            per_shard,
+            mesh=self.mesh,
+            in_specs=(P(AXIS),) + (P(AXIS),) * (3 * n_windows),
+            out_specs=(P(AXIS), P(AXIS), P(AXIS)),
+        )
+        self._backward_cache[key] = jax.jit(fn)
+        return self._backward_cache[key]
+
+    # ----------------------------------------------------------------- phases
+
+    def _forward(self, pools: Dict[int, List[np.ndarray]], start_level: int):
+        g = self.game
+        S = self.S
+        k = start_level
+        while pools and k <= max(pools):
+            if k not in pools:
+                k += 1
+                continue
+            t0 = time.perf_counter()
+            shards = pools[k]
+            cap = bucket_size(max(a.shape[0] for a in shards), self.min_bucket)
+            total = sum(a.shape[0] for a in shards)
+            route_cap = bucket_size(
+                max(64, 2 * cap * g.max_moves // S), self.min_bucket
+            )
+            stacked = _pad_shards(shards, cap)
+            while True:
+                uniq, levels, count, send_counts = self._forward_fn(
+                    cap, route_cap
+                )(stacked)
+                max_sent = int(np.asarray(send_counts).max())
+                if max_sent <= route_cap:
+                    break
+                route_cap = bucket_size(max_sent)  # spill path: retry bigger
+            uniq = np.asarray(uniq)
+            levels = np.asarray(levels)
+            count = np.asarray(count)
+            for s in range(S):
+                n = int(count[s])
+                kids = uniq[s, :n]
+                kid_levels = levels[s, :n]
+                for lv in np.unique(kid_levels):
+                    lv = int(lv)
+                    batch = kids[kid_levels == lv]
+                    if lv not in pools:
+                        pools[lv] = [np.empty(0, np.uint64) for _ in range(S)]
+                    pools[lv][s] = np.union1d(pools[lv][s], batch)
+            if self.logger is not None:
+                self.logger.log(
+                    {
+                        "phase": "forward",
+                        "level": k,
+                        "frontier": total,
+                        "shards": S,
+                        "route_cap": route_cap,
+                        "secs": time.perf_counter() - t0,
+                    }
+                )
+            k += 1
+
+    def _repartition(self, states: np.ndarray) -> List[np.ndarray]:
+        """Split a sorted global state array into per-shard sorted arrays."""
+        owners = owner_shard_np(states, self.S)
+        return [states[owners == s] for s in range(self.S)]
+
+    def _backward(self, pools: Dict[int, List[np.ndarray]]):
+        g = self.game
+        S = self.S
+        resolved: Dict[int, LevelTable] = {}
+        padded_cache: Dict[int, tuple] = {}
+        completed = (
+            set(self.checkpointer.completed_levels())
+            if self.checkpointer is not None
+            else set()
+        )
+        for k in sorted(pools, reverse=True):
+            t0 = time.perf_counter()
+            shards = pools[k]
+            cap = bucket_size(max(a.shape[0] for a in shards), self.min_bucket)
+            stacked = _pad_shards(shards, cap)
+            pv = np.full((S, cap), UNDECIDED, dtype=np.uint8)
+            pr = np.zeros((S, cap), dtype=np.int32)
+            from_checkpoint = k in completed
+            if from_checkpoint:
+                # Restart-from-level: reload the solved table, re-partition it
+                # by owner to refill the per-shard window cache.
+                table = self.checkpointer.load_level(k)
+                expected = np.sort(np.concatenate(shards))
+                if table.states.shape[0] != expected.shape[0] or not (
+                    table.states == expected
+                ).all():
+                    raise SolverError(
+                        f"checkpointed level {k} does not match the "
+                        "discovered frontier — stale checkpoint directory?"
+                    )
+                owners = owner_shard_np(table.states, S)
+                for s in range(S):
+                    sel = owners == s
+                    pv[s, : sel.sum()] = table.values[sel]
+                    pr[s, : sel.sum()] = table.remoteness[sel]
+            else:
+                window_levels = [
+                    k + j
+                    for j in range(1, g.max_level_jump + 1)
+                    if (k + j) in padded_cache
+                ]
+                window_caps = tuple(
+                    padded_cache[L][0].shape[1] for L in window_levels
+                )
+                window_flat = []
+                for L in window_levels:
+                    window_flat.extend(padded_cache[L])
+                values, remoteness, misses = self._backward_fn(cap, window_caps)(
+                    stacked, *window_flat
+                )
+                if self.paranoid and int(np.asarray(misses).sum()) > 0:
+                    raise SolverError(
+                        f"level {k}: consistency failures (missed child "
+                        "lookups or zero-move non-primitive positions)"
+                    )
+                values = np.asarray(values)
+                remoteness = np.asarray(remoteness)
+                # Global table for this level: concatenate shards (kept
+                # sharded on device during the solve; materialized for the
+                # result).
+                gs, gv, gr = [], [], []
+                for s in range(S):
+                    n = shards[s].shape[0]
+                    gs.append(shards[s])
+                    gv.append(values[s, :n])
+                    gr.append(remoteness[s, :n])
+                    pv[s, :n] = values[s, :n]
+                    pr[s, :n] = remoteness[s, :n]
+                states = np.concatenate(gs)
+                order = np.argsort(states)
+                table = LevelTable(
+                    states=states[order],
+                    values=np.concatenate(gv)[order],
+                    remoteness=np.concatenate(gr)[order],
+                )
+            resolved[k] = table
+            padded_cache[k] = (stacked, pv, pr)
+            for done in [d for d in padded_cache if d > k + g.max_level_jump]:
+                del padded_cache[done]
+            if self.logger is not None:
+                self.logger.log(
+                    {
+                        "phase": "backward",
+                        "level": k,
+                        "n": int(table.states.shape[0]),
+                        "shards": S,
+                        "resumed": from_checkpoint,
+                        "secs": time.perf_counter() - t0,
+                    }
+                )
+            if self.checkpointer is not None and not from_checkpoint:
+                self.checkpointer.save_level(k, table)
+        return resolved
+
+    # ------------------------------------------------------------------ solve
+
+    def solve(self) -> SolveResult:
+        g = self.game
+        S = self.S
+        t0 = time.perf_counter()
+        init = np.uint64(g.initial_state())
+        start_level = int(np.asarray(g.level_of(jnp.asarray([init])))[0])
+        global_pools = (
+            self.checkpointer.load_frontiers()
+            if self.checkpointer is not None
+            else None
+        )
+        if global_pools is not None:
+            pools = {
+                k: self._repartition(v) for k, v in global_pools.items()
+            }
+        else:
+            owner = int(owner_shard_np(np.array([init]), S)[0])
+            shards = [np.empty(0, np.uint64) for _ in range(S)]
+            shards[owner] = np.array([init], np.uint64)
+            pools = {start_level: shards}
+            self._forward(pools, start_level)
+            if self.checkpointer is not None:
+                self.checkpointer.save_frontiers(
+                    {
+                        k: np.sort(np.concatenate(v))
+                        for k, v in pools.items()
+                    }
+                )
+        t_forward = time.perf_counter() - t0
+        resolved = self._backward(pools)
+        t_total = time.perf_counter() - t0
+        root = resolved[start_level]
+        i = int(np.searchsorted(root.states, init))
+        num_positions = sum(t.states.shape[0] for t in resolved.values())
+        stats = {
+            "game": g.name,
+            "shards": S,
+            "positions": num_positions,
+            "levels": len(resolved),
+            "secs_forward": t_forward,
+            "secs_total": t_total,
+            "positions_per_sec": num_positions / max(t_total, 1e-9),
+        }
+        if self.logger is not None:
+            self.logger.log({"phase": "done", **stats})
+        return SolveResult(
+            g, int(root.values[i]), int(root.remoteness[i]), resolved, stats
+        )

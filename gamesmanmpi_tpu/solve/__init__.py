@@ -1,0 +1,6 @@
+"""solve: the solver engines (single-device sweep + host oracle)."""
+
+from gamesmanmpi_tpu.solve.engine import Solver, SolveResult, LevelTable
+from gamesmanmpi_tpu.solve.oracle import oracle_solve
+
+__all__ = ["Solver", "SolveResult", "LevelTable", "oracle_solve"]

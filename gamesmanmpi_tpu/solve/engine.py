@@ -1,0 +1,295 @@
+"""The level-synchronous retrograde solver (single device).
+
+This replaces the reference's entire L1 distributed runtime — the Process
+event loop, priority work queue and Job dispatch table (src/process.py,
+src/job.py; SURVEY.md §2.2, §3.2-3.4) — with two bulk phases per level.
+The Job types map as follows:
+
+  reference Job (SURVEY.md §2.2)   here
+  -------------------------------  -------------------------------------------
+  LOOK_UP / DISTRIBUTE             forward pass: expand a whole level's
+                                   frontier in one vmapped kernel; children are
+                                   dedup'd (sort-unique) and merged into their
+                                   level's pool instead of being mailed to
+                                   owner ranks one Job at a time.
+  CHECK_FOR_UPDATES                gone — no polling; the level barrier is the
+                                   only synchronization.
+  SEND_BACK / RESOLVE              backward pass: for each level (deepest
+                                   first) regenerate children, look their
+                                   values up in already-solved deeper levels
+                                   (ops.lookup), and combine (ops.combine).
+  FINISHED                         the backward loop reaching the root level.
+
+Scheduling differs from the reference by design (SURVEY.md §2.4: asynchronous
+small-message actors are anti-idiomatic on TPU); observable behavior — the
+(value, remoteness) of every reachable position — is preserved and tested
+against a pure-Python oracle.
+
+The forward/backward orchestration is a host loop (level count is tiny — tens
+of iterations); all per-position work runs inside jitted kernels with bucketed
+static shapes (ops.padding), so the set of compiled programs is small and
+reused across levels.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gamesmanmpi_tpu.core.bitops import SENTINEL
+from gamesmanmpi_tpu.core.values import UNDECIDED
+from gamesmanmpi_tpu.games.base import TensorGame
+from gamesmanmpi_tpu.ops.combine import combine_children
+from gamesmanmpi_tpu.ops.dedup import sort_unique
+from gamesmanmpi_tpu.ops.lookup import lookup_window
+from gamesmanmpi_tpu.ops.padding import MIN_BUCKET, pad_to_bucket
+
+
+class LevelTable(NamedTuple):
+    """Solved records for one level: parallel arrays sorted by state."""
+
+    states: np.ndarray  # uint64, sorted ascending
+    values: np.ndarray  # uint8
+    remoteness: np.ndarray  # int32
+
+
+class SolveResult:
+    """Full solve output: root answer + per-level tables + stats."""
+
+    def __init__(self, game: TensorGame, value: int, remoteness: int,
+                 levels: Dict[int, LevelTable], stats: dict):
+        self.game = game
+        self.value = int(value)
+        self.remoteness = int(remoteness)
+        self.levels = levels
+        self.stats = stats
+
+    @property
+    def num_positions(self) -> int:
+        return sum(t.states.shape[0] for t in self.levels.values())
+
+    def lookup(self, state) -> tuple[int, int]:
+        """(value, remoteness) of any reachable packed state."""
+        state = np.uint64(state)
+        level = int(
+            np.asarray(self.game.level_of(jnp.asarray([state], jnp.uint64)))[0]
+        )
+        table = self.levels.get(level)
+        if table is not None:
+            i = np.searchsorted(table.states, state)
+            if i < table.states.shape[0] and table.states[i] == state:
+                return int(table.values[i]), int(table.remoteness[i])
+        raise KeyError(f"state {state:#x} not reachable/solved")
+
+
+class SolverError(RuntimeError):
+    pass
+
+
+class Solver:
+    """Single-device level-synchronous solver for a TensorGame."""
+
+    def __init__(
+        self,
+        game: TensorGame,
+        *,
+        min_bucket: int = MIN_BUCKET,
+        paranoid: bool = False,
+        logger=None,
+        checkpointer=None,
+    ):
+        self.game = game
+        self.min_bucket = min_bucket
+        self.paranoid = paranoid
+        self.logger = logger
+        self.checkpointer = checkpointer
+        self._expand_jit = jax.jit(self._expand_impl)
+        self._resolve_jit = jax.jit(self._resolve_impl)
+
+    # ---------------------------------------------------------------- kernels
+
+    def _expand_impl(self, states):
+        """[B] states -> (unique children [B*M] sorted, their levels, count)."""
+        g = self.game
+        valid = states != SENTINEL
+        prim = g.primitive(states)
+        expandable = valid & (prim == UNDECIDED)
+        children, mask = g.expand(states)
+        mask = mask & expandable[:, None]
+        children = jnp.where(mask, children, SENTINEL)
+        uniq, count = sort_unique(children.reshape(-1))
+        levels = jnp.where(uniq != SENTINEL, g.level_of(uniq), -1)
+        return uniq, levels, count
+
+    def _resolve_impl(self, states, window):
+        """[B] states + solved deeper levels -> (values, remoteness, misses)."""
+        g = self.game
+        valid = states != SENTINEL
+        prim = g.primitive(states)
+        undecided = valid & (prim == UNDECIDED)
+        children, mask = g.expand(states)
+        mask = mask & undecided[:, None]
+        children = jnp.where(mask, children, SENTINEL)
+        child_vals, child_rem, hit = lookup_window(children, window)
+        values, remoteness = combine_children(child_vals, child_rem, mask)
+        values = jnp.where(undecided, values, jnp.where(valid, prim, UNDECIDED))
+        remoteness = jnp.where(undecided, remoteness, 0)
+        # Consistency counters (SURVEY.md §5.2): child lookups that missed the
+        # solved window, and non-primitive positions with zero legal moves
+        # (a game-definition error — they would silently score LOSE/0).
+        misses = jnp.sum(mask & ~hit) + jnp.sum(undecided & ~jnp.any(mask, axis=-1))
+        return values, remoteness, misses
+
+    # ----------------------------------------------------------------- phases
+
+    def _forward(self, pools: Dict[int, np.ndarray], start_level: int) -> dict:
+        """Discover all reachable states, grouped into per-level pools."""
+        g = self.game
+        stats_levels = {}
+        k = start_level
+        while pools and k <= max(pools):
+            if k not in pools:
+                k += 1
+                continue
+            t0 = time.perf_counter()
+            frontier = pools[k]
+            padded = pad_to_bucket(frontier, self.min_bucket)
+            uniq, levels, count = self._expand_jit(padded)
+            n = int(count)
+            kids = np.asarray(uniq[:n])
+            kid_levels = np.asarray(levels[:n])
+            for lv in np.unique(kid_levels):
+                lv = int(lv)
+                batch = kids[kid_levels == lv]
+                if lv in pools:
+                    pools[lv] = np.union1d(pools[lv], batch)
+                else:
+                    pools[lv] = batch
+            dt = time.perf_counter() - t0
+            stats_levels[k] = {
+                "phase": "forward",
+                "level": k,
+                "frontier": int(frontier.shape[0]),
+                "children": n,
+                "secs": dt,
+            }
+            if self.logger is not None:
+                self.logger.log(stats_levels[k])
+            k += 1
+        return stats_levels
+
+    def _backward(self, pools: Dict[int, np.ndarray]) -> Dict[int, LevelTable]:
+        """Resolve all levels deepest-first against the solved window.
+
+        Levels already present in the checkpoint (a previous, preempted run)
+        are loaded instead of recomputed — restart-from-level recovery.
+        """
+        g = self.game
+        resolved: Dict[int, LevelTable] = {}
+        padded_cache: Dict[int, tuple] = {}
+        completed = (
+            set(self.checkpointer.completed_levels())
+            if self.checkpointer is not None
+            else set()
+        )
+        for k in sorted(pools, reverse=True):
+            t0 = time.perf_counter()
+            states = pools[k]
+            padded = pad_to_bucket(states, self.min_bucket)
+            n = states.shape[0]
+            from_checkpoint = k in completed
+            if from_checkpoint:
+                table = self.checkpointer.load_level(k)
+                if table.states.shape[0] != n or not (table.states == states).all():
+                    raise SolverError(
+                        f"checkpointed level {k} does not match the discovered "
+                        "frontier — stale checkpoint directory?"
+                    )
+            else:
+                window = tuple(
+                    padded_cache[k + j]
+                    for j in range(1, g.max_level_jump + 1)
+                    if (k + j) in padded_cache
+                )
+                values, remoteness, misses = self._resolve_jit(padded, window)
+                if self.paranoid and int(misses) > 0:
+                    raise SolverError(
+                        f"level {k}: {int(misses)} consistency failures (child "
+                        "lookups outside the solved window — level_of/"
+                        "max_level_jump inconsistent — or non-primitive "
+                        "positions with zero legal moves)"
+                    )
+                table = LevelTable(
+                    states=states,
+                    values=np.asarray(values[:n]),
+                    remoteness=np.asarray(remoteness[:n]),
+                )
+            resolved[k] = table
+            cap = padded.shape[0]
+            pv = np.full(cap, UNDECIDED, dtype=np.uint8)
+            pr = np.zeros(cap, dtype=np.int32)
+            pv[:n] = table.values
+            pr[:n] = table.remoteness
+            padded_cache[k] = (padded, pv, pr)
+            # Levels deeper than the lookback window can never be read again.
+            for done in [d for d in padded_cache if d > k + g.max_level_jump]:
+                del padded_cache[done]
+            if self.logger is not None:
+                self.logger.log(
+                    {
+                        "phase": "backward",
+                        "level": k,
+                        "n": n,
+                        "resumed": from_checkpoint,
+                        "secs": time.perf_counter() - t0,
+                    }
+                )
+            if self.checkpointer is not None and not from_checkpoint:
+                self.checkpointer.save_level(k, table)
+        return resolved
+
+    # ------------------------------------------------------------------ solve
+
+    def solve(self) -> SolveResult:
+        g = self.game
+        t0 = time.perf_counter()
+        init = np.uint64(g.initial_state())
+        start_level = int(np.asarray(g.level_of(jnp.asarray([init])))[0])
+        pools = (
+            self.checkpointer.load_frontiers()
+            if self.checkpointer is not None
+            else None
+        )
+        if pools is None:
+            pools = {start_level: np.array([init], np.uint64)}
+            self._forward(pools, start_level)
+            if self.checkpointer is not None:
+                self.checkpointer.save_frontiers(pools)
+        t_forward = time.perf_counter() - t0
+        resolved = self._backward(pools)
+        t_total = time.perf_counter() - t0
+        root = resolved[start_level]
+        i = int(np.searchsorted(root.states, init))
+        value = int(root.values[i])
+        remoteness = int(root.remoteness[i])
+        num_positions = sum(t.states.shape[0] for t in resolved.values())
+        stats = {
+            "game": g.name,
+            "positions": num_positions,
+            "levels": len(resolved),
+            "secs_forward": t_forward,
+            "secs_total": t_total,
+            "positions_per_sec": num_positions / max(t_total, 1e-9),
+        }
+        if self.logger is not None:
+            self.logger.log({"phase": "done", **stats})
+        return SolveResult(g, value, remoteness, resolved, stats)
+
+
+def solve(game: TensorGame, **kwargs) -> SolveResult:
+    """Convenience: Solver(game, **kwargs).solve()."""
+    return Solver(game, **kwargs).solve()

@@ -1,0 +1,19 @@
+"""utils: observability and persistence (SURVEY.md §5).
+
+The reference has stdout prints and nothing else (§5.1-5.5 all "none");
+these are the TPU-idiomatic equivalents the rebuild is required to carry:
+structured per-level metrics (metrics.py), level checkpoint/restart
+(checkpoint.py), and profiler capture (profiling.py).
+"""
+
+from gamesmanmpi_tpu.utils.metrics import JsonlLogger, StdoutLogger
+from gamesmanmpi_tpu.utils.checkpoint import LevelCheckpointer, save_result_npz
+from gamesmanmpi_tpu.utils.profiling import maybe_profile
+
+__all__ = [
+    "JsonlLogger",
+    "StdoutLogger",
+    "LevelCheckpointer",
+    "save_result_npz",
+    "maybe_profile",
+]

@@ -1,0 +1,115 @@
+"""Per-level checkpoint / resume (SURVEY.md §5.4).
+
+The reference has no checkpointing — a solve is monolithic and in-memory.
+For the north-star scale (4.5e12 states on a preemptible pod) restart-from-
+level recovery is required. The unit of persistence is the natural unit of
+the level-synchronous engine: one solved level = (sorted states, packed
+value+remoteness cells via core.codec). Plain .npz per level plus a JSON
+manifest — no framework dependency, shard-friendly, and the packed cell
+format is exactly the HBM table layout.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from gamesmanmpi_tpu.core.codec import pack_cells, unpack_cells
+
+
+class LevelCheckpointer:
+    """Saves solved levels as they complete; loads them for resume."""
+
+    def __init__(self, directory: str):
+        self.dir = pathlib.Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.manifest_path = self.dir / "manifest.json"
+
+    def _level_path(self, level: int) -> pathlib.Path:
+        return self.dir / f"level_{level:04d}.npz"
+
+    def save_level(self, level: int, table) -> None:
+        cells = np.asarray(
+            pack_cells(jnp.asarray(table.values), jnp.asarray(table.remoteness))
+        )
+        np.savez_compressed(
+            self._level_path(level), states=table.states, cells=cells
+        )
+        manifest = self.load_manifest()
+        manifest["levels"] = sorted(set(manifest.get("levels", [])) | {level})
+        self.manifest_path.write_text(json.dumps(manifest))
+
+    def load_manifest(self) -> dict:
+        if self.manifest_path.exists():
+            return json.loads(self.manifest_path.read_text())
+        return {}
+
+    def load_level(self, level: int):
+        from gamesmanmpi_tpu.solve.engine import LevelTable
+
+        with np.load(self._level_path(level)) as z:
+            states = z["states"]
+            values, remoteness = unpack_cells(jnp.asarray(z["cells"]))
+        return LevelTable(
+            states=states,
+            values=np.asarray(values),
+            remoteness=np.asarray(remoteness),
+        )
+
+    def completed_levels(self) -> list[int]:
+        return list(self.load_manifest().get("levels", []))
+
+    # Forward-phase snapshot: all per-level frontiers after discovery, so a
+    # restarted solve skips the whole forward sweep (restart-from-level,
+    # SURVEY.md §5.4 — the backward phase then loads completed levels).
+
+    def save_frontiers(self, pools) -> None:
+        arrays = {
+            f"level_{k:04d}": np.asarray(v, np.uint64) for k, v in pools.items()
+        }
+        np.savez_compressed(self.dir / "frontiers.npz", **arrays)
+        manifest = self.load_manifest()
+        manifest["frontiers"] = True
+        self.manifest_path.write_text(json.dumps(manifest))
+
+    def load_frontiers(self):
+        """-> {level: sorted uint64 states} or None if no snapshot exists."""
+        if not self.load_manifest().get("frontiers"):
+            return None
+        path = self.dir / "frontiers.npz"
+        if not path.exists():
+            return None
+        out = {}
+        with np.load(path) as z:
+            for name in z.files:
+                out[int(name.split("_")[1])] = z[name]
+        return out
+
+
+def save_table_npz(path: str, table: dict) -> None:
+    """Dump a host-solve table ({pos: (value, remoteness)}) as one .npz."""
+    states = np.array(sorted(table), dtype=np.uint64)
+    values = jnp.asarray(
+        np.array([table[int(s)][0] for s in states], dtype=np.uint8)
+    )
+    rems = jnp.asarray(
+        np.array([table[int(s)][1] for s in states], dtype=np.int32)
+    )
+    np.savez_compressed(
+        path, states=states, cells=np.asarray(pack_cells(values, rems))
+    )
+
+
+def save_result_npz(path: str, result) -> None:
+    """Dump a SolveResult's full table as one .npz (packed cells per level)."""
+    arrays = {}
+    for level, table in result.levels.items():
+        cells = np.asarray(
+            pack_cells(jnp.asarray(table.values), jnp.asarray(table.remoteness))
+        )
+        arrays[f"states_{level:04d}"] = table.states
+        arrays[f"cells_{level:04d}"] = cells
+    np.savez_compressed(path, **arrays)

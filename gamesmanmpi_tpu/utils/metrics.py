@@ -1,0 +1,60 @@
+"""Structured per-level metrics (SURVEY.md §5.5).
+
+Reference: stdout on rank 0 plus optional rank-tagged debug prints. Rebuild:
+one structured record per solve phase per level — level, frontier size, seconds,
+positions/sec — emitted as JSONL (and optionally human-readable). This is
+load-bearing: BASELINE.json's tracked metric is positions-solved/sec/chip, and
+bench.py computes it from these records.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+class JsonlLogger:
+    """Appends one JSON object per record to a file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fh = open(path, "a", buffering=1)
+        self._t0 = time.time()
+
+    def log(self, record: dict) -> None:
+        rec = {"t": round(time.time() - self._t0, 6), **record}
+        self._fh.write(json.dumps(rec, default=str) + "\n")
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+class StdoutLogger:
+    """Human-readable per-level progress lines (debug flag analog)."""
+
+    def log(self, record: dict) -> None:
+        phase = record.get("phase", "?")
+        level = record.get("level", "-")
+        parts = [
+            f"{k}={v}" for k, v in record.items() if k not in ("phase", "level")
+        ]
+        print(f"[{phase}] level={level} " + " ".join(parts), file=sys.stderr)
+
+    def close(self) -> None:
+        pass
+
+
+class TeeLogger:
+    """Fan a record out to several loggers."""
+
+    def __init__(self, *loggers):
+        self.loggers = [l for l in loggers if l is not None]
+
+    def log(self, record: dict) -> None:
+        for l in self.loggers:
+            l.log(record)
+
+    def close(self) -> None:
+        for l in self.loggers:
+            l.close()

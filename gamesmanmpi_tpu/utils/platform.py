@@ -1,0 +1,52 @@
+"""Backend/platform selection that works around plugin-pinned containers.
+
+Some environments register an accelerator PJRT plugin in sitecustomize and pin
+`jax_platforms` at interpreter start. That makes the standard JAX_PLATFORMS
+env var ineffective (the config wins) and can hang CPU-only runs at first
+backend init. The one reliable knob is the jax config, set before backends
+initialize — this helper is the single place that knowledge lives
+(used by the CLI, the driver entry points, and tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_platform(platform: str, fake_devices: int | None = None) -> None:
+    """Select a JAX platform robustly; optionally fake N host devices.
+
+    Must run before the first jax array/device operation for the XLA_FLAGS
+    part to take effect; if backends already initialized, they are cleared
+    (pre-existing arrays keep their original backend).
+    """
+    if fake_devices is not None and platform == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={fake_devices}"
+            ).strip()
+
+    import jax
+
+    # Config first: clearing/initializing backends re-reads the config, and
+    # initializing a pinned plugin backend is exactly what can hang.
+    jax.config.update("jax_platforms", platform)
+    jax.config.update("jax_enable_x64", True)
+
+    from jax._src import xla_bridge
+
+    if xla_bridge.backends_are_initialized():
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+
+
+def apply_platform_env(default_fake_devices: int | None = None) -> None:
+    """Honor GAMESMAN_PLATFORM (and GAMESMAN_FAKE_DEVICES) if set."""
+    platform = os.environ.get("GAMESMAN_PLATFORM")
+    if not platform:
+        return
+    fake = os.environ.get("GAMESMAN_FAKE_DEVICES")
+    fake_devices = int(fake) if fake else default_fake_devices
+    force_platform(platform, fake_devices)

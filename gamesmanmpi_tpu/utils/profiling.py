@@ -1,0 +1,22 @@
+"""Profiler capture (SURVEY.md §5.1).
+
+Reference: none beyond an elapsed-time print. Rebuild: wrap any solve in a
+jax.profiler trace (viewable in TensorBoard/Perfetto) with a no-op fallback
+when no directory is given.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def maybe_profile(trace_dir=None):
+    """Context manager: jax.profiler.trace(trace_dir) when a dir is given."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(str(trace_dir)):
+        yield

@@ -1,0 +1,11 @@
+#!/usr/bin/env python
+"""Launcher shim — the reference repo's entry point is solver_launcher.py at
+the repo root (SURVEY.md §2.2); this is its counterpart, delegating to
+gamesmanmpi_tpu.cli."""
+
+import sys
+
+from gamesmanmpi_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

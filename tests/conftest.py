@@ -1,0 +1,16 @@
+"""Test environment: CPU backend faking an 8-device mesh.
+
+SURVEY.md §4.2 axis 2: shard-count invariance is the TPU analog of the
+reference's `mpirun -np 1` vs `-np 8`, and the fake-backend mechanism for CI
+is XLA's host-platform device-count flag.
+
+This container's sitecustomize registers an experimental TPU PJRT plugin
+("axon") and forces `jax_platforms="axon,cpu"` at interpreter start, which
+both ignores a JAX_PLATFORMS=cpu env var and hangs CPU-only runs. The
+workaround lives in one place — utils/platform.force_platform — which must
+run before the first backend use.
+"""
+
+from gamesmanmpi_tpu.utils.platform import force_platform
+
+force_platform("cpu", fake_devices=8)

@@ -1,0 +1,43 @@
+"""Shared test helpers."""
+
+import importlib.util
+import pathlib
+
+import numpy as np
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+REF_GAMES = REPO / "examples" / "ref_games"
+
+
+def load_module(path):
+    """Import a reference-style scalar game module from a file path."""
+    path = pathlib.Path(path)
+    spec = importlib.util.spec_from_file_location(path.stem, path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def full_table(result):
+    """Flatten a SolveResult's per-level tables into {pos: (value, rem)}."""
+    out = {}
+    for table in result.levels.values():
+        for s, v, r in zip(table.states, table.values, table.remoteness):
+            out[int(s)] = (int(v), int(r))
+    return out
+
+
+def assert_table_parity(result, oracle_table):
+    engine_table = full_table(result)
+    assert len(engine_table) == len(oracle_table), (
+        f"reachable-set size mismatch: engine {len(engine_table)} "
+        f"vs oracle {len(oracle_table)}"
+    )
+    mismatches = []
+    for pos, expected in oracle_table.items():
+        got = engine_table.get(int(pos))
+        if got != expected:
+            mismatches.append((pos, expected, got))
+            if len(mismatches) > 5:
+                break
+    assert not mismatches, f"value/remoteness mismatches: {mismatches}"

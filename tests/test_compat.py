@@ -1,0 +1,64 @@
+"""compat/: unmodified reference-style modules through both paths."""
+
+import numpy as np
+import pytest
+
+from gamesmanmpi_tpu.compat import TensorizedModule, load_game_module, solve_module
+from gamesmanmpi_tpu.core.values import TIE, WIN
+from gamesmanmpi_tpu.solve import Solver
+
+from helpers import REF_GAMES, assert_table_parity
+
+
+def test_solve_module_tictactoe():
+    module = load_game_module(REF_GAMES / "tictactoe.py")
+    value, remoteness, table = solve_module(module)
+    assert value == TIE and remoteness == 9
+    assert len(table) == 5478
+
+
+def test_solve_module_accepts_generate_moves_spelling(tmp_path):
+    src = (REF_GAMES / "ten_to_zero.py").read_text()
+    src = src.replace("def gen_moves", "def generate_moves")
+    p = tmp_path / "alt_spelling.py"
+    p.write_text(src)
+    module = load_game_module(p)
+    value, _, _ = solve_module(module)
+    assert value == WIN
+
+
+def test_load_game_module_validates(tmp_path):
+    p = tmp_path / "bad_game.py"
+    p.write_text("initial_position = 0\n")
+    with pytest.raises(AttributeError):
+        load_game_module(p)
+
+
+def test_tensorized_module_through_jit_engine():
+    """The boundary proof: an unmodified scalar module driven by the same
+    jitted level-synchronous engine, full-table parity vs the host oracle."""
+    module = load_game_module(REF_GAMES / "ten_to_zero.py")
+    game = TensorizedModule(
+        module,
+        max_moves=2,
+        level_fn=lambda pos: module.initial_position - pos,
+        max_level_jump=2,
+        num_levels=11,
+    )
+    result = Solver(game, paranoid=True).solve()
+    _, _, oracle_table = solve_module(module)
+    assert result.value == WIN
+    assert_table_parity(result, oracle_table)
+
+
+def test_tensorized_module_tictactoe():
+    module = load_game_module(REF_GAMES / "tictactoe.py")
+    game = TensorizedModule(
+        module,
+        max_moves=9,
+        level_fn=lambda pos: bin(pos).count("1"),
+        num_levels=10,
+    )
+    result = Solver(game, paranoid=True).solve()
+    assert result.value == TIE and result.remoteness == 9
+    assert result.num_positions == 5478

@@ -1,0 +1,67 @@
+"""core/: value algebra, codec, hashing, bit ops."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from gamesmanmpi_tpu.core import (
+    WIN,
+    LOSE,
+    TIE,
+    UNDECIDED,
+    negate,
+    pack_cells,
+    unpack_cells,
+    owner_shard,
+    splitmix64,
+    popcount64,
+    msb_index64,
+    SENTINEL,
+)
+from gamesmanmpi_tpu.core.hashing import owner_shard_np
+from gamesmanmpi_tpu.core.values import MAX_REMOTENESS
+
+
+def test_negate_involution():
+    vals = jnp.arange(4, dtype=jnp.uint8)
+    assert (negate(negate(vals)) == vals).all()
+    assert int(negate(jnp.uint8(WIN))) == LOSE
+    assert int(negate(jnp.uint8(LOSE))) == WIN
+    assert int(negate(jnp.uint8(TIE))) == TIE
+    assert int(negate(jnp.uint8(UNDECIDED))) == UNDECIDED
+
+
+def test_codec_roundtrip():
+    rng = np.random.default_rng(0)
+    values = jnp.asarray(rng.integers(0, 4, 1000), jnp.uint8)
+    rem = jnp.asarray(rng.integers(0, MAX_REMOTENESS + 1, 1000), jnp.int32)
+    v, r = unpack_cells(pack_cells(values, rem))
+    assert (v == values).all()
+    assert (r == rem).all()
+
+
+def test_splitmix64_bijective_sample():
+    rng = np.random.default_rng(1)
+    xs = jnp.asarray(rng.integers(0, 2**63, 4096, dtype=np.uint64))
+    hs = np.asarray(splitmix64(xs))
+    assert len(np.unique(hs)) == len(np.unique(np.asarray(xs)))
+
+
+def test_owner_shard_total_and_deterministic():
+    # Hash-partition totality (SURVEY.md §4.2 axis 3): every position owned by
+    # exactly one shard, stable across calls, and consistent host vs device.
+    rng = np.random.default_rng(2)
+    xs = rng.integers(0, 2**63, 10000, dtype=np.uint64)
+    for n in (1, 2, 8):
+        owners = np.asarray(owner_shard(jnp.asarray(xs), n))
+        assert owners.min() >= 0 and owners.max() < n
+        assert (owners == np.asarray(owner_shard(jnp.asarray(xs), n))).all()
+        assert (owners == owner_shard_np(xs, n)).all()
+    # Reasonable balance over 8 shards.
+    counts = np.bincount(owner_shard_np(xs, 8), minlength=8)
+    assert counts.min() > 0.8 * len(xs) / 8
+
+
+def test_bitops():
+    xs = jnp.asarray(np.array([1, 2, 3, 2**40, SENTINEL], dtype=np.uint64))
+    assert list(np.asarray(popcount64(xs))) == [1, 1, 2, 1, 64]
+    assert list(np.asarray(msb_index64(xs))) == [0, 1, 1, 40, 63]

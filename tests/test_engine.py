@@ -1,0 +1,74 @@
+"""solve/: end-to-end solves with oracle parity (SURVEY.md §4.2 axis 1).
+
+Golden values (SURVEY.md §4.2 table): 3x3 tic-tac-toe is a TIE with
+remoteness 9; normal-play Nim is a first-player WIN iff XOR of heaps != 0;
+1-2-10 subtraction follows mod-3 arithmetic.
+"""
+
+import numpy as np
+import pytest
+
+from gamesmanmpi_tpu.core.values import WIN, LOSE, TIE
+from gamesmanmpi_tpu.games import get_game
+from gamesmanmpi_tpu.solve import Solver, oracle_solve
+
+from helpers import REF_GAMES, load_module, assert_table_parity, full_table
+
+
+def _solve_both(spec, ref_file, **solver_kw):
+    result = Solver(get_game(spec), paranoid=True, **solver_kw).solve()
+    _, _, oracle_table = oracle_solve(load_module(REF_GAMES / ref_file))
+    return result, oracle_table
+
+
+def test_tictactoe_3x3_full_parity():
+    result, oracle_table = _solve_both("tictactoe", "tictactoe.py")
+    assert result.value == TIE
+    assert result.remoteness == 9
+    assert result.num_positions == 5478  # classic reachable count
+    assert_table_parity(result, oracle_table)
+
+
+def test_subtract_1210_parity_and_closed_form():
+    result, oracle_table = _solve_both(
+        "subtract:total=10,moves=1-2", "ten_to_zero.py"
+    )
+    # 10 % 3 == 1 -> first player WIN (takes 1, leaves a multiple of 3).
+    assert result.value == WIN
+    assert_table_parity(result, oracle_table)
+    # Closed form for every position: LOSE iff pos % 3 == 0.
+    for pos, (value, _) in full_table(result).items():
+        assert value == (LOSE if pos % 3 == 0 else WIN)
+
+
+def test_subtract_misere():
+    game = get_game("subtract:total=10,moves=1-2,misere=1")
+    result = Solver(game, paranoid=True).solve()
+    # Misère: LOSE iff pos % 3 == 1; 10 % 3 == 1 -> first player LOSE.
+    assert result.value == LOSE
+
+
+def test_nim_345_parity_and_xor_rule():
+    result, oracle_table = _solve_both("nim:heaps=3-4-5", "nim_345.py")
+    assert result.value == WIN  # 3 ^ 4 ^ 5 == 2 != 0
+    assert_table_parity(result, oracle_table)
+    # XOR rule across the whole table (normal play).
+    game = get_game("nim:heaps=3-4-5")
+    for pos, (value, _) in full_table(result).items():
+        heaps = [(pos >> (i * game.bits)) & ((1 << game.bits) - 1) for i in range(3)]
+        x = heaps[0] ^ heaps[1] ^ heaps[2]
+        assert value == (LOSE if x == 0 else WIN), f"XOR rule broken at {heaps}"
+
+
+def test_connect4_4x4_full_parity():
+    result, oracle_table = _solve_both("connect4:w=4,h=4", "connect4_4x4.py")
+    assert_table_parity(result, oracle_table)
+
+
+def test_result_lookup():
+    result = Solver(get_game("tictactoe"), paranoid=True).solve()
+    value, rem = result.lookup(result.game.initial_state())
+    assert (value, rem) == (TIE, 9)
+    with pytest.raises(KeyError):
+        # An unreachable "position": both players on the same cell.
+        result.lookup(np.uint64(1 | (1 << 9)))

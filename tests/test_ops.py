@@ -1,0 +1,103 @@
+"""ops/: padding, dedup, lookup, combine kernels."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from gamesmanmpi_tpu.core.bitops import SENTINEL
+from gamesmanmpi_tpu.core.values import WIN, LOSE, TIE, UNDECIDED
+from gamesmanmpi_tpu.ops import (
+    bucket_size,
+    pad_to_bucket,
+    sort_unique,
+    lookup_sorted,
+    lookup_window,
+    combine_children,
+)
+
+
+def test_bucket_size():
+    assert bucket_size(0) == 256
+    assert bucket_size(256) == 256
+    assert bucket_size(257) == 512
+    assert bucket_size(1000) == 1024
+
+
+def test_pad_to_bucket():
+    out = pad_to_bucket(np.array([5, 3], dtype=np.uint64))
+    assert out.shape == (256,)
+    assert out[0] == 5 and out[1] == 3
+    assert (out[2:] == SENTINEL).all()
+
+
+def test_sort_unique():
+    x = np.array([7, 3, 7, SENTINEL, 3, 1, SENTINEL], dtype=np.uint64)
+    s, count = sort_unique(jnp.asarray(x))
+    assert int(count) == 3
+    assert list(np.asarray(s[:3])) == [1, 3, 7]
+    assert (np.asarray(s[3:]) == SENTINEL).all()
+
+
+def _table(states, values, rems):
+    states = np.asarray(states, np.uint64)
+    order = np.argsort(states)
+    return (
+        jnp.asarray(states[order]),
+        jnp.asarray(np.asarray(values, np.uint8)[order]),
+        jnp.asarray(np.asarray(rems, np.int32)[order]),
+    )
+
+
+def test_lookup_sorted_hits_and_misses():
+    ts, tv, tr = _table([10, 20, 30], [WIN, LOSE, TIE], [1, 2, 3])
+    keys = jnp.asarray(np.array([20, 5, 30, 99, SENTINEL], dtype=np.uint64))
+    v, r, hit = lookup_sorted(keys, ts, tv, tr)
+    assert list(np.asarray(hit)) == [True, False, True, False, False]
+    assert list(np.asarray(v)) == [LOSE, UNDECIDED, TIE, UNDECIDED, UNDECIDED]
+    assert list(np.asarray(r)) == [2, 0, 3, 0, 0]
+
+
+def test_lookup_window_multi_level():
+    w1 = _table([10, 20], [WIN, LOSE], [1, 2])
+    w2 = _table([30, 40], [TIE, WIN], [3, 4])
+    keys = jnp.asarray(np.array([40, 10, 77], dtype=np.uint64))
+    v, r, hit = lookup_window(keys, (w1, w2))
+    assert list(np.asarray(hit)) == [True, True, False]
+    assert list(np.asarray(v)) == [WIN, WIN, UNDECIDED]
+    assert list(np.asarray(r)) == [4, 1, 0]
+
+
+def test_combine_children_rules():
+    # Rows: (child values, child rems, mask) -> expected (value, rem).
+    cv = jnp.asarray(
+        np.array(
+            [
+                [LOSE, WIN, LOSE],  # WIN: 1 + min(LOSE rems 5, 2) = 3
+                [WIN, TIE, WIN],  # TIE: 1 + max(TIE rems) = 8
+                [WIN, WIN, WIN],  # LOSE: 1 + max(all rems) = 10
+                [LOSE, LOSE, LOSE],  # masked lanes ignored
+            ],
+            dtype=np.uint8,
+        )
+    )
+    cr = jnp.asarray(np.array([[5, 9, 2], [1, 7, 3], [4, 9, 6], [5, 1, 9]], np.int32))
+    mask = jnp.asarray(
+        np.array(
+            [
+                [True, True, True],
+                [True, True, True],
+                [True, True, True],
+                [True, False, False],
+            ]
+        )
+    )
+    v, r = combine_children(cv, cr, mask)
+    assert list(np.asarray(v)) == [WIN, TIE, LOSE, WIN]
+    assert list(np.asarray(r)) == [3, 8, 10, 6]
+
+
+def test_combine_children_no_children():
+    cv = jnp.zeros((1, 3), jnp.uint8)
+    cr = jnp.zeros((1, 3), jnp.int32)
+    mask = jnp.zeros((1, 3), bool)
+    v, r = combine_children(cv, cr, mask)
+    assert int(v[0]) == LOSE and int(r[0]) == 0

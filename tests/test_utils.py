@@ -1,0 +1,168 @@
+"""utils/: metrics JSONL, checkpoint/resume, profiler hook, CLI."""
+
+import json
+
+import numpy as np
+import pytest
+
+from gamesmanmpi_tpu.cli import main as cli_main
+from gamesmanmpi_tpu.games import get_game
+from gamesmanmpi_tpu.solve import Solver
+from gamesmanmpi_tpu.utils import JsonlLogger, LevelCheckpointer, maybe_profile
+from gamesmanmpi_tpu.utils.checkpoint import save_result_npz
+
+from helpers import REF_GAMES
+
+
+def test_jsonl_logger_and_solver_records(tmp_path):
+    path = tmp_path / "metrics.jsonl"
+    logger = JsonlLogger(str(path))
+    result = Solver(get_game("subtract:total=10,moves=1-2"), logger=logger).solve()
+    logger.close()
+    records = [json.loads(line) for line in path.read_text().splitlines()]
+    phases = {r["phase"] for r in records}
+    assert {"forward", "backward", "done"} <= phases
+    done = [r for r in records if r["phase"] == "done"][0]
+    assert done["positions"] == result.num_positions
+    assert done["positions_per_sec"] > 0
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    ckpt = LevelCheckpointer(str(tmp_path / "ckpt"))
+    result = Solver(get_game("tictactoe"), checkpointer=ckpt).solve()
+    assert sorted(ckpt.completed_levels()) == sorted(result.levels.keys())
+    for level, table in result.levels.items():
+        loaded = ckpt.load_level(level)
+        assert (loaded.states == table.states).all()
+        assert (loaded.values == table.values).all()
+        assert (loaded.remoteness == table.remoteness).all()
+
+
+def test_save_result_npz(tmp_path):
+    result = Solver(get_game("subtract:total=10,moves=1-2")).solve()
+    out = tmp_path / "table.npz"
+    save_result_npz(str(out), result)
+    with np.load(out) as z:
+        names = set(z.files)
+    assert any(n.startswith("states_") for n in names)
+    assert any(n.startswith("cells_") for n in names)
+
+
+def test_maybe_profile_noop_and_trace(tmp_path):
+    with maybe_profile(None):
+        pass
+    with maybe_profile(str(tmp_path / "trace")):
+        Solver(get_game("subtract:total=5,moves=1-2")).solve()
+    assert any((tmp_path / "trace").iterdir())
+
+
+def test_cli_builtin_game(capsys):
+    rc = cli_main(["tictactoe"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "value: TIE" in out
+    assert "remoteness: 9" in out
+    assert "positions: 5478" in out
+
+
+def test_cli_sharded(capsys):
+    rc = cli_main(["subtract:total=10,moves=1-2", "--devices", "4"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "value: WIN" in out
+
+
+def test_cli_compat_module(capsys):
+    rc = cli_main([str(REF_GAMES / "tictactoe.py")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "value: TIE" in out
+    assert "remoteness: 9" in out
+
+
+def test_checkpoint_resume_skips_recompute(tmp_path):
+    """Restart-from-level: a resumed solve must not re-expand or re-resolve."""
+    d = str(tmp_path / "resume")
+    first = Solver(get_game("tictactoe"), checkpointer=LevelCheckpointer(d)).solve()
+    resumed_solver = Solver(
+        get_game("tictactoe"), checkpointer=LevelCheckpointer(d)
+    )
+    # Poison the compute paths: resume must never touch them.
+    resumed_solver._expand_jit = None
+    resumed_solver._resolve_jit = None
+    resumed = resumed_solver.solve()
+    assert resumed.value == first.value
+    assert resumed.remoteness == first.remoteness
+    assert resumed.num_positions == first.num_positions
+
+
+def test_checkpoint_resume_sharded(tmp_path):
+    import jax
+
+    if len(jax.devices()) < 4:
+        import pytest
+
+        pytest.skip("needs 4 fake devices")
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+
+    d = str(tmp_path / "resume_sharded")
+    first = ShardedSolver(
+        get_game("nim:heaps=3-4-5"), num_shards=4,
+        checkpointer=LevelCheckpointer(d),
+    ).solve()
+    resumed = ShardedSolver(
+        get_game("nim:heaps=3-4-5"), num_shards=4,
+        checkpointer=LevelCheckpointer(d),
+    )
+    resumed._forward_cache = None  # poison: resume must not recompile/run
+    resumed._backward_cache = None
+    result = resumed.solve()
+    assert (result.value, result.remoteness) == (first.value, first.remoteness)
+
+
+def test_paranoid_catches_zero_move_undecided():
+    """A non-primitive position with no legal moves must trip --paranoid."""
+    import pytest
+
+    from gamesmanmpi_tpu.games.subtract import Subtract
+    from gamesmanmpi_tpu.core.values import UNDECIDED
+    import jax.numpy as jnp
+
+    class BrokenGame(Subtract):
+        # primitive() never fires, so position 0 is UNDECIDED with no moves.
+        def primitive(self, states):
+            return jnp.full(states.shape, UNDECIDED, dtype=jnp.uint8)
+
+    from gamesmanmpi_tpu.solve.engine import SolverError
+
+    with pytest.raises(SolverError, match="consistency"):
+        Solver(BrokenGame(total=4, moves=(1, 2)), paranoid=True).solve()
+
+
+def test_tensorized_module_requires_max_moves():
+    import pytest
+
+    from gamesmanmpi_tpu.compat import TensorizedModule, load_game_module
+
+    module = load_game_module(REF_GAMES / "ten_to_zero.py")
+    with pytest.raises(ValueError, match="max_moves"):
+        TensorizedModule(module, level_fn=lambda p: 10 - p)
+
+
+def test_cli_compat_warns_on_unsupported_flags(tmp_path, capsys):
+    rc = cli_main(
+        [
+            str(REF_GAMES / "ten_to_zero.py"),
+            "--devices",
+            "4",
+            "--table-out",
+            str(tmp_path / "t.npz"),
+            "--jsonl",
+            str(tmp_path / "m.jsonl"),
+        ]
+    )
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "not supported on the compat" in captured.err
+    assert (tmp_path / "t.npz").exists()
+    assert "done" in (tmp_path / "m.jsonl").read_text()
