@@ -24,6 +24,12 @@ import time
 
 
 def main() -> int:
+    from gamesmanmpi_tpu.utils.platform import apply_platform_env
+
+    # Honor GAMESMAN_PLATFORM=cpu when the TPU tunnel is unavailable (the
+    # driver leaves it unset, so real runs stay on the accelerator).
+    apply_platform_env()
+
     import gamesmanmpi_tpu  # noqa: F401  (enables x64 before first trace)
     import jax
 

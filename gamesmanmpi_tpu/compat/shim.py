@@ -60,6 +60,8 @@ def solve_module(module):
 class TensorizedModule(TensorGame):
     """A scalar 4-function module lifted onto the batched TensorGame API."""
 
+    _instance_counter = 0
+
     def __init__(
         self,
         module,
@@ -78,6 +80,14 @@ class TensorizedModule(TensorGame):
         self._gen, self._do, self._prim = gen, do, prim
         self._initial = np.uint64(initial)
         self.name = f"compat_{getattr(module, '__name__', 'module')}"
+        # Unlike built-in games, `name` does not encode this wrapper's full
+        # identity (two modules can share a file stem; max_moves/level_fn are
+        # caller-supplied), so the base cache_key contract — equal key =>
+        # identical kernels — would not hold and the engine's kernel cache
+        # could reuse another module's host callbacks. A per-instance token
+        # disables cross-instance sharing.
+        TensorizedModule._instance_counter += 1
+        self._cache_token = TensorizedModule._instance_counter
         level_fn = level_fn or getattr(module, "level_of", None)
         if level_fn is None:
             raise ValueError(
@@ -99,6 +109,10 @@ class TensorizedModule(TensorGame):
             max_level_jump or getattr(module, "max_level_jump", 1)
         )
         self.num_levels = int(num_levels or getattr(module, "num_levels", 1 << 20))
+
+    @property
+    def cache_key(self):
+        return (type(self).__qualname__, self.name, self._cache_token)
 
     def initial_state(self) -> np.uint64:
         return self._initial

@@ -1,18 +1,47 @@
-"""uint64 bit primitives shared by state codecs and games.
+"""Bit primitives shared by state codecs and games.
 
-All positions in this framework are bit-packed uint64 scalars (SURVEY.md §7:
-"bit-packed state codecs"); these helpers are the common vocabulary.
+All positions in this framework are bit-packed unsigned scalars (SURVEY.md §7:
+"bit-packed state codecs") — uint32 when the game's state fits in 31 bits,
+uint64 otherwise. The narrow dtype matters on TPU: v5e has no native 64-bit
+lanes, so uint64 sorts/compares are emulated at roughly half throughput (and
+compile to much larger programs); every game declares its width and the
+engine picks the narrowest dtype (games/base.py `state_dtype`).
 """
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-# Padding sentinel for frontiers/tables: sorts after every real state, so
-# sorted arrays keep their sentinel tail and searchsorted stays correct.
-SENTINEL = np.uint64(0xFFFF_FFFF_FFFF_FFFF)
+# Padding sentinel for frontiers/tables: all-ones sorts after every real
+# state, so sorted arrays keep their sentinel tail and searchsorted stays
+# correct. Games guarantee the all-ones pattern is never a reachable state
+# (state_bits <= 31 for uint32 / <= 63 for uint64).
+SENTINEL64 = np.uint64(0xFFFF_FFFF_FFFF_FFFF)
+SENTINEL32 = np.uint32(0xFFFF_FFFF)
+
+# Back-compat alias (pre-dtype code paths; uint64 default).
+SENTINEL = SENTINEL64
 
 U64_ONE = np.uint64(1)
+
+
+def sentinel_for(dtype) -> np.number:
+    """The all-ones sentinel of a state dtype (uint32 or uint64)."""
+    dtype = np.dtype(dtype)
+    if dtype == np.uint64:
+        return SENTINEL64
+    if dtype == np.uint32:
+        return SENTINEL32
+    raise TypeError(f"unsupported state dtype {dtype}")
+
+
+def state_dtype_for(bits: int):
+    """Narrowest supported state dtype for a game of `bits` state bits."""
+    if bits <= 31:
+        return np.uint32
+    if bits <= 63:
+        return np.uint64
+    raise ValueError(f"state does not fit 63 bits: {bits}")
 
 
 def u64(x) -> jnp.ndarray:
@@ -20,12 +49,23 @@ def u64(x) -> jnp.ndarray:
     return jnp.asarray(x, dtype=jnp.uint64)
 
 
+def popcount(x):
+    """Population count of an unsigned integer array (any width)."""
+    return jax.lax.population_count(x).astype(jnp.int32)
+
+
+def msb_index(x):
+    """Index of the most-significant set bit of x (x must be nonzero)."""
+    x = jnp.asarray(x)
+    width = np.dtype(x.dtype).itemsize * 8
+    return (width - 1) - jax.lax.clz(x).astype(jnp.int32)
+
+
 def popcount64(x):
-    """Population count of a uint64 array."""
-    return jax.lax.population_count(jnp.asarray(x, jnp.uint64)).astype(jnp.int32)
+    """Population count of a uint64 array (back-compat wrapper)."""
+    return popcount(jnp.asarray(x, jnp.uint64))
 
 
 def msb_index64(x):
-    """Index of the most-significant set bit of x (x must be nonzero)."""
-    clz = jax.lax.clz(jnp.asarray(x, jnp.uint64)).astype(jnp.int32)
-    return 63 - clz
+    """MSB index of a uint64 array (back-compat wrapper)."""
+    return msb_index(jnp.asarray(x, jnp.uint64))

@@ -33,9 +33,11 @@ import abc
 
 import numpy as np
 
+from gamesmanmpi_tpu.core.bitops import sentinel_for, state_dtype_for
+
 
 class TensorGame(abc.ABC):
-    """A two-player abstract game over batches of packed uint64 states."""
+    """A two-player abstract game over batches of packed unsigned states."""
 
     #: short name used by the registry / CLI
     name: str = "game"
@@ -45,9 +47,42 @@ class TensorGame(abc.ABC):
     num_levels: int
     #: max of level_of(child) - level_of(parent) over all moves
     max_level_jump: int = 1
+    #: number of bits a packed state occupies. Games that fit 31 bits run in
+    #: uint32 (v5e TPUs emulate 64-bit; narrow states sort ~2x faster and
+    #: compile much smaller programs); wider games run in uint64. The bound is
+    #: strict (31/63, not 32/64) so the all-ones SENTINEL can never collide
+    #: with a real state.
+    state_bits: int = 63
+    #: True when *every* move advances level_of by exactly 1 (tic-tac-toe,
+    #: connect4: level == stones placed). Engines then take the device-resident
+    #: fast path: each level's children all land in level k+1, so frontiers
+    #: chain on-device with no host-side pool merging.
+    uniform_level_jump: bool = False
+
+    @property
+    def state_dtype(self):
+        """Narrowest numpy dtype holding a packed state (uint32/uint64)."""
+        return state_dtype_for(self.state_bits)
+
+    @property
+    def sentinel(self):
+        """The padding sentinel in this game's state dtype."""
+        return sentinel_for(self.state_dtype)
+
+    @property
+    def cache_key(self):
+        """Hashable identity for compiled-kernel caching.
+
+        Two game instances with equal cache_key must trace to identical
+        kernels; the engines key their module-level jit caches on this, so
+        re-instantiated solvers (benchmark repeats, CLI reruns in-process)
+        reuse XLA executables instead of recompiling. Parametrized built-ins
+        encode every parameter in `name`; override if that ever stops holding.
+        """
+        return (type(self).__qualname__, self.name, self.state_bits)
 
     @abc.abstractmethod
-    def initial_state(self) -> np.uint64:
+    def initial_state(self):
         """The packed initial position (reference: `initial_position`)."""
 
     @abc.abstractmethod

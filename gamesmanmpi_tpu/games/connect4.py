@@ -1,16 +1,18 @@
 """Connect-N on a w x h board, column-drop rules (reference games/win4.py-style;
 BASELINE configs #3-4 and the 6x7 north star).
 
-State encoding (uint64): column c occupies bits [c*(h+1), c*(h+1)+h] — h cell
-bits plus one guard position. Within a column, the stones of the *player to
-move* are set bits below the guard; the guard is a single 1 at bit `height`
-(number of stones in the column). The guard is therefore always the column's
+State encoding: column c occupies bits [c*(h+1), c*(h+1)+h] — h cell bits plus
+one guard position. Within a column, the stones of the *player to move* are
+set bits below the guard; the guard is a single 1 at bit `height` (number of
+stones in the column). The guard is therefore always the column's
 most-significant set bit, which makes the encoding self-delimiting: height,
 filled-cell mask and both players' stones are all recoverable with clz/mask
 arithmetic, no side tables. An empty column is 0b1; the whole encoding fits
-(h+1)*w <= 63 bits — 49 bits for the 7x6 north star. This is the column-wise
-perfect encoding SURVEY.md §7 calls for ("Hashing/indexing 4.5e12 C4 states:
-perfect column-wise encoding").
+(h+1)*w <= 63 bits — 49 bits for the 7x6 north star — and runs in uint32 when
+(h+1)*w <= 31 (boards up to 5x5 / 7x3), which matters on v5e TPUs where
+64-bit lanes are emulated. This is the column-wise perfect encoding SURVEY.md
+§7 calls for ("Hashing/indexing 4.5e12 C4 states: perfect column-wise
+encoding").
 
 A move in column c is branch-free: with g the column's guard bit,
     child = opponent_stones | (guards + g)
@@ -28,12 +30,14 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
-from gamesmanmpi_tpu.core.bitops import popcount64, msb_index64
+from gamesmanmpi_tpu.core.bitops import popcount, msb_index
 from gamesmanmpi_tpu.core.values import LOSE, TIE, UNDECIDED
 from gamesmanmpi_tpu.games.base import TensorGame
 
 
 class Connect4(TensorGame):
+    uniform_level_jump = True  # every move drops exactly one stone
+
     def __init__(self, width: int = 7, height: int = 6, connect: int = 4):
         if (height + 1) * width > 63:
             raise ValueError("board too large for uint64 packing")
@@ -42,31 +46,35 @@ class Connect4(TensorGame):
         self.max_moves = width
         self.num_levels = width * height + 1
         self.max_level_jump = 1
+        self.state_bits = (height + 1) * width
+        dt = self.state_dtype
         h1 = height + 1
         self._col_masks = np.array(
-            [((1 << h1) - 1) << (c * h1) for c in range(width)], dtype=np.uint64
+            [((1 << h1) - 1) << (c * h1) for c in range(width)], dtype=dt
         )
         self._top_bits = np.array(
-            [1 << (c * h1 + height) for c in range(width)], dtype=np.uint64
+            [1 << (c * h1 + height) for c in range(width)], dtype=dt
         )
-        self._full_mask = np.uint64(
+        self._full_mask = dt(
             sum(((1 << height) - 1) << (c * h1) for c in range(width))
         )
-        self._bottom_mask = np.uint64(sum(1 << (c * h1) for c in range(width)))
+        self._bottom_mask = dt(sum(1 << (c * h1) for c in range(width)))
+        self._one = dt(1)
         # {vertical, diag down, horizontal, diag up} strides.
-        self._dirs = (1, height, h1, height + 2)
+        self._dirs = tuple(dt(d) for d in (1, height, h1, height + 2))
 
-    def initial_state(self) -> np.uint64:
+    def initial_state(self):
         return self._bottom_mask
 
     def _decompose(self, states):
         """-> (guards, filled, current, opponent) bitboards for a [B] batch."""
-        guards = jnp.zeros(states.shape, dtype=jnp.uint64)
-        filled = jnp.zeros(states.shape, dtype=jnp.uint64)
-        one = np.uint64(1)
+        dt = self.state_dtype
+        guards = jnp.zeros(states.shape, dtype=dt)
+        filled = jnp.zeros(states.shape, dtype=dt)
+        one = self._one
         for c in range(self.width):
             colv = states & self._col_masks[c]
-            g = one << msb_index64(colv | one).astype(jnp.uint64)
+            g = one << msb_index(colv | one).astype(dt)
             guards = guards | g
             filled = filled | ((g - one) & self._col_masks[c])
         current = states ^ guards
@@ -88,7 +96,7 @@ class Connect4(TensorGame):
         for d in self._dirs:
             x = stones
             for i in range(1, self.connect):
-                x = x & (stones >> np.uint64(d * i))
+                x = x & (stones >> (d * self.state_dtype(i)))
             won = won | (x != 0)
         return won
 
@@ -102,7 +110,7 @@ class Connect4(TensorGame):
 
     def level_of(self, states):
         _, filled, _, _ = self._decompose(states)
-        return popcount64(filled)
+        return popcount(filled)
 
     def describe(self, state) -> str:
         s = int(state)

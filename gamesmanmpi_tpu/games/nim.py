@@ -6,9 +6,9 @@ player WIN iff the XOR of heap sizes is nonzero).
 
 State layout: heap i occupies `bits` bits starting at i*bits, where `bits` is
 sized to hold the largest initial heap. A move removes 1..heap[i] objects from
-one heap; with packed heaps that is plain uint64 subtraction at the heap's
-offset. Terminal: all heaps empty — LOSE for the player to move in normal
-play, WIN in misère.
+one heap; with packed heaps that is plain unsigned subtraction at the heap's
+offset (uint32 when the packing fits 31 bits, else uint64). Terminal: all
+heaps empty — LOSE for the player to move in normal play, WIN in misère.
 """
 
 from __future__ import annotations
@@ -27,7 +27,7 @@ class Nim(TensorGame):
             raise ValueError("heaps must be non-negative")
         self.misere = misere
         self.bits = max(max(self.heaps), 1).bit_length()
-        if self.bits * len(self.heaps) > 64:
+        if self.bits * len(self.heaps) > 63:
             raise ValueError("heaps too large for uint64 packing")
         suffix = "m" if misere else ""
         self.name = f"nim_{'-'.join(map(str, self.heaps))}{suffix}"
@@ -38,23 +38,25 @@ class Nim(TensorGame):
         self.max_moves = max(len(self._move_list), 1)
         self.num_levels = sum(self.heaps) + 1
         self.max_level_jump = max(max(self.heaps), 1)
-        self._heap_mask = np.uint64((1 << self.bits) - 1)
+        self.state_bits = self.bits * len(self.heaps)
+        self._heap_mask = self.state_dtype((1 << self.bits) - 1)
 
-    def initial_state(self) -> np.uint64:
+    def initial_state(self):
         s = 0
         for i, h in enumerate(self.heaps):
             s |= h << (i * self.bits)
-        return np.uint64(s)
+        return self.state_dtype(s)
 
     def _heap(self, states, i: int):
-        return (states >> np.uint64(i * self.bits)) & self._heap_mask
+        return (states >> self.state_dtype(i * self.bits)) & self._heap_mask
 
     def expand(self, states):
+        dt = self.state_dtype
         children = []
         masks = []
         for i, t in self._move_list:
-            amt = np.uint64(t << (i * self.bits))
-            masks.append(self._heap(states, i) >= np.uint64(t))
+            amt = dt(t << (i * self.bits))
+            masks.append(self._heap(states, i) >= dt(t))
             children.append(states - amt)
         return jnp.stack(children, axis=-1), jnp.stack(masks, axis=-1)
 

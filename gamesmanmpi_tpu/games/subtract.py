@@ -31,16 +31,18 @@ class Subtract(TensorGame):
         self.max_moves = len(self.moves)
         self.num_levels = self.total + 1
         self.max_level_jump = self.moves[-1]
+        self.state_bits = max(int(self.total).bit_length(), 1)
         self._terminal_value = np.uint8(WIN if misere else LOSE)
 
-    def initial_state(self) -> np.uint64:
-        return np.uint64(self.total)
+    def initial_state(self):
+        return self.state_dtype(self.total)
 
     def expand(self, states):
+        dt = self.state_dtype
         children = []
         masks = []
         for mv in self.moves:
-            amt = np.uint64(mv)
+            amt = dt(mv)
             masks.append(states >= amt)
             children.append(states - amt)
         return jnp.stack(children, axis=-1), jnp.stack(masks, axis=-1)
@@ -49,4 +51,4 @@ class Subtract(TensorGame):
         return jnp.where(states == 0, self._terminal_value, jnp.uint8(UNDECIDED))
 
     def level_of(self, states):
-        return (np.uint64(self.total) - states).astype(jnp.int32)
+        return (self.state_dtype(self.total) - states).astype(jnp.int32)

@@ -4,10 +4,11 @@ Reference counterpart: games/tictactoe.py — board packed as an int, 4-function
 scalar API (SURVEY.md §2.2). Same packing here, tensorized: an m x n board with
 k-in-a-row to win, X moving first.
 
-State layout (uint64): bits [0, m*n) are X's stones, bits [m*n, 2*m*n) are O's
-stones, cell index = row * n + col. Player to move: X iff popcount(X plane) ==
-popcount(O plane). The scalar twin in examples/ref_games/tictactoe.py uses the
-identical layout, which is what makes full-table oracle parity tests possible.
+State layout: bits [0, m*n) are X's stones, bits [m*n, 2*m*n) are O's stones,
+cell index = row * n + col; packed in uint32 when 2*m*n <= 31 (the 3x3 board),
+uint64 otherwise. Player to move: X iff popcount(X plane) == popcount(O plane).
+The scalar twin in examples/ref_games/tictactoe.py uses the identical layout,
+which is what makes full-table oracle parity tests possible.
 
 Primitive semantics (perspective of player to move): if the *last mover* has k
 in a row the mover has lost -> LOSE; else a full board is TIE; else UNDECIDED.
@@ -18,12 +19,12 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
-from gamesmanmpi_tpu.core.bitops import popcount64
+from gamesmanmpi_tpu.core.bitops import popcount
 from gamesmanmpi_tpu.core.values import LOSE, TIE, UNDECIDED
 from gamesmanmpi_tpu.games.base import TensorGame
 
 
-def _win_lines(m: int, n: int, k: int) -> np.ndarray:
+def _win_lines(m: int, n: int, k: int, dtype) -> np.ndarray:
     """All k-in-a-row masks on the X bit-plane (bits 0..m*n)."""
     lines = []
     cells = [[r * n + c for c in range(n)] for r in range(m)]
@@ -36,12 +37,14 @@ def _win_lines(m: int, n: int, k: int) -> np.ndarray:
                     for i in range(k):
                         mask |= 1 << cells[r + dr * i][c + dc * i]
                     lines.append(mask)
-    return np.array(sorted(set(lines)), dtype=np.uint64)
+    return np.array(sorted(set(lines)), dtype=dtype)
 
 
 class TicTacToe(TensorGame):
+    uniform_level_jump = True  # every move places exactly one stone
+
     def __init__(self, m: int = 3, n: int = 3, k: int = 3):
-        if 2 * m * n > 64:
+        if 2 * m * n > 63:
             raise ValueError("board too large for uint64 packing")
         self.m, self.n, self.k = m, n, k
         self.cells = m * n
@@ -49,32 +52,37 @@ class TicTacToe(TensorGame):
         self.max_moves = self.cells
         self.num_levels = self.cells + 1
         self.max_level_jump = 1
-        self._lines = jnp.asarray(_win_lines(m, n, k))
-        self._plane_mask = np.uint64((1 << self.cells) - 1)
-        self._full = np.uint64((1 << self.cells) - 1)
+        self.state_bits = 2 * self.cells
+        dt = self.state_dtype
+        self._lines = _win_lines(m, n, k, dt)
+        self._plane_mask = dt((1 << self.cells) - 1)
+        self._full = dt((1 << self.cells) - 1)
+        self._cells_shift = dt(self.cells)
+        self._bits = np.array([1 << i for i in range(self.cells)], dtype=dt)
 
-    def initial_state(self) -> np.uint64:
-        return np.uint64(0)
+    def initial_state(self):
+        return self.state_dtype(0)
 
     def _planes(self, states):
         x = states & self._plane_mask
-        o = (states >> np.uint64(self.cells)) & self._plane_mask
+        o = (states >> self._cells_shift) & self._plane_mask
         return x, o
 
     def _x_to_move(self, states):
         x, o = self._planes(states)
-        return popcount64(x) == popcount64(o)
+        return popcount(x) == popcount(o)
 
     def expand(self, states):
         x, o = self._planes(states)
         occupied = x | o
         x_to_move = self._x_to_move(states)
         # The mover's stone lands at cell i on their own plane.
-        shift = jnp.where(x_to_move, 0, self.cells).astype(jnp.uint64)
+        zero = self.state_dtype(0)
+        shift = jnp.where(x_to_move, zero, self._cells_shift)
         children = []
         masks = []
         for i in range(self.cells):
-            bit = np.uint64(1 << i)
+            bit = self._bits[i]
             empty = (occupied & bit) == 0
             child = states | (bit << shift)
             children.append(child)
@@ -95,7 +103,7 @@ class TicTacToe(TensorGame):
         )
 
     def level_of(self, states):
-        return popcount64(states)
+        return popcount(states)
 
     def describe(self, state) -> str:
         s = int(state)

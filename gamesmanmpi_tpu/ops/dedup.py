@@ -9,19 +9,20 @@ static-shape O(n log n) pattern XLA maps well (SURVEY.md §7 "Dedup at scale").
 
 import jax.numpy as jnp
 
-from gamesmanmpi_tpu.core.bitops import SENTINEL
+from gamesmanmpi_tpu.core.bitops import sentinel_for
 
 
 def sort_unique(states):
     """Sort states, replace duplicates with SENTINEL, resort, count uniques.
 
-    Input: [N] uint64 (may contain SENTINEL padding).
+    Input: [N] uint32/uint64 (may contain SENTINEL padding of the same dtype).
     Returns (sorted_unique [N] with all uniques first then SENTINEL tail,
              count of unique non-sentinel entries, int32).
     """
+    sentinel = sentinel_for(states.dtype)
     s = jnp.sort(states)
     dup = jnp.concatenate([jnp.zeros((1,), bool), s[1:] == s[:-1]])
-    s = jnp.where(dup, SENTINEL, s)
+    s = jnp.where(dup, sentinel, s)
     s = jnp.sort(s)
-    count = jnp.sum(s != SENTINEL).astype(jnp.int32)
+    count = jnp.sum(s != sentinel).astype(jnp.int32)
     return s, count

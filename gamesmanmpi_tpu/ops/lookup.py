@@ -2,27 +2,29 @@
 
 Reference counterpart: `pos in resolved` dict probes plus the SEND_BACK
 round-trip to the owner rank (src/process.py LOOK_UP path, SURVEY.md §3.2-3.3).
-Here solved levels are sorted uint64 arrays with SENTINEL tails, and a whole
-frontier's child queries become one vectorized binary search (searchsorted +
-gather) per level of the lookup window — no messages, no dict.
+Here solved levels are sorted uint32/uint64 arrays with SENTINEL tails, and a
+whole frontier's child queries become one vectorized binary search
+(searchsorted + gather) per level of the lookup window — no messages, no dict.
 """
 
 import jax.numpy as jnp
 
-from gamesmanmpi_tpu.core.bitops import SENTINEL
+from gamesmanmpi_tpu.core.bitops import sentinel_for
 from gamesmanmpi_tpu.core.values import UNDECIDED
 
 
 def lookup_sorted(keys, table_states, table_values, table_remoteness):
     """Look keys up in one sorted solved level.
 
-    keys: [K] uint64 (SENTINEL entries allowed; they miss).
-    table_states: [N] sorted uint64 with SENTINEL tail.
-    Returns (values [K] uint8 — UNDECIDED on miss, remoteness [K] int32, hit [K] bool).
+    keys: [K] unsigned (SENTINEL entries allowed; they miss).
+    table_states: [N] sorted, same dtype as keys, SENTINEL tail.
+    Returns (values [K] uint8 — UNDECIDED on miss, remoteness [K] int32,
+    hit [K] bool).
     """
+    sentinel = sentinel_for(keys.dtype)
     idx = jnp.searchsorted(table_states, keys)
     idx = jnp.clip(idx, 0, table_states.shape[0] - 1)
-    hit = (table_states[idx] == keys) & (keys != SENTINEL)
+    hit = (table_states[idx] == keys) & (keys != sentinel)
     values = jnp.where(hit, table_values[idx], jnp.uint8(UNDECIDED))
     remoteness = jnp.where(hit, table_remoteness[idx], 0)
     return values, remoteness, hit

@@ -2,13 +2,17 @@
 
 XLA compiles one program per shape; per-level frontier sizes vary wildly
 (SURVEY.md §7 "Dynamic frontier vs static shapes"). We round every frontier up
-to a power-of-two bucket and pad with SENTINEL, so the whole solve reuses a
-small, bounded set of compiled programs regardless of level sizes.
+to a power-of-two bucket and pad with the dtype's SENTINEL, so the whole solve
+reuses a small, bounded set of compiled programs regardless of level sizes.
+This matters double in environments where XLA compilation is remote/expensive:
+every distinct shape is a compile, so the engines also keep capacities
+monotone across levels (solve/engine.py) to bound the shape count by
+log2(max frontier), not by level count.
 """
 
 import numpy as np
 
-from gamesmanmpi_tpu.core.bitops import SENTINEL
+from gamesmanmpi_tpu.core.bitops import sentinel_for
 
 # Smallest bucket: keeps tiny levels from generating many near-empty programs.
 MIN_BUCKET = 256
@@ -20,9 +24,20 @@ def bucket_size(n: int, minimum: int = MIN_BUCKET) -> int:
 
 
 def pad_to_bucket(states: np.ndarray, minimum: int = MIN_BUCKET) -> np.ndarray:
-    """Pad a 1-D uint64 host array to its bucket size with SENTINEL."""
-    states = np.asarray(states, dtype=np.uint64)
+    """Pad a 1-D unsigned host array to its bucket size with SENTINEL.
+
+    The dtype (and therefore the sentinel) is taken from the input array.
+    """
+    states = np.asarray(states)
     cap = bucket_size(states.shape[0], minimum)
-    out = np.full(cap, SENTINEL, dtype=np.uint64)
+    out = np.full(cap, sentinel_for(states.dtype), dtype=states.dtype)
+    out[: states.shape[0]] = states
+    return out
+
+
+def pad_to(states: np.ndarray, cap: int) -> np.ndarray:
+    """Pad a 1-D unsigned host array to exactly `cap` with SENTINEL."""
+    states = np.asarray(states)
+    out = np.full(cap, sentinel_for(states.dtype), dtype=states.dtype)
     out[: states.shape[0]] = states
     return out

@@ -9,8 +9,8 @@ The Job types map as follows:
   -------------------------------  -------------------------------------------
   LOOK_UP / DISTRIBUTE             forward pass: expand a whole level's
                                    frontier in one vmapped kernel; children are
-                                   dedup'd (sort-unique) and merged into their
-                                   level's pool instead of being mailed to
+                                   dedup'd (sort-unique) and become the next
+                                   level's frontier instead of being mailed to
                                    owner ranks one Job at a time.
   CHECK_FOR_UPDATES                gone — no polling; the level barrier is the
                                    only synchronization.
@@ -25,14 +25,30 @@ small-message actors are anti-idiomatic on TPU); observable behavior — the
 (value, remoteness) of every reachable position — is preserved and tested
 against a pure-Python oracle.
 
-The forward/backward orchestration is a host loop (level count is tiny — tens
-of iterations); all per-position work runs inside jitted kernels with bucketed
-static shapes (ops.padding), so the set of compiled programs is small and
-reused across levels.
+Two execution paths share the kernels:
+
+* **Fast path** (games with `uniform_level_jump`, i.e. every move advances the
+  level by exactly 1 — tic-tac-toe, connect4): fully device-resident. The
+  frontier chains on-device level to level (the next frontier is a static
+  slice of the dedup output), and the backward window is exactly the
+  previously-resolved level, which is already on-device. Host work per level
+  is one scalar sync (the unique-count) plus the result-table download.
+* **Generic path** (multi-jump games — subtraction games, Nim): children span
+  multiple levels, so per-level pools are merged on host and the lookup
+  window covers `max_level_jump` deeper levels.
+
+Compiled-program economy: XLA compiles one program per shape, and in this
+project's environments compilation can be remote and cost tens of seconds per
+shape, while dispatch is cheap. All kernels are therefore cached at module
+level keyed on (game.cache_key, kind, shapes) — re-instantiated Solvers
+(benchmark repeats, CLI reruns) reuse executables — and frontier capacities
+are power-of-two buckets so the shape count is O(log max-frontier), not
+O(levels).
 """
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, NamedTuple, Optional
 
@@ -40,19 +56,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from gamesmanmpi_tpu.core.bitops import SENTINEL
 from gamesmanmpi_tpu.core.values import UNDECIDED
 from gamesmanmpi_tpu.games.base import TensorGame
 from gamesmanmpi_tpu.ops.combine import combine_children
 from gamesmanmpi_tpu.ops.dedup import sort_unique
 from gamesmanmpi_tpu.ops.lookup import lookup_window
-from gamesmanmpi_tpu.ops.padding import MIN_BUCKET, pad_to_bucket
+from gamesmanmpi_tpu.ops.padding import MIN_BUCKET, bucket_size, pad_to, pad_to_bucket
 
 
 class LevelTable(NamedTuple):
     """Solved records for one level: parallel arrays sorted by state."""
 
-    states: np.ndarray  # uint64, sorted ascending
+    states: np.ndarray  # game.state_dtype, sorted ascending
     values: np.ndarray  # uint8
     remoteness: np.ndarray  # int32
 
@@ -74,20 +89,99 @@ class SolveResult:
 
     def lookup(self, state) -> tuple[int, int]:
         """(value, remoteness) of any reachable packed state."""
-        state = np.uint64(state)
+        state = self.game.state_dtype(state)
         level = int(
-            np.asarray(self.game.level_of(jnp.asarray([state], jnp.uint64)))[0]
+            np.asarray(self.game.level_of(jnp.asarray([state])))[0]
         )
         table = self.levels.get(level)
         if table is not None:
             i = np.searchsorted(table.states, state)
             if i < table.states.shape[0] and table.states[i] == state:
                 return int(table.values[i]), int(table.remoteness[i])
-        raise KeyError(f"state {state:#x} not reachable/solved")
+        raise KeyError(f"state {int(state):#x} not reachable/solved")
 
 
 class SolverError(RuntimeError):
     pass
+
+
+# ---------------------------------------------------------------------------
+# Module-level kernel cache: (game.cache_key, kind, *shape info) -> jitted fn.
+# Lives for the process so repeated Solver instances (bench repeats, parity
+# tests, CLI reruns) never recompile. Bounded in practice: a handful of kinds
+# x O(log max-frontier) capacities per game. Builders receive the game and
+# must close over nothing else (a cached kernel outlives the Solver that
+# first built it).
+_KERNELS: dict = {}
+
+
+def get_kernel(game: TensorGame, kind: str, shape_key, builder):
+    key = (game.cache_key, kind, shape_key)
+    fn = _KERNELS.get(key)
+    if fn is None:
+        fn = _KERNELS[key] = jax.jit(builder(game))
+    return fn
+
+
+def expand_core(game: TensorGame, states):
+    """Shared expand+mask+dedup: [B] -> (uniq [B*M] sorted, count)."""
+    valid = states != game.sentinel
+    prim = game.primitive(states)
+    expandable = valid & (prim == UNDECIDED)
+    children, mask = game.expand(states)
+    mask = mask & expandable[:, None]
+    children = jnp.where(mask, children, game.sentinel)
+    return sort_unique(children.reshape(-1))
+
+
+def expand_with_levels(game: TensorGame, states):
+    """Generic-path forward: expand_core + each child's topological level."""
+    uniq, count = expand_core(game, states)
+    levels = jnp.where(uniq != game.sentinel, game.level_of(uniq), -1)
+    return uniq, levels, count
+
+
+def resolve_level(game: TensorGame, states, window):
+    """[B] states + solved deeper levels -> (values, remoteness, misses)."""
+    valid = states != game.sentinel
+    prim = game.primitive(states)
+    undecided = valid & (prim == UNDECIDED)
+    children, mask = game.expand(states)
+    mask = mask & undecided[:, None]
+    children = jnp.where(mask, children, game.sentinel)
+    child_vals, child_rem, hit = lookup_window(children, window)
+    values, remoteness = combine_children(child_vals, child_rem, mask)
+    values = jnp.where(undecided, values, jnp.where(valid, prim, UNDECIDED))
+    remoteness = jnp.where(undecided, remoteness, 0)
+    # Consistency counters (SURVEY.md §5.2): child lookups that missed the
+    # solved window, and non-primitive positions with zero legal moves
+    # (a game-definition error — they would silently score LOSE/0).
+    misses = jnp.sum(mask & ~hit) + jnp.sum(undecided & ~jnp.any(mask, axis=-1))
+    return values, remoteness, misses
+
+
+# Device-resident level store budget for the fast path (bytes of packed
+# states kept on device between the forward and backward phases; levels past
+# the budget are spilled to host and re-uploaded during backward).
+_DEVICE_STORE_BYTES = int(
+    os.environ.get("GAMESMAN_DEVICE_STORE_MB", "2048")
+) * (1 << 20)
+
+
+class _Level:
+    """One discovered level: host states + optionally the device copy."""
+
+    __slots__ = ("n", "host", "dev")
+
+    def __init__(self, n: int, host: Optional[np.ndarray], dev):
+        self.n = n  # real (non-sentinel) count
+        self.host = host  # np [n] sorted, or None if only on device
+        self.dev = dev  # jnp [cap] sorted + sentinel tail, or None
+
+    def host_states(self) -> np.ndarray:
+        if self.host is None:
+            self.host = np.asarray(self.dev[: self.n])
+        return self.host
 
 
 class Solver:
@@ -101,55 +195,186 @@ class Solver:
         paranoid: bool = False,
         logger=None,
         checkpointer=None,
+        force_generic: bool = False,
     ):
         self.game = game
         self.min_bucket = min_bucket
         self.paranoid = paranoid
         self.logger = logger
         self.checkpointer = checkpointer
-        self._expand_jit = jax.jit(self._expand_impl)
-        self._resolve_jit = jax.jit(self._resolve_impl)
+        self.fast = bool(game.uniform_level_jump) and not force_generic
 
     # ---------------------------------------------------------------- kernels
 
     def _expand_impl(self, states):
-        """[B] states -> (unique children [B*M] sorted, their levels, count)."""
-        g = self.game
-        valid = states != SENTINEL
-        prim = g.primitive(states)
-        expandable = valid & (prim == UNDECIDED)
-        children, mask = g.expand(states)
-        mask = mask & expandable[:, None]
-        children = jnp.where(mask, children, SENTINEL)
-        uniq, count = sort_unique(children.reshape(-1))
-        levels = jnp.where(uniq != SENTINEL, g.level_of(uniq), -1)
-        return uniq, levels, count
+        """[B] states -> (unique children, their levels, count).
 
-    def _resolve_impl(self, states, window):
-        """[B] states + solved deeper levels -> (values, remoteness, misses)."""
-        g = self.game
-        valid = states != SENTINEL
-        prim = g.primitive(states)
-        undecided = valid & (prim == UNDECIDED)
-        children, mask = g.expand(states)
-        mask = mask & undecided[:, None]
-        children = jnp.where(mask, children, SENTINEL)
-        child_vals, child_rem, hit = lookup_window(children, window)
-        values, remoteness = combine_children(child_vals, child_rem, mask)
-        values = jnp.where(undecided, values, jnp.where(valid, prim, UNDECIDED))
-        remoteness = jnp.where(undecided, remoteness, 0)
-        # Consistency counters (SURVEY.md §5.2): child lookups that missed the
-        # solved window, and non-primitive positions with zero legal moves
-        # (a game-definition error — they would silently score LOSE/0).
-        misses = jnp.sum(mask & ~hit) + jnp.sum(undecided & ~jnp.any(mask, axis=-1))
-        return values, remoteness, misses
+        Traceable generic-path forward (also the driver compile-check entry).
+        """
+        return expand_with_levels(self.game, states)
 
-    # ----------------------------------------------------------------- phases
+    # Cached kernel getters. Builders close over the game only — a cached
+    # kernel outlives this Solver (see _KERNELS).
 
-    def _forward(self, pools: Dict[int, np.ndarray], start_level: int) -> dict:
-        """Discover all reachable states, grouped into per-level pools."""
+    def _fwd(self, cap: int):
+        """Fast-path forward: states[cap] -> (uniq [cap*M], count)."""
+        return get_kernel(
+            self.game, "fwd", cap,
+            lambda game: lambda states: expand_core(game, states),
+        )
+
+    def _fwd_generic(self, cap: int):
+        return get_kernel(
+            self.game, "fwdg", cap,
+            lambda game: lambda states: expand_with_levels(game, states),
+        )
+
+    def _bwd(self, cap: int, wcaps: tuple):
+        """Backward: states[cap] + window levels -> (values, rem, misses).
+
+        wcaps: tuple of window-level capacities (possibly empty — deepest
+        level, everything primitive).
+        """
+
+        def build(game):
+            def f(states, *window_flat):
+                window = tuple(
+                    (window_flat[i], window_flat[i + 1], window_flat[i + 2])
+                    for i in range(0, len(window_flat), 3)
+                )
+                return resolve_level(game, states, window)
+
+            return f
+
+        return get_kernel(self.game, "bwd", (cap, tuple(wcaps)), build)
+
+    # ------------------------------------------------------------- fast phase
+
+    def _forward_fast(self, init, start_level: int) -> Dict[int, _Level]:
+        """Device-resident forward sweep for uniform_level_jump games."""
         g = self.game
-        stats_levels = {}
+        levels: Dict[int, _Level] = {}
+        frontier = jnp.asarray(
+            pad_to(np.array([init], dtype=g.state_dtype), self.min_bucket)
+        )
+        levels[start_level] = _Level(1, np.array([init], dtype=g.state_dtype),
+                                     frontier)
+        stored_bytes = frontier.nbytes
+        k = start_level
+        while True:
+            t0 = time.perf_counter()
+            cap = frontier.shape[0]
+            uniq, count = self._fwd(cap)(frontier)
+            n = int(count)  # the one host sync per level
+            if n == 0:
+                break
+            next_cap = bucket_size(n, self.min_bucket)
+            if next_cap <= uniq.shape[0]:
+                nxt = jax.lax.slice(uniq, (0,), (next_cap,))
+            else:  # bucket(n) > cap*M: only when M < 2 and the level grew
+                nxt = jnp.asarray(pad_to(np.asarray(uniq), next_cap))
+            rec = _Level(n, None, nxt)
+            if stored_bytes + nxt.nbytes > _DEVICE_STORE_BYTES:
+                # Device-store budget exhausted: keep this level on host only
+                # (backward re-uploads it); the live frontier still chains on
+                # device.
+                rec.host_states()
+                rec.dev = None
+            else:
+                stored_bytes += nxt.nbytes
+            levels[k + 1] = rec
+            frontier = nxt
+            if self.logger is not None:
+                self.logger.log(
+                    {
+                        "phase": "forward",
+                        "level": k,
+                        "frontier": levels[k].n,
+                        "children": n,
+                        "secs": time.perf_counter() - t0,
+                    }
+                )
+            k += 1
+        return levels
+
+    def _backward_fast(self, levels: Dict[int, _Level]) -> Dict[int, LevelTable]:
+        """Deepest-first resolve; the window is the previous (deeper) level."""
+        g = self.game
+        resolved: Dict[int, LevelTable] = {}
+        completed = (
+            set(self.checkpointer.completed_levels())
+            if self.checkpointer is not None
+            else set()
+        )
+        prev = None  # (states_dev, values_dev, rem_dev) of level k+1
+        for k in sorted(levels, reverse=True):
+            t0 = time.perf_counter()
+            rec = levels[k]
+            n = rec.n
+            if rec.dev is not None:
+                states_dev = rec.dev
+            else:
+                states_dev = jnp.asarray(
+                    pad_to(rec.host_states(),
+                           bucket_size(n, self.min_bucket))
+                )
+            cap = states_dev.shape[0]
+            from_checkpoint = k in completed
+            if from_checkpoint:
+                table = self.checkpointer.load_level(k)
+                states_host = rec.host_states()
+                if table.states.shape[0] != n or not (
+                    np.asarray(table.states, dtype=g.state_dtype) == states_host
+                ).all():
+                    raise SolverError(
+                        f"checkpointed level {k} does not match the discovered "
+                        "frontier — stale checkpoint directory?"
+                    )
+                values_dev = jnp.asarray(pad_to_cap_u8(table.values, cap))
+                rem_dev = jnp.asarray(pad_to_cap_i32(table.remoteness, cap))
+            else:
+                if prev is None:
+                    args, wcaps = (), ()
+                else:
+                    args = prev
+                    wcaps = (prev[0].shape[0],)
+                values_dev, rem_dev, misses = self._bwd(cap, wcaps)(
+                    states_dev, *args
+                )
+                if self.paranoid and int(misses) > 0:
+                    raise SolverError(
+                        f"level {k}: {int(misses)} consistency failures (child "
+                        "lookups outside the solved window — level_of/"
+                        "max_level_jump inconsistent — or non-primitive "
+                        "positions with zero legal moves)"
+                    )
+                table = LevelTable(
+                    states=rec.host_states(),
+                    values=np.asarray(values_dev[:n]),
+                    remoteness=np.asarray(rem_dev[:n]),
+                )
+            resolved[k] = table
+            prev = (states_dev, values_dev, rem_dev)
+            rec.dev = None  # release the forward copy
+            if self.logger is not None:
+                self.logger.log(
+                    {
+                        "phase": "backward",
+                        "level": k,
+                        "n": n,
+                        "resumed": from_checkpoint,
+                        "secs": time.perf_counter() - t0,
+                    }
+                )
+            if self.checkpointer is not None and not from_checkpoint:
+                self.checkpointer.save_level(k, table)
+        return resolved
+
+    # ---------------------------------------------------------- generic phase
+
+    def _forward_generic(self, pools: Dict[int, np.ndarray], start_level: int):
+        """Host-pooled forward for multi-jump games (children span levels)."""
+        g = self.game
         k = start_level
         while pools and k <= max(pools):
             if k not in pools:
@@ -158,7 +383,9 @@ class Solver:
             t0 = time.perf_counter()
             frontier = pools[k]
             padded = pad_to_bucket(frontier, self.min_bucket)
-            uniq, levels, count = self._expand_jit(padded)
+            uniq, levels, count = self._fwd_generic(padded.shape[0])(
+                jnp.asarray(padded)
+            )
             n = int(count)
             kids = np.asarray(uniq[:n])
             kid_levels = np.asarray(levels[:n])
@@ -169,21 +396,20 @@ class Solver:
                     pools[lv] = np.union1d(pools[lv], batch)
                 else:
                     pools[lv] = batch
-            dt = time.perf_counter() - t0
-            stats_levels[k] = {
-                "phase": "forward",
-                "level": k,
-                "frontier": int(frontier.shape[0]),
-                "children": n,
-                "secs": dt,
-            }
             if self.logger is not None:
-                self.logger.log(stats_levels[k])
+                self.logger.log(
+                    {
+                        "phase": "forward",
+                        "level": k,
+                        "frontier": int(frontier.shape[0]),
+                        "children": n,
+                        "secs": time.perf_counter() - t0,
+                    }
+                )
             k += 1
-        return stats_levels
 
-    def _backward(self, pools: Dict[int, np.ndarray]) -> Dict[int, LevelTable]:
-        """Resolve all levels deepest-first against the solved window.
+    def _backward_generic(self, pools: Dict[int, np.ndarray]) -> Dict[int, LevelTable]:
+        """Resolve all levels deepest-first against a multi-level window.
 
         Levels already present in the checkpoint (a previous, preempted run)
         are loaded instead of recomputed — restart-from-level recovery.
@@ -204,18 +430,28 @@ class Solver:
             from_checkpoint = k in completed
             if from_checkpoint:
                 table = self.checkpointer.load_level(k)
-                if table.states.shape[0] != n or not (table.states == states).all():
+                if table.states.shape[0] != n or not (
+                    np.asarray(table.states, dtype=g.state_dtype) == states
+                ).all():
                     raise SolverError(
                         f"checkpointed level {k} does not match the discovered "
                         "frontier — stale checkpoint directory?"
                     )
+                values = np.asarray(table.values)
+                remoteness = np.asarray(table.remoteness)
             else:
-                window = tuple(
-                    padded_cache[k + j]
+                window_levels = [
+                    k + j
                     for j in range(1, g.max_level_jump + 1)
                     if (k + j) in padded_cache
-                )
-                values, remoteness, misses = self._resolve_jit(padded, window)
+                ]
+                window_flat = []
+                for L in window_levels:
+                    window_flat.extend(padded_cache[L])
+                wcaps = tuple(padded_cache[L][0].shape[0] for L in window_levels)
+                values_dev, rem_dev, misses = self._bwd(
+                    padded.shape[0], wcaps
+                )(jnp.asarray(padded), *[jnp.asarray(a) for a in window_flat])
                 if self.paranoid and int(misses) > 0:
                     raise SolverError(
                         f"level {k}: {int(misses)} consistency failures (child "
@@ -223,11 +459,10 @@ class Solver:
                         "max_level_jump inconsistent — or non-primitive "
                         "positions with zero legal moves)"
                     )
-                table = LevelTable(
-                    states=states,
-                    values=np.asarray(values[:n]),
-                    remoteness=np.asarray(remoteness[:n]),
-                )
+                values = np.asarray(values_dev[:n])
+                remoteness = np.asarray(rem_dev[:n])
+                table = LevelTable(states=states, values=values,
+                                   remoteness=remoteness)
             resolved[k] = table
             cap = padded.shape[0]
             pv = np.full(cap, UNDECIDED, dtype=np.uint8)
@@ -257,20 +492,43 @@ class Solver:
     def solve(self) -> SolveResult:
         g = self.game
         t0 = time.perf_counter()
-        init = np.uint64(g.initial_state())
+        init = g.state_dtype(g.initial_state())
         start_level = int(np.asarray(g.level_of(jnp.asarray([init])))[0])
-        pools = (
+
+        saved = (
             self.checkpointer.load_frontiers()
             if self.checkpointer is not None
             else None
         )
-        if pools is None:
-            pools = {start_level: np.array([init], np.uint64)}
-            self._forward(pools, start_level)
-            if self.checkpointer is not None:
-                self.checkpointer.save_frontiers(pools)
-        t_forward = time.perf_counter() - t0
-        resolved = self._backward(pools)
+        if self.fast:
+            if saved is not None:
+                levels = {
+                    k: _Level(v.shape[0], np.asarray(v, dtype=g.state_dtype),
+                              None)
+                    for k, v in saved.items()
+                }
+            else:
+                levels = self._forward_fast(init, start_level)
+                if self.checkpointer is not None:
+                    self.checkpointer.save_frontiers(
+                        {k: rec.host_states() for k, rec in levels.items()}
+                    )
+            t_forward = time.perf_counter() - t0
+            resolved = self._backward_fast(levels)
+        else:
+            if saved is not None:
+                pools = {
+                    k: np.asarray(v, dtype=g.state_dtype)
+                    for k, v in saved.items()
+                }
+            else:
+                pools = {start_level: np.array([init], g.state_dtype)}
+                self._forward_generic(pools, start_level)
+                if self.checkpointer is not None:
+                    self.checkpointer.save_frontiers(pools)
+            t_forward = time.perf_counter() - t0
+            resolved = self._backward_generic(pools)
+
         t_total = time.perf_counter() - t0
         root = resolved[start_level]
         i = int(np.searchsorted(root.states, init))
@@ -288,6 +546,18 @@ class Solver:
         if self.logger is not None:
             self.logger.log({"phase": "done", **stats})
         return SolveResult(g, value, remoteness, resolved, stats)
+
+
+def pad_to_cap_u8(a, cap: int) -> np.ndarray:
+    out = np.full(cap, UNDECIDED, dtype=np.uint8)
+    out[: len(a)] = a
+    return out
+
+
+def pad_to_cap_i32(a, cap: int) -> np.ndarray:
+    out = np.zeros(cap, dtype=np.int32)
+    out[: len(a)] = a
+    return out
 
 
 def solve(game: TensorGame, **kwargs) -> SolveResult:

@@ -88,8 +88,12 @@ def test_checkpoint_resume_skips_recompute(tmp_path):
         get_game("tictactoe"), checkpointer=LevelCheckpointer(d)
     )
     # Poison the compute paths: resume must never touch them.
-    resumed_solver._expand_jit = None
-    resumed_solver._resolve_jit = None
+    def _poisoned(*a, **k):
+        raise AssertionError("resume recomputed a level")
+
+    resumed_solver._fwd = _poisoned
+    resumed_solver._fwd_generic = _poisoned
+    resumed_solver._bwd = _poisoned
     resumed = resumed_solver.solve()
     assert resumed.value == first.value
     assert resumed.remoteness == first.remoteness
