@@ -85,9 +85,12 @@ class TensorizedModule(TensorGame):
         # caller-supplied), so the base cache_key contract — equal key =>
         # identical kernels — would not hold and the engine's kernel cache
         # could reuse another module's host callbacks. A per-instance token
-        # disables cross-instance sharing.
+        # plus a per-instance cache dict (engine.get_kernel honors it)
+        # disables cross-instance sharing AND lets the kernels be collected
+        # with this wrapper instead of living in the process-wide cache.
         TensorizedModule._instance_counter += 1
         self._cache_token = TensorizedModule._instance_counter
+        self._private_kernel_cache: dict = {}
         level_fn = level_fn or getattr(module, "level_of", None)
         if level_fn is None:
             raise ValueError(

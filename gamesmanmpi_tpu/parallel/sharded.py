@@ -24,6 +24,11 @@ peer) is detected on host via returned per-destination counts and retried
 with a doubled capacity — the "capacity counters + host-side spill loop
 (rare path)" design of SURVEY.md §5.8.
 
+Like the single-device engine, compiled steps are cached process-wide
+(solve/engine._KERNELS via get_kernel) keyed on game identity, mesh devices
+and shapes, and capacities are power-of-two buckets — re-instantiated solvers
+reuse XLA executables, and the shape count stays O(log max-frontier).
+
 Shard-count invariance (same tables for 1 and N shards) is the test contract
 replacing the reference's `mpirun -np 1` vs `-np N` (SURVEY.md §4.2).
 """
@@ -38,7 +43,6 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from gamesmanmpi_tpu.core.bitops import SENTINEL
 from gamesmanmpi_tpu.core.hashing import owner_shard, owner_shard_np
 from gamesmanmpi_tpu.core.values import UNDECIDED
 from gamesmanmpi_tpu.games.base import TensorGame
@@ -47,16 +51,93 @@ from gamesmanmpi_tpu.ops.dedup import sort_unique
 from gamesmanmpi_tpu.ops.lookup import lookup_window
 from gamesmanmpi_tpu.ops.padding import bucket_size
 from gamesmanmpi_tpu.parallel.mesh import AXIS, make_mesh
-from gamesmanmpi_tpu.solve.engine import LevelTable, SolveResult, SolverError
+from gamesmanmpi_tpu.solve.engine import (
+    LevelTable,
+    SolveResult,
+    SolverError,
+    get_kernel,
+)
 
 
 def _pad_shards(shard_arrays: List[np.ndarray], cap: int) -> np.ndarray:
-    """Stack per-shard 1-D uint64 arrays into [S, cap] with SENTINEL pad."""
+    """Stack per-shard 1-D state arrays into [S, cap] with SENTINEL pad.
+
+    The dtype (and sentinel) follows the input arrays' dtype.
+    """
+    from gamesmanmpi_tpu.core.bitops import sentinel_for
+
     S = len(shard_arrays)
-    out = np.full((S, cap), SENTINEL, dtype=np.uint64)
+    dtype = shard_arrays[0].dtype
+    out = np.full((S, cap), sentinel_for(dtype), dtype=dtype)
     for s, arr in enumerate(shard_arrays):
         out[s, : arr.shape[0]] = arr
     return out
+
+
+def _sharded_forward_step(game: TensorGame, S: int, route_cap: int, local):
+    """Per-shard forward body: expand -> owner-bucket -> all_to_all -> dedup.
+
+    local: [1, cap] this shard's frontier slice (shard_map gives the leading
+    mesh axis). Returns ([1, S*route_cap] unique children, [1] count,
+    [1, S] per-destination send counts for overflow detection).
+    """
+    sentinel = game.sentinel
+    local = local[0]
+    valid = local != sentinel
+    prim = game.primitive(local)
+    children, mask = game.expand(local)
+    mask = mask & (valid & (prim == UNDECIDED))[:, None]
+    flat = jnp.where(mask, children, sentinel).reshape(-1)
+    owner = jnp.where(flat == sentinel, S, owner_shard(flat, S)).astype(
+        jnp.int32
+    )
+    # Bucket by owner: stable-sort children by destination shard.
+    order = jnp.argsort(owner, stable=True)
+    s_owner = owner[order]
+    s_kids = flat[order]
+    # Position of each element within its destination bucket.
+    first = jnp.searchsorted(s_owner, jnp.arange(S + 1))
+    pos = jnp.arange(s_owner.shape[0]) - first[jnp.clip(s_owner, 0, S)]
+    counts = first[1:] - first[:-1]  # per-destination send counts [S]
+    out = jnp.full((S, route_cap), sentinel, dtype=local.dtype)
+    # Out-of-range rows (owner==S) and overflow (pos>=route_cap) drop.
+    out = out.at[s_owner, pos].set(s_kids, mode="drop")
+    routed = jax.lax.all_to_all(out, AXIS, split_axis=0, concat_axis=0,
+                                tiled=True)
+    uniq, count = sort_unique(routed.reshape(-1))
+    return uniq[None], count[None], counts[None]
+
+
+def _sharded_backward_step(game: TensorGame, S: int, local, window_flat):
+    """Per-shard backward body: expand -> all_gather window -> combine.
+
+    window_flat: flat sequence of (states, values, remoteness) triples, one
+    per window level, each [1, capL] shard slices.
+    """
+    sentinel = game.sentinel
+    local = local[0]
+    valid = local != sentinel
+    prim = game.primitive(local)
+    undecided = valid & (prim == UNDECIDED)
+    children, mask = game.expand(local)
+    mask = mask & undecided[:, None]
+    children = jnp.where(mask, children, sentinel)
+    # Gather the solved window from all shards; each shard's slice is
+    # sorted, so lookups are per-chunk binary searches.
+    tables = []
+    for i in range(0, len(window_flat), 3):
+        ts = jax.lax.all_gather(window_flat[i][0], AXIS)  # [S, capL]
+        tv = jax.lax.all_gather(window_flat[i + 1][0], AXIS)
+        tr = jax.lax.all_gather(window_flat[i + 2][0], AXIS)
+        for s in range(S):
+            tables.append((ts[s], tv[s], tr[s]))
+    child_vals, child_rem, hit = lookup_window(children, tuple(tables))
+    values, remoteness = combine_children(child_vals, child_rem, mask)
+    values = jnp.where(undecided, values, jnp.where(valid, prim, UNDECIDED))
+    remoteness = jnp.where(undecided, remoteness, 0)
+    # Misses + zero-move UNDECIDED positions (see engine.resolve_level).
+    misses = jnp.sum(mask & ~hit) + jnp.sum(undecided & ~jnp.any(mask, axis=-1))
+    return values[None], remoteness[None], misses[None]
 
 
 class ShardedSolver:
@@ -80,107 +161,53 @@ class ShardedSolver:
         self.paranoid = paranoid
         self.logger = logger
         self.checkpointer = checkpointer
-        # Per-instance caches of jitted steps keyed on static shapes (a
-        # class-level functools.cache would pin instances for process life).
-        self._forward_cache: dict = {}
-        self._backward_cache: dict = {}
+        # Mesh identity participates in the process-wide kernel cache key
+        # (same shard count over different device sets must not share).
+        self._mesh_key = tuple(d.id for d in self.mesh.devices.flat)
 
     # ------------------------------------------------------------- jit builds
 
     def _forward_fn(self, cap: int, route_cap: int):
         """Compiled forward step: [S, cap] states -> routed unique children."""
-        key = (cap, route_cap)
-        if key in self._forward_cache:
-            return self._forward_cache[key]
-        g = self.game
-        S = self.S
+        mesh, S = self.mesh, self.S
 
-        def per_shard(local):  # local: [1, cap]
-            local = local[0]
-            valid = local != SENTINEL
-            prim = g.primitive(local)
-            children, mask = g.expand(local)
-            mask = mask & (valid & (prim == UNDECIDED))[:, None]
-            flat = jnp.where(mask, children, SENTINEL).reshape(-1)
-            owner = jnp.where(
-                flat == SENTINEL, S, owner_shard(flat, S)
-            ).astype(jnp.int32)
-            # Bucket by owner: stable-sort children by destination shard.
-            order = jnp.argsort(owner, stable=True)
-            s_owner = owner[order]
-            s_kids = flat[order]
-            # Position of each element within its destination bucket.
-            first = jnp.searchsorted(s_owner, jnp.arange(S + 1))
-            pos = jnp.arange(s_owner.shape[0]) - first[jnp.clip(s_owner, 0, S)]
-            counts = first[1:] - first[:-1]  # per-destination send counts [S]
-            out = jnp.full((S, route_cap), SENTINEL, dtype=jnp.uint64)
-            # Out-of-range rows (owner==S) and overflow (pos>=route_cap) drop.
-            out = out.at[s_owner, pos].set(s_kids, mode="drop")
-            routed = jax.lax.all_to_all(
-                out, AXIS, split_axis=0, concat_axis=0, tiled=True
-            )
-            uniq, count = sort_unique(routed.reshape(-1))
-            levels = jnp.where(uniq != SENTINEL, g.level_of(uniq), -1)
-            return (
-                uniq[None],
-                levels[None],
-                count[None],
-                counts[None],
+        def build(game):
+            def per_shard(local):
+                return _sharded_forward_step(game, S, route_cap, local)
+
+            return jax.shard_map(
+                per_shard,
+                mesh=mesh,
+                in_specs=P(AXIS),
+                out_specs=(P(AXIS), P(AXIS), P(AXIS)),
             )
 
-        fn = jax.shard_map(
-            per_shard,
-            mesh=self.mesh,
-            in_specs=P(AXIS),
-            out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        return get_kernel(
+            self.game, "sfwd", (self._mesh_key, cap, route_cap), build
         )
-        self._forward_cache[key] = jax.jit(fn)
-        return self._forward_cache[key]
 
     def _backward_fn(self, cap: int, window_caps: tuple):
         """Compiled backward step for one level against a solved window."""
-        key = (cap, window_caps)
-        if key in self._backward_cache:
-            return self._backward_cache[key]
-        g = self.game
-        S = self.S
-
-        def per_shard(local, *window_flat):  # local: [1, cap]
-            local = local[0]
-            valid = local != SENTINEL
-            prim = g.primitive(local)
-            undecided = valid & (prim == UNDECIDED)
-            children, mask = g.expand(local)
-            mask = mask & undecided[:, None]
-            children = jnp.where(mask, children, SENTINEL)
-            # Gather the solved window from all shards; each shard's slice is
-            # sorted, so lookups are per-chunk binary searches.
-            tables = []
-            for i in range(0, len(window_flat), 3):
-                ts = jax.lax.all_gather(window_flat[i][0], AXIS)  # [S, capL]
-                tv = jax.lax.all_gather(window_flat[i + 1][0], AXIS)
-                tr = jax.lax.all_gather(window_flat[i + 2][0], AXIS)
-                for s in range(S):
-                    tables.append((ts[s], tv[s], tr[s]))
-            child_vals, child_rem, hit = lookup_window(children, tuple(tables))
-            values, remoteness = combine_children(child_vals, child_rem, mask)
-            values = jnp.where(undecided, values, jnp.where(valid, prim, UNDECIDED))
-            remoteness = jnp.where(undecided, remoteness, 0)
-            # Misses + zero-move UNDECIDED positions (see engine._resolve_impl).
-            misses = jnp.sum(mask & ~hit) + jnp.sum(
-                undecided & ~jnp.any(mask, axis=-1)
-            )
-            return values[None], remoteness[None], misses[None]
-
+        mesh, S = self.mesh, self.S
         n_windows = len(window_caps)
-        fn = jax.shard_map(
-            per_shard,
-            mesh=self.mesh,
-            in_specs=(P(AXIS),) + (P(AXIS),) * (3 * n_windows),
-            out_specs=(P(AXIS), P(AXIS), P(AXIS)),
+
+        def build(game):
+            def per_shard(local, *window_flat):
+                return _sharded_backward_step(game, S, local, window_flat)
+
+            return jax.shard_map(
+                per_shard,
+                mesh=mesh,
+                in_specs=(P(AXIS),) + (P(AXIS),) * (3 * n_windows),
+                out_specs=(P(AXIS), P(AXIS), P(AXIS)),
+            )
+
+        return get_kernel(
+            self.game,
+            "sbwd",
+            (self._mesh_key, cap, tuple(window_caps)),
+            build,
         )
-        self._backward_cache[key] = jax.jit(fn)
-        return self._backward_cache[key]
 
     # ----------------------------------------------------------------- phases
 
@@ -201,25 +228,40 @@ class ShardedSolver:
             )
             stacked = _pad_shards(shards, cap)
             while True:
-                uniq, levels, count, send_counts = self._forward_fn(
-                    cap, route_cap
-                )(stacked)
+                uniq, count, send_counts = self._forward_fn(cap, route_cap)(
+                    stacked
+                )
                 max_sent = int(np.asarray(send_counts).max())
                 if max_sent <= route_cap:
                     break
                 route_cap = bucket_size(max_sent)  # spill path: retry bigger
             uniq = np.asarray(uniq)
-            levels = np.asarray(levels)
             count = np.asarray(count)
+            # Children land in their levels' pools. For uniform unit-jump
+            # games this is a single destination level; multi-jump games
+            # compute each child's level host-side in one pass.
             for s in range(S):
                 n = int(count[s])
                 kids = uniq[s, :n]
-                kid_levels = levels[s, :n]
-                for lv in np.unique(kid_levels):
-                    lv = int(lv)
-                    batch = kids[kid_levels == lv]
+                if n == 0:
+                    continue
+                if g.uniform_level_jump:
+                    groups = [(k + 1, kids)]
+                else:
+                    kid_levels = np.asarray(
+                        self._level_fn(bucket_size(n, self.min_bucket))(
+                            jnp.asarray(_pad_shards([kids],
+                                        bucket_size(n, self.min_bucket))[0])
+                        )
+                    )[:n]
+                    groups = [
+                        (int(lv), kids[kid_levels == lv])
+                        for lv in np.unique(kid_levels)
+                    ]
+                for lv, batch in groups:
                     if lv not in pools:
-                        pools[lv] = [np.empty(0, np.uint64) for _ in range(S)]
+                        pools[lv] = [np.empty(0, g.state_dtype)
+                                     for _ in range(S)]
                     pools[lv][s] = np.union1d(pools[lv][s], batch)
             if self.logger is not None:
                 self.logger.log(
@@ -233,6 +275,15 @@ class ShardedSolver:
                     }
                 )
             k += 1
+
+    def _level_fn(self, cap: int):
+        """Cached level_of kernel for multi-jump child grouping."""
+        return get_kernel(
+            self.game, "lvl", cap,
+            lambda game: lambda states: jnp.where(
+                states != game.sentinel, game.level_of(states), -1
+            ),
+        )
 
     def _repartition(self, states: np.ndarray) -> List[np.ndarray]:
         """Split a sorted global state array into per-shard sorted arrays."""
@@ -261,6 +312,11 @@ class ShardedSolver:
                 # Restart-from-level: reload the solved table, re-partition it
                 # by owner to refill the per-shard window cache.
                 table = self.checkpointer.load_level(k)
+                table = LevelTable(
+                    states=np.asarray(table.states, dtype=g.state_dtype),
+                    values=table.values,
+                    remoteness=table.remoteness,
+                )
                 expected = np.sort(np.concatenate(shards))
                 if table.states.shape[0] != expected.shape[0] or not (
                     table.states == expected
@@ -339,7 +395,7 @@ class ShardedSolver:
         g = self.game
         S = self.S
         t0 = time.perf_counter()
-        init = np.uint64(g.initial_state())
+        init = g.state_dtype(g.initial_state())
         start_level = int(np.asarray(g.level_of(jnp.asarray([init])))[0])
         global_pools = (
             self.checkpointer.load_frontiers()
@@ -348,12 +404,13 @@ class ShardedSolver:
         )
         if global_pools is not None:
             pools = {
-                k: self._repartition(v) for k, v in global_pools.items()
+                k: self._repartition(np.asarray(v, dtype=g.state_dtype))
+                for k, v in global_pools.items()
             }
         else:
-            owner = int(owner_shard_np(np.array([init]), S)[0])
-            shards = [np.empty(0, np.uint64) for _ in range(S)]
-            shards[owner] = np.array([init], np.uint64)
+            owner = int(owner_shard_np(np.array([init], np.uint64), S)[0])
+            shards = [np.empty(0, g.state_dtype) for _ in range(S)]
+            shards[owner] = np.array([init], g.state_dtype)
             pools = {start_level: shards}
             self._forward(pools, start_level)
             if self.checkpointer is not None:

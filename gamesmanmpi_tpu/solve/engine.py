@@ -116,10 +116,14 @@ _KERNELS: dict = {}
 
 
 def get_kernel(game: TensorGame, kind: str, shape_key, builder):
+    # Games whose identity is per-instance (TensorizedModule: host callbacks
+    # can't be compared) carry their own cache dict, so their kernels are
+    # garbage-collected with the game instead of pinning it process-wide.
+    cache = getattr(game, "_private_kernel_cache", _KERNELS)
     key = (game.cache_key, kind, shape_key)
-    fn = _KERNELS.get(key)
+    fn = cache.get(key)
     if fn is None:
-        fn = _KERNELS[key] = jax.jit(builder(game))
+        fn = cache[key] = jax.jit(builder(game))
     return fn
 
 
@@ -271,8 +275,19 @@ class Solver:
             next_cap = bucket_size(n, self.min_bucket)
             if next_cap <= uniq.shape[0]:
                 nxt = jax.lax.slice(uniq, (0,), (next_cap,))
-            else:  # bucket(n) > cap*M: only when M < 2 and the level grew
-                nxt = jnp.asarray(pad_to(np.asarray(uniq), next_cap))
+            else:
+                # bucket(n) can exceed cap*M for non-power-of-two branching
+                # factors (e.g. M=7: n in (1024, 1792] at cap=256); extend
+                # with sentinel padding on device — no host round-trip.
+                nxt = jnp.concatenate(
+                    [
+                        uniq,
+                        jnp.full(
+                            next_cap - uniq.shape[0], g.sentinel,
+                            dtype=uniq.dtype,
+                        ),
+                    ]
+                )
             rec = _Level(n, None, nxt)
             if stored_bytes + nxt.nbytes > _DEVICE_STORE_BYTES:
                 # Device-store budget exhausted: keep this level on host only
@@ -437,8 +452,6 @@ class Solver:
                         f"checkpointed level {k} does not match the discovered "
                         "frontier — stale checkpoint directory?"
                     )
-                values = np.asarray(table.values)
-                remoteness = np.asarray(table.remoteness)
             else:
                 window_levels = [
                     k + j

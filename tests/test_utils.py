@@ -118,8 +118,12 @@ def test_checkpoint_resume_sharded(tmp_path):
         get_game("nim:heaps=3-4-5"), num_shards=4,
         checkpointer=LevelCheckpointer(d),
     )
-    resumed._forward_cache = None  # poison: resume must not recompile/run
-    resumed._backward_cache = None
+    # Poison the step builders: resume must not recompile/run any level.
+    def _poisoned(*a, **k):
+        raise AssertionError("sharded resume recomputed a level")
+
+    resumed._forward_fn = _poisoned
+    resumed._backward_fn = _poisoned
     result = resumed.solve()
     assert (result.value, result.remoteness) == (first.value, first.remoteness)
 
