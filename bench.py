@@ -19,16 +19,40 @@ Prints exactly ONE JSON line on stdout; everything else goes to stderr.
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 
+def _accelerator_alive(timeout: float = 180.0) -> bool:
+    """Probe backend init in a throwaway subprocess.
+
+    The container's TPU plugin tunnels device access; a wedged tunnel hangs
+    at first backend touch *forever* (no error). Probing in a child keeps
+    this process clean and lets us fall back to CPU instead of hanging the
+    benchmark run.
+    """
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('ok')"],
+            timeout=timeout, capture_output=True, text=True,
+        )
+        return proc.returncode == 0 and "ok" in proc.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> int:
-    from gamesmanmpi_tpu.utils.platform import apply_platform_env
+    from gamesmanmpi_tpu.utils.platform import apply_platform_env, force_platform
 
     # Honor GAMESMAN_PLATFORM=cpu when the TPU tunnel is unavailable (the
     # driver leaves it unset, so real runs stay on the accelerator).
     apply_platform_env()
+    if not os.environ.get("GAMESMAN_PLATFORM") and not _accelerator_alive():
+        print("accelerator probe failed/hung; falling back to CPU",
+              file=sys.stderr)
+        force_platform("cpu")
 
     import gamesmanmpi_tpu  # noqa: F401  (enables x64 before first trace)
     import jax

@@ -145,9 +145,10 @@ def main(argv=None) -> int:
     except (KeyError, ValueError) as e:
         print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
         print(
-            "known games: tictactoe[:m=,n=,k=], connect4[:w=,h=,k=], "
+            "known games: tictactoe[:m=,n=,k=,sym=], connect4[:w=,h=,k=,sym=], "
             "subtract[:total=,moves=,misere=], nim[:heaps=,misere=] — or a "
-            "path to a reference-style game module file",
+            "path to a reference-style game module file "
+            "(sym=1 enables board-symmetry reduction)",
             file=sys.stderr,
         )
         return 2

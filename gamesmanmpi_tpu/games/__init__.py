@@ -38,26 +38,31 @@ def get_game(spec: str) -> TensorGame:
     name, _, rest = spec.partition(":")
     kw = _parse_kwargs(rest)
     name = name.strip().lower()
+    def _flag(key):
+        return kw.get(key, "0") not in ("0", "false", "False", "")
+
     if name in ("tictactoe", "ttt", "mnk"):
         return TicTacToe(
-            m=int(kw.get("m", 3)), n=int(kw.get("n", 3)), k=int(kw.get("k", 3))
+            m=int(kw.get("m", 3)), n=int(kw.get("n", 3)), k=int(kw.get("k", 3)),
+            sym=_flag("sym"),
         )
     if name in ("connect4", "c4", "win4", "connectn"):
         return Connect4(
             width=int(kw.get("w", kw.get("width", 7))),
             height=int(kw.get("h", kw.get("height", 6))),
             connect=int(kw.get("k", kw.get("connect", 4))),
+            sym=_flag("sym"),
         )
     if name in ("subtract", "1210", "tentozero"):
         return Subtract(
             total=int(kw.get("total", kw.get("n", 10))),
             moves=_intlist(kw.get("moves", "1-2")),
-            misere=kw.get("misere", "0") not in ("0", "false", "False", ""),
+            misere=_flag("misere"),
         )
     if name == "nim":
         return Nim(
             heaps=_intlist(kw.get("heaps", "3-4-5")),
-            misere=kw.get("misere", "0") not in ("0", "false", "False", ""),
+            misere=_flag("misere"),
         )
     raise KeyError(f"unknown game spec {spec!r}")
 

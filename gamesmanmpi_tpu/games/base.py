@@ -81,6 +81,23 @@ class TensorGame(abc.ABC):
         """
         return (type(self).__qualname__, self.name, self.state_bits)
 
+    def canonicalize(self, states):
+        """Map each state to its symmetry-class representative.
+
+        Identity by default. Games with board symmetries (connect4 mirror,
+        tic-tac-toe dihedral group) override this with a branch-free
+        min-over-transforms; the engines then solve only canonical
+        representatives — the standard state-space reduction of retrograde
+        analysis (PAPERS.md: Pentago 8-fold, 2507.05267 mirror). The override
+        must be a game automorphism projection: canonicalize(do_move(s)) must
+        equal canonicalize(do_move(canonicalize(s))) for the matching move,
+        and value/remoteness must be invariant within a class. The reference
+        has no symmetry reduction, so this is off unless a game opts in
+        (spec flag `sym=1`); results are observably identical either way
+        (root value/remoteness, and lookup() canonicalizes queries).
+        """
+        return states
+
     @abc.abstractmethod
     def initial_state(self):
         """The packed initial position (reference: `initial_position`)."""

@@ -38,11 +38,14 @@ from gamesmanmpi_tpu.games.base import TensorGame
 class Connect4(TensorGame):
     uniform_level_jump = True  # every move drops exactly one stone
 
-    def __init__(self, width: int = 7, height: int = 6, connect: int = 4):
+    def __init__(self, width: int = 7, height: int = 6, connect: int = 4,
+                 sym: bool = False):
         if (height + 1) * width > 63:
             raise ValueError("board too large for uint64 packing")
         self.width, self.height, self.connect = width, height, connect
-        self.name = f"connect{connect}_{width}x{height}"
+        self.sym = bool(sym)
+        suffix = "_sym" if self.sym else ""
+        self.name = f"connect{connect}_{width}x{height}{suffix}"
         self.max_moves = width
         self.num_levels = width * height + 1
         self.max_level_jump = 1
@@ -65,6 +68,27 @@ class Connect4(TensorGame):
 
     def initial_state(self):
         return self._bottom_mask
+
+    def _mirror(self, states):
+        """Reflect the board left-right: column c <-> column w-1-c."""
+        dt = self.state_dtype
+        h1 = self.height + 1
+        out = jnp.zeros(states.shape, dtype=dt)
+        for c in range(self.width):
+            col = (states >> dt(c * h1)) & self._col_masks[0]
+            out = out | (col << dt((self.width - 1 - c) * h1))
+        return out
+
+    def canonicalize(self, states):
+        """Class representative under the mirror symmetry (when sym=1).
+
+        Mirroring commutes with drops and preserves wins, so min(state,
+        mirror) picks a consistent representative per class — the standard
+        2-fold reduction of Connect-4 solvers (PAPERS.md: 2507.05267).
+        """
+        if not self.sym:
+            return states
+        return jnp.minimum(states, self._mirror(states))
 
     def _decompose(self, states):
         """-> (guards, filled, current, opponent) bitboards for a [B] batch."""

@@ -43,12 +43,14 @@ def _win_lines(m: int, n: int, k: int, dtype) -> np.ndarray:
 class TicTacToe(TensorGame):
     uniform_level_jump = True  # every move places exactly one stone
 
-    def __init__(self, m: int = 3, n: int = 3, k: int = 3):
+    def __init__(self, m: int = 3, n: int = 3, k: int = 3, sym: bool = False):
         if 2 * m * n > 63:
             raise ValueError("board too large for uint64 packing")
         self.m, self.n, self.k = m, n, k
         self.cells = m * n
-        self.name = f"tictactoe_{m}x{n}x{k}"
+        self.sym = bool(sym)
+        suffix = "_sym" if self.sym else ""
+        self.name = f"tictactoe_{m}x{n}x{k}{suffix}"
         self.max_moves = self.cells
         self.num_levels = self.cells + 1
         self.max_level_jump = 1
@@ -59,9 +61,60 @@ class TicTacToe(TensorGame):
         self._full = dt((1 << self.cells) - 1)
         self._cells_shift = dt(self.cells)
         self._bits = np.array([1 << i for i in range(self.cells)], dtype=dt)
+        self._sym_perms = self._board_symmetries() if self.sym else []
 
     def initial_state(self):
         return self.state_dtype(0)
+
+    def _board_symmetries(self):
+        """Cell permutations of the board's symmetry group, identity excluded.
+
+        Dihedral-4 (8 transforms) for square boards, the Klein group (4) for
+        rectangular ones. perm[dst] = src cell index.
+        """
+        m, n = self.m, self.n
+        coord_maps = [
+            lambda r, c: (r, n - 1 - c),          # horizontal flip
+            lambda r, c: (m - 1 - r, c),          # vertical flip
+            lambda r, c: (m - 1 - r, n - 1 - c),  # 180 rotation
+        ]
+        if m == n:
+            coord_maps += [
+                lambda r, c: (c, r),                      # main transpose
+                lambda r, c: (n - 1 - c, m - 1 - r),      # anti transpose
+                lambda r, c: (c, m - 1 - r),              # rot 90
+                lambda r, c: (n - 1 - c, r),              # rot 270
+            ]
+        perms = []
+        for f in coord_maps:
+            perm = [0] * self.cells
+            for r in range(m):
+                for c in range(n):
+                    sr, sc = f(r, c)
+                    perm[r * n + c] = sr * n + sc
+            perms.append(tuple(perm))
+        return sorted(set(perms))
+
+    def canonicalize(self, states):
+        """Min over the board symmetry group applied to both planes (sym=1).
+
+        Board symmetries permute cells identically on the X and O planes and
+        map win-lines to win-lines, so they are game automorphisms; taking
+        the minimum packed value picks a consistent class representative.
+        """
+        if not self.sym:
+            return states
+        dt = self.state_dtype
+        best = states
+        for perm in self._sym_perms:
+            out = jnp.zeros(states.shape, dtype=dt)
+            for dst, src in enumerate(perm):
+                bit = dt(1)
+                x = (states >> dt(src)) & bit
+                o = (states >> dt(self.cells + src)) & bit
+                out = out | (x << dt(dst)) | (o << dt(self.cells + dst))
+            best = jnp.minimum(best, out)
+        return best
 
     def _planes(self, states):
         x = states & self._plane_mask

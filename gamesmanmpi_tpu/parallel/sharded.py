@@ -55,6 +55,7 @@ from gamesmanmpi_tpu.solve.engine import (
     LevelTable,
     SolveResult,
     SolverError,
+    canonical_scalar,
     get_kernel,
 )
 
@@ -86,6 +87,7 @@ def _sharded_forward_step(game: TensorGame, S: int, route_cap: int, local):
     valid = local != sentinel
     prim = game.primitive(local)
     children, mask = game.expand(local)
+    children = game.canonicalize(children)
     mask = mask & (valid & (prim == UNDECIDED))[:, None]
     flat = jnp.where(mask, children, sentinel).reshape(-1)
     owner = jnp.where(flat == sentinel, S, owner_shard(flat, S)).astype(
@@ -120,6 +122,7 @@ def _sharded_backward_step(game: TensorGame, S: int, local, window_flat):
     prim = game.primitive(local)
     undecided = valid & (prim == UNDECIDED)
     children, mask = game.expand(local)
+    children = game.canonicalize(children)
     mask = mask & undecided[:, None]
     children = jnp.where(mask, children, sentinel)
     # Gather the solved window from all shards; each shard's slice is
@@ -395,8 +398,7 @@ class ShardedSolver:
         g = self.game
         S = self.S
         t0 = time.perf_counter()
-        init = g.state_dtype(g.initial_state())
-        start_level = int(np.asarray(g.level_of(jnp.asarray([init])))[0])
+        init, start_level = canonical_scalar(g, g.initial_state())
         global_pools = (
             self.checkpointer.load_frontiers()
             if self.checkpointer is not None

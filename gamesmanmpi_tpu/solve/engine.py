@@ -88,11 +88,12 @@ class SolveResult:
         return sum(t.states.shape[0] for t in self.levels.values())
 
     def lookup(self, state) -> tuple[int, int]:
-        """(value, remoteness) of any reachable packed state."""
-        state = self.game.state_dtype(state)
-        level = int(
-            np.asarray(self.game.level_of(jnp.asarray([state])))[0]
-        )
+        """(value, remoteness) of any reachable packed state.
+
+        Queries are canonicalized, so symmetry-reduced tables answer for
+        every member of a stored class.
+        """
+        state, level = canonical_scalar(self.game, state)
         table = self.levels.get(level)
         if table is not None:
             i = np.searchsorted(table.states, state)
@@ -127,12 +128,37 @@ def get_kernel(game: TensorGame, kind: str, shape_key, builder):
     return fn
 
 
+def canonical_scalar(game: TensorGame, state):
+    """(canonical state, topological level) of one packed state.
+
+    The shared scalar entry for roots and point queries; runs through the
+    process-wide kernel cache so per-query dispatch is O(1) even for games
+    with expensive canonicalize (dihedral tic-tac-toe).
+    """
+
+    def build(g):
+        def f(s):
+            c = g.canonicalize(s)
+            return c, g.level_of(c)
+
+        return f
+
+    fn = get_kernel(game, "canon1", 1, build)
+    c, lvl = fn(jnp.asarray([game.state_dtype(state)]))
+    return game.state_dtype(np.asarray(c)[0]), int(np.asarray(lvl)[0])
+
+
 def expand_core(game: TensorGame, states):
-    """Shared expand+mask+dedup: [B] -> (uniq [B*M] sorted, count)."""
+    """Shared expand+mask+dedup: [B] -> (uniq [B*M] sorted, count).
+
+    Children are canonicalized before masking (identity for most games), so
+    a symmetry-reduced solve only ever stores class representatives.
+    """
     valid = states != game.sentinel
     prim = game.primitive(states)
     expandable = valid & (prim == UNDECIDED)
     children, mask = game.expand(states)
+    children = game.canonicalize(children)
     mask = mask & expandable[:, None]
     children = jnp.where(mask, children, game.sentinel)
     return sort_unique(children.reshape(-1))
@@ -146,11 +172,15 @@ def expand_with_levels(game: TensorGame, states):
 
 
 def resolve_level(game: TensorGame, states, window):
-    """[B] states + solved deeper levels -> (values, remoteness, misses)."""
+    """[B] states + solved deeper levels -> (values, remoteness, misses).
+
+    Children are canonicalized to match the canonical solved tables.
+    """
     valid = states != game.sentinel
     prim = game.primitive(states)
     undecided = valid & (prim == UNDECIDED)
     children, mask = game.expand(states)
+    children = game.canonicalize(children)
     mask = mask & undecided[:, None]
     children = jnp.where(mask, children, game.sentinel)
     child_vals, child_rem, hit = lookup_window(children, window)
@@ -505,8 +535,7 @@ class Solver:
     def solve(self) -> SolveResult:
         g = self.game
         t0 = time.perf_counter()
-        init = g.state_dtype(g.initial_state())
-        start_level = int(np.asarray(g.level_of(jnp.asarray([init])))[0])
+        init, start_level = canonical_scalar(g, g.initial_state())
 
         saved = (
             self.checkpointer.load_frontiers()

@@ -1,0 +1,62 @@
+"""Symmetry reduction (sym=1): observable equivalence + table shrink.
+
+The reference has no symmetry reduction, so sym=1 must change nothing
+observable — root value/remoteness and every queried position's answer —
+while solving only class representatives (the Pentago/2507.05267-style
+state-space reduction; SURVEY.md §7 capacity planning).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from gamesmanmpi_tpu.core.values import TIE
+from gamesmanmpi_tpu.games import get_game
+from gamesmanmpi_tpu.solve import Solver
+
+from helpers import full_table
+
+
+def test_tictactoe_sym_root_and_canonical_count():
+    plain = Solver(get_game("tictactoe"), paranoid=True).solve()
+    sym = Solver(get_game("tictactoe:sym=1"), paranoid=True).solve()
+    assert (sym.value, sym.remoteness) == (plain.value, plain.remoteness) == (TIE, 9)
+    # 765 essentially-different positions is the classic 3x3 count.
+    assert sym.num_positions == 765
+    assert plain.num_positions == 5478
+
+
+def test_tictactoe_sym_answers_match_plain_for_every_position():
+    plain = Solver(get_game("tictactoe")).solve()
+    sym = Solver(get_game("tictactoe:sym=1")).solve()
+    # Every reachable raw position must answer identically through the
+    # canonicalizing lookup.
+    for pos, expected in full_table(plain).items():
+        assert sym.lookup(pos) == expected
+
+
+def test_connect4_sym_root_and_shrink():
+    plain = Solver(get_game("connect4:w=4,h=4")).solve()
+    sym = Solver(get_game("connect4:w=4,h=4,sym=1")).solve()
+    assert (sym.value, sym.remoteness) == (plain.value, plain.remoteness)
+    # Mirror symmetry roughly halves the table (self-symmetric states less).
+    assert sym.num_positions < 0.6 * plain.num_positions
+    # Spot-check: mirrored sibling positions answer identically.
+    rng = np.random.default_rng(0)
+    states = plain.levels[max(plain.levels)].states
+    for pos in rng.choice(states, size=min(50, len(states)), replace=False):
+        assert sym.lookup(pos) == plain.lookup(pos)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 (fake) devices")
+def test_sharded_sym_invariance():
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+
+    single = Solver(get_game("tictactoe:sym=1"), paranoid=True).solve()
+    sharded = ShardedSolver(
+        get_game("tictactoe:sym=1"), num_shards=4, paranoid=True
+    ).solve()
+    assert (sharded.value, sharded.remoteness) == (single.value, single.remoteness)
+    assert sharded.num_positions == single.num_positions == 765
+    assert full_table(sharded) == full_table(single)
