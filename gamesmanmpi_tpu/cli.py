@@ -50,6 +50,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="write per-level structured metrics to this JSONL file (§5.5)",
     )
     p.add_argument(
+        "-v",
+        "--verbose",
+        action="store_true",
+        help="print per-level progress lines to stderr (the reference's "
+        "debug-print flag analog, SURVEY.md §5.5)",
+    )
+    p.add_argument(
         "--checkpoint-dir",
         default=None,
         help="save per-level solved tables for restart-from-level (§5.4)",
@@ -79,10 +86,15 @@ def main(argv=None) -> int:
     import pathlib
 
     from gamesmanmpi_tpu.core.values import value_name
-    from gamesmanmpi_tpu.utils.metrics import JsonlLogger
+    from gamesmanmpi_tpu.utils.metrics import JsonlLogger, StdoutLogger, TeeLogger
     from gamesmanmpi_tpu.utils.profiling import maybe_profile
 
-    logger = JsonlLogger(args.jsonl) if args.jsonl else None
+    logger = None
+    if args.jsonl or args.verbose:
+        logger = TeeLogger(
+            JsonlLogger(args.jsonl) if args.jsonl else None,
+            StdoutLogger() if args.verbose else None,
+        )
     checkpointer = None
     if args.checkpoint_dir:
         from gamesmanmpi_tpu.utils.checkpoint import LevelCheckpointer
@@ -95,63 +107,75 @@ def main(argv=None) -> int:
 
         try:
             module = load_game_module(args.game)
-        except AttributeError as e:
+        except (AttributeError, ImportError) as e:
             # Module validation, solver_launcher.py-style (SURVEY.md §3.1).
             print(f"error: invalid game module {args.game!r}: {e}", file=sys.stderr)
             return 2
+        if hasattr(module, "level_of") and hasattr(module, "max_moves"):
+            # Modules that declare the two engine extras (topological level
+            # + static move bound) are lifted onto the batched protocol and
+            # driven by the real engine — all solver flags work, including
+            # --devices (the host callbacks run per shard-batch).
+            from gamesmanmpi_tpu.compat import TensorizedModule
+
+            game = TensorizedModule(module)
+        else:
+            game = None
         for flag, name in (
             (args.devices > 1, "--devices"),
             (args.paranoid, "--paranoid"),
             (args.checkpoint_dir, "--checkpoint-dir"),
         ):
-            if flag:
+            if flag and game is None:
                 print(
-                    f"warning: {name} is not supported on the compat "
-                    "(host-solve) path and is ignored; wrap the module with "
-                    "gamesmanmpi_tpu.compat.TensorizedModule to drive the "
+                    f"warning: {name} needs the tensorized compat path and "
+                    "is ignored on the host solve; define level_of(pos) and "
+                    "max_moves in the module (or wrap it with "
+                    "gamesmanmpi_tpu.compat.TensorizedModule) to drive the "
                     "TPU engine",
                     file=sys.stderr,
                 )
-        with maybe_profile(args.profile_dir):
-            value, remoteness, table = solve_module(module)
-        elapsed = time.perf_counter() - t0
-        print(f"game: {pathlib.Path(args.game).stem} (compat module)")
-        print(f"positions: {len(table)}")
-        print(f"value: {value_name(value)}")
-        print(f"remoteness: {remoteness}")
-        print(f"elapsed: {elapsed:.3f}s")
-        if args.table_out:
-            from gamesmanmpi_tpu.utils.checkpoint import save_table_npz
+        if game is None:
+            with maybe_profile(args.profile_dir):
+                value, remoteness, table = solve_module(module)
+            elapsed = time.perf_counter() - t0
+            print(f"game: {pathlib.Path(args.game).stem} (compat module)")
+            print(f"positions: {len(table)}")
+            print(f"value: {value_name(value)}")
+            print(f"remoteness: {remoteness}")
+            print(f"elapsed: {elapsed:.3f}s")
+            if args.table_out:
+                from gamesmanmpi_tpu.utils.checkpoint import save_table_npz
 
-            save_table_npz(args.table_out, table)
-            print(f"table written: {args.table_out}")
-        if logger is not None:
-            logger.log(
-                {
-                    "phase": "done",
-                    "game": pathlib.Path(args.game).stem,
-                    "compat": True,
-                    "positions": len(table),
-                    "secs_total": elapsed,
-                }
+                save_table_npz(args.table_out, table)
+                print(f"table written: {args.table_out}")
+            if logger is not None:
+                logger.log(
+                    {
+                        "phase": "done",
+                        "game": pathlib.Path(args.game).stem,
+                        "compat": True,
+                        "positions": len(table),
+                        "secs_total": elapsed,
+                    }
+                )
+                logger.close()
+            return 0
+    else:
+        from gamesmanmpi_tpu.games import get_game
+
+        try:
+            game = get_game(args.game)
+        except (KeyError, ValueError) as e:
+            print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
+            print(
+                "known games: tictactoe[:m=,n=,k=,sym=], "
+                "connect4[:w=,h=,k=,sym=], subtract[:total=,moves=,misere=], "
+                "nim[:heaps=,misere=] — or a path to a reference-style game "
+                "module file (sym=1 enables board-symmetry reduction)",
+                file=sys.stderr,
             )
-            logger.close()
-        return 0
-
-    from gamesmanmpi_tpu.games import get_game
-
-    try:
-        game = get_game(args.game)
-    except (KeyError, ValueError) as e:
-        print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
-        print(
-            "known games: tictactoe[:m=,n=,k=,sym=], connect4[:w=,h=,k=,sym=], "
-            "subtract[:total=,moves=,misere=], nim[:heaps=,misere=] — or a "
-            "path to a reference-style game module file "
-            "(sym=1 enables board-symmetry reduction)",
-            file=sys.stderr,
-        )
-        return 2
+            return 2
     if args.devices > 1:
         from gamesmanmpi_tpu.parallel import ShardedSolver
 

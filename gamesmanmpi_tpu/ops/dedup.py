@@ -13,16 +13,23 @@ from gamesmanmpi_tpu.core.bitops import sentinel_for
 
 
 def sort_unique(states):
-    """Sort states, replace duplicates with SENTINEL, resort, count uniques.
+    """Sort states, drop duplicates/sentinels, compact to the front.
 
     Input: [N] uint32/uint64 (may contain SENTINEL padding of the same dtype).
     Returns (sorted_unique [N] with all uniques first then SENTINEL tail,
              count of unique non-sentinel entries, int32).
+
+    One sort + prefix-sum scatter compaction: after the sort, the survivor
+    of each duplicate run is its first element; cumsum of the keep-mask is
+    each survivor's target slot, and a scatter-with-drop writes them — O(N)
+    instead of the naive mark-and-resort second O(N log N) pass.
     """
     sentinel = sentinel_for(states.dtype)
     s = jnp.sort(states)
-    dup = jnp.concatenate([jnp.zeros((1,), bool), s[1:] == s[:-1]])
-    s = jnp.where(dup, sentinel, s)
-    s = jnp.sort(s)
-    count = jnp.sum(s != sentinel).astype(jnp.int32)
-    return s, count
+    first = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
+    keep = first & (s != sentinel)
+    idx = jnp.cumsum(keep) - 1  # target slot per survivor (sorted order kept)
+    out = jnp.full(s.shape, sentinel, dtype=s.dtype)
+    out = out.at[jnp.where(keep, idx, s.shape[0])].set(s, mode="drop")
+    count = jnp.sum(keep).astype(jnp.int32)
+    return out, count

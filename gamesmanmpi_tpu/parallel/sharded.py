@@ -399,6 +399,8 @@ class ShardedSolver:
         S = self.S
         t0 = time.perf_counter()
         init, start_level = canonical_scalar(g, g.initial_state())
+        if self.checkpointer is not None:
+            self.checkpointer.bind_game(g.name)
         global_pools = (
             self.checkpointer.load_frontiers()
             if self.checkpointer is not None

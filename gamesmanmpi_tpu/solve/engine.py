@@ -148,19 +148,30 @@ def canonical_scalar(game: TensorGame, state):
     return game.state_dtype(np.asarray(c)[0]), int(np.asarray(lvl)[0])
 
 
-def expand_core(game: TensorGame, states):
-    """Shared expand+mask+dedup: [B] -> (uniq [B*M] sorted, count).
+def undecided_mask(game: TensorGame, states):
+    """Which lanes hold real, non-terminal positions: [B] bool."""
+    return (states != game.sentinel) & (game.primitive(states) == UNDECIDED)
 
-    Children are canonicalized before masking (identity for most games), so
-    a symmetry-reduced solve only ever stores class representatives.
+
+def canonical_children(game: TensorGame, states, active):
+    """expand + canonicalize + deactivate parents + sentinel-fill.
+
+    The one implementation of the per-level child generation all four solver
+    kernels (single/sharded x forward/backward) share: children of inactive
+    parents (padding lanes, primitives) are sentinel; survivors are
+    symmetry-class representatives (identity for games without sym).
+    Returns (children [B, M], mask [B, M]).
     """
-    valid = states != game.sentinel
-    prim = game.primitive(states)
-    expandable = valid & (prim == UNDECIDED)
     children, mask = game.expand(states)
     children = game.canonicalize(children)
-    mask = mask & expandable[:, None]
+    mask = mask & active[:, None]
     children = jnp.where(mask, children, game.sentinel)
+    return children, mask
+
+
+def expand_core(game: TensorGame, states):
+    """Shared expand+mask+dedup: [B] -> (uniq [B*M] sorted, count)."""
+    children, _ = canonical_children(game, states, undecided_mask(game, states))
     return sort_unique(children.reshape(-1))
 
 
@@ -179,10 +190,7 @@ def resolve_level(game: TensorGame, states, window):
     valid = states != game.sentinel
     prim = game.primitive(states)
     undecided = valid & (prim == UNDECIDED)
-    children, mask = game.expand(states)
-    children = game.canonicalize(children)
-    mask = mask & undecided[:, None]
-    children = jnp.where(mask, children, game.sentinel)
+    children, mask = canonical_children(game, states, undecided)
     child_vals, child_rem, hit = lookup_window(children, window)
     values, remoteness = combine_children(child_vals, child_rem, mask)
     values = jnp.where(undecided, values, jnp.where(valid, prim, UNDECIDED))
@@ -537,6 +545,8 @@ class Solver:
         t0 = time.perf_counter()
         init, start_level = canonical_scalar(g, g.initial_state())
 
+        if self.checkpointer is not None:
+            self.checkpointer.bind_game(g.name)
         saved = (
             self.checkpointer.load_frontiers()
             if self.checkpointer is not None

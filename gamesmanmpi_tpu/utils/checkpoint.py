@@ -31,6 +31,25 @@ class LevelCheckpointer:
     def _level_path(self, level: int) -> pathlib.Path:
         return self.dir / f"level_{level:04d}.npz"
 
+    def bind_game(self, name: str) -> None:
+        """Record/validate which game this directory belongs to.
+
+        Game names encode every parameter (board, symmetry flag, ...), so a
+        resume with a different spec — e.g. sym=1 against a sym=0 checkpoint,
+        whose canonical tables would silently disagree — fails loudly here
+        instead of mixing tables. Engines call this before loading anything.
+        """
+        manifest = self.load_manifest()
+        bound = manifest.get("game")
+        if bound is None:
+            manifest["game"] = name
+            self.manifest_path.write_text(json.dumps(manifest))
+        elif bound != name:
+            raise ValueError(
+                f"checkpoint directory {self.dir} belongs to game {bound!r}, "
+                f"not {name!r} — use a fresh --checkpoint-dir"
+            )
+
     def save_level(self, level: int, table) -> None:
         cells = np.asarray(
             pack_cells(jnp.asarray(table.values), jnp.asarray(table.remoteness))

@@ -1,0 +1,131 @@
+"""Property tests (SURVEY.md §4.2 axis 3, via hypothesis).
+
+Laws that hold independent of any game: the value algebra (negate is an
+involution; WIN iff some LOSE child), hash-partition totality (every state
+owned by exactly one shard, identically on host and device), codec
+round-trips, and dedup/lookup invariants.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from hypothesis import given, settings, strategies as st
+
+from gamesmanmpi_tpu.core.bitops import SENTINEL32, SENTINEL64, sentinel_for
+from gamesmanmpi_tpu.core.codec import pack_cells, unpack_cells
+from gamesmanmpi_tpu.core.hashing import owner_shard, owner_shard_np
+from gamesmanmpi_tpu.core.values import (
+    LOSE,
+    MAX_REMOTENESS,
+    TIE,
+    UNDECIDED,
+    WIN,
+    negate_np,
+)
+from gamesmanmpi_tpu.ops.combine import combine_children
+from gamesmanmpi_tpu.ops.dedup import sort_unique
+from gamesmanmpi_tpu.solve.oracle import combine_host
+
+VALUES = st.sampled_from([WIN, LOSE, TIE])
+_SETTINGS = dict(max_examples=50, deadline=None)
+
+
+@given(v=st.sampled_from([WIN, LOSE, TIE, UNDECIDED]))
+@settings(**_SETTINGS)
+def test_negate_involution(v):
+    assert negate_np(negate_np(np.uint8(v))) == v
+
+
+@given(
+    children=st.lists(
+        st.tuples(VALUES, st.integers(0, 1000)), min_size=1, max_size=16
+    )
+)
+@settings(**_SETTINGS)
+def test_combine_laws_host_vs_device(children):
+    """The jnp combine kernel agrees with the host oracle combine, and both
+    satisfy the negamax laws."""
+    value, rem = combine_host(children)
+    vals = [v for v, _ in children]
+    if LOSE in vals:
+        assert value == WIN
+        assert rem == 1 + min(r for v, r in children if v == LOSE)
+    elif TIE in vals:
+        assert value == TIE
+        assert rem == 1 + max(r for v, r in children if v == TIE)
+    else:
+        assert value == LOSE
+        assert rem == 1 + max(r for _, r in children)
+    M = len(children)
+    cv = jnp.asarray(np.array([[v for v, _ in children]], np.uint8))
+    cr = jnp.asarray(np.array([[r for _, r in children]], np.int32))
+    mask = jnp.ones((1, M), bool)
+    dv, dr = combine_children(cv, cr, mask)
+    assert (int(dv[0]), int(dr[0])) == (value, rem)
+
+
+@given(
+    values=st.lists(st.sampled_from([WIN, LOSE, TIE, UNDECIDED]), min_size=1,
+                    max_size=64),
+    rems=st.data(),
+)
+@settings(**_SETTINGS)
+def test_codec_roundtrip(values, rems):
+    n = len(values)
+    remoteness = np.array(
+        [rems.draw(st.integers(0, MAX_REMOTENESS)) for _ in range(n)],
+        np.int32,
+    )
+    v = jnp.asarray(np.array(values, np.uint8))
+    r = jnp.asarray(remoteness)
+    v2, r2 = unpack_cells(pack_cells(v, r))
+    assert (np.asarray(v2) == values).all()
+    assert (np.asarray(r2) == remoteness).all()
+
+
+@given(
+    states=st.lists(st.integers(0, 2**63 - 1), min_size=1, max_size=256),
+    shards=st.integers(1, 16),
+)
+@settings(**_SETTINGS)
+def test_owner_partition_total_and_consistent(states, shards):
+    arr = np.array(states, np.uint64)
+    host = owner_shard_np(arr, shards)
+    dev = np.asarray(owner_shard(jnp.asarray(arr), shards))
+    assert (host == dev).all()
+    assert ((host >= 0) & (host < shards)).all()
+
+
+@given(
+    states=st.lists(st.integers(0, 2**31 - 2), min_size=1, max_size=128),
+    dtype=st.sampled_from([np.uint32, np.uint64]),
+)
+@settings(**_SETTINGS)
+def test_sort_unique_matches_numpy(states, dtype):
+    arr = np.array(states, dtype)
+    sentinel = sentinel_for(dtype)
+    padded = np.concatenate([arr, np.full(7, sentinel, dtype)])
+    out, count = sort_unique(jnp.asarray(padded))
+    expect = np.unique(arr)
+    assert int(count) == expect.shape[0]
+    assert (np.asarray(out[: expect.shape[0]]) == expect).all()
+    assert (np.asarray(out[expect.shape[0]:]) == sentinel).all()
+
+
+def test_owner_u32_matches_u64_widening():
+    """uint32 states must route to the same owner as their uint64 widening
+    (the sharded path may see either dtype for the same logical state)."""
+    rng = np.random.default_rng(1)
+    s32 = rng.integers(0, 2**31, 1000, dtype=np.uint32)
+    for shards in (2, 8, 13):
+        a = owner_shard_np(s32, shards)
+        b = owner_shard_np(s32.astype(np.uint64), shards)
+        assert (a == b).all()
+        dev = np.asarray(owner_shard(jnp.asarray(s32), shards))
+        assert (dev == a).all()
+
+
+def test_sentinels_sort_last():
+    assert SENTINEL64 == np.iinfo(np.uint64).max
+    assert SENTINEL32 == np.iinfo(np.uint32).max

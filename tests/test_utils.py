@@ -171,6 +171,27 @@ def test_cli_compat_warns_on_unsupported_flags(tmp_path, capsys):
     )
     captured = capsys.readouterr()
     assert rc == 0
-    assert "not supported on the compat" in captured.err
+    assert "needs the tensorized compat path" in captured.err
     assert (tmp_path / "t.npz").exists()
     assert "done" in (tmp_path / "m.jsonl").read_text()
+
+
+def test_cli_tensorized_compat_module(tmp_path, capsys):
+    """A module declaring level_of + max_moves drives the real engine
+    (solver flags work; no host-solve warning)."""
+    mod = tmp_path / "ttz_t.py"
+    mod.write_text(
+        "initial_position = 10\n"
+        "max_moves = 2\n"
+        "max_level_jump = 2\n"
+        "def level_of(pos):\n    return 10 - pos\n"
+        "def gen_moves(pos):\n    return [m for m in (1, 2) if pos >= m]\n"
+        "def do_move(pos, move):\n    return pos - move\n"
+        "def primitive(pos):\n    return 'LOSE' if pos == 0 else None\n"
+    )
+    rc = cli_main([str(mod), "--paranoid"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "value: WIN" in captured.out
+    assert "remoteness: 7" in captured.out
+    assert "warning" not in captured.err
