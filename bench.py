@@ -14,7 +14,17 @@ BENCH_GAME). The metric (positions/sec/chip) is comparable across boards.
 is computed against the north-star-implied per-chip rate: 4.5e12 states in
 1 hour on 32 chips = 39.06M positions/sec/chip. vs_baseline = value / 39.06e6.
 
+Accelerator bring-up: this container's TPU is reached through an "axon" PJRT
+plugin over a localhost relay; a wedged relay hangs at first backend touch
+with no error. The probe therefore runs in a throwaway child with a LONG
+budget (remote compile + tunnel init can legitimately take minutes) and, on
+timeout, dumps the child's Python stacks via faulthandler so the failure
+mode is recorded in this run's stderr instead of being a silent fallback.
+
 Prints exactly ONE JSON line on stdout; everything else goes to stderr.
+The JSON records which platform actually ran (`device`) and whether the CPU
+fallback fired (`fallback_cpu`) so a CPU number can never be mistaken for a
+TPU number downstream.
 """
 
 import json
@@ -23,24 +33,61 @@ import subprocess
 import sys
 import time
 
+_PROBE_SRC = r"""
+import faulthandler, sys, time
+# If init wedges, print every thread's stack to stderr before the parent's
+# deadline so the parent can capture *where* it hung (relay dial, compile
+# RPC, device enumeration, ...).
+faulthandler.dump_traceback_later({dump_after}, exit=False, file=sys.stderr)
+t0 = time.time()
+import jax
+print(f"probe: jax imported in {{time.time()-t0:.1f}}s", file=sys.stderr)
+t0 = time.time()
+devs = jax.devices()
+print(f"probe: jax.devices() -> {{devs}} in {{time.time()-t0:.1f}}s",
+      file=sys.stderr)
+import jax.numpy as jnp
+t0 = time.time()
+x = jnp.arange(1024, dtype=jnp.uint32)
+y = jnp.sort(x).block_until_ready()
+print(f"probe: first kernel in {{time.time()-t0:.1f}}s", file=sys.stderr)
+faulthandler.cancel_dump_traceback_later()
+print("PROBE_OK", devs[0].platform)
+"""
 
-def _accelerator_alive(timeout: float = 180.0) -> bool:
-    """Probe backend init in a throwaway subprocess.
 
-    The container's TPU plugin tunnels device access; a wedged tunnel hangs
-    at first backend touch *forever* (no error). Probing in a child keeps
-    this process clean and lets us fall back to CPU instead of hanging the
-    benchmark run.
+def _probe_accelerator(timeout: float) -> str | None:
+    """Probe backend init in a throwaway subprocess; return its platform.
+
+    Returns the platform string ("tpu"/"axon"/...) on success, None on
+    failure/hang. On a hang the child's faulthandler stack dump (written
+    shortly before the deadline) is forwarded to stderr — the evidence
+    VERDICT.md round 1 asked for.
     """
+    src = _PROBE_SRC.format(dump_after=max(timeout - 15.0, 5.0))
     try:
         proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; jax.devices(); print('ok')"],
+            [sys.executable, "-c", src],
             timeout=timeout, capture_output=True, text=True,
         )
-        return proc.returncode == 0 and "ok" in proc.stdout
-    except subprocess.TimeoutExpired:
-        return False
+        if proc.stderr:
+            sys.stderr.write(proc.stderr)
+        if proc.returncode == 0:
+            for line in proc.stdout.splitlines():
+                if line.startswith("PROBE_OK"):
+                    return line.split()[1]
+        print(f"probe: child exited rc={proc.returncode}", file=sys.stderr)
+        return None
+    except subprocess.TimeoutExpired as e:
+        # The faulthandler dump fires before this deadline; forward it.
+        for stream in (e.stderr, e.stdout):
+            if stream:
+                sys.stderr.write(
+                    stream if isinstance(stream, str) else stream.decode()
+                )
+        print(f"probe: timed out after {timeout:.0f}s (stacks above)",
+              file=sys.stderr)
+        return None
 
 
 def main() -> int:
@@ -49,10 +96,15 @@ def main() -> int:
     # Honor GAMESMAN_PLATFORM=cpu when the TPU tunnel is unavailable (the
     # driver leaves it unset, so real runs stay on the accelerator).
     apply_platform_env()
-    if not os.environ.get("GAMESMAN_PLATFORM") and not _accelerator_alive():
-        print("accelerator probe failed/hung; falling back to CPU",
-              file=sys.stderr)
-        force_platform("cpu")
+    fallback = False
+    if not os.environ.get("GAMESMAN_PLATFORM"):
+        budget = float(os.environ.get("GAMESMAN_PROBE_TIMEOUT", "600"))
+        platform = _probe_accelerator(budget)
+        if platform is None:
+            print("accelerator probe failed/hung; falling back to CPU",
+                  file=sys.stderr)
+            force_platform("cpu")
+            fallback = True
 
     import gamesmanmpi_tpu  # noqa: F401  (enables x64 before first trace)
     import jax
@@ -60,11 +112,16 @@ def main() -> int:
     from gamesmanmpi_tpu.games import get_game
     from gamesmanmpi_tpu.solve import Solver
 
-    spec = os.environ.get("BENCH_GAME", "connect4:w=5,h=4")
-    repeats = int(os.environ.get("BENCH_REPEATS", "2"))
-
     dev = jax.devices()[0]
     print(f"bench device: {dev.platform} ({dev})", file=sys.stderr)
+
+    # Default board: the largest that solves in benchmark-friendly time on
+    # the platform that actually runs (BASELINE.md configs #3-#4 ladder).
+    default_spec = (
+        "connect4:w=5,h=4" if dev.platform == "cpu" else "connect4:w=5,h=5"
+    )
+    spec = os.environ.get("BENCH_GAME", default_spec)
+    repeats = int(os.environ.get("BENCH_REPEATS", "2"))
 
     game = get_game(spec)
     best = None
@@ -90,6 +147,8 @@ def main() -> int:
                 "value": round(best, 1),
                 "unit": "positions/sec/chip",
                 "vs_baseline": round(best / north_star_per_chip, 6),
+                "device": dev.platform,
+                "fallback_cpu": fallback,
             }
         )
     )

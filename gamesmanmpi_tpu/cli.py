@@ -71,7 +71,51 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="dump the full solved table as .npz (packed cells per level)",
     )
+    # Multi-host bring-up (SURVEY.md §5.8 control plane): one process per
+    # host, jax.distributed over DCN, mesh over all addressable devices.
+    # docs/ARCHITECTURE.md "Multi-host launch" shows a v4-32 example.
+    p.add_argument(
+        "--coordinator",
+        default=None,
+        help="coordinator address host:port for multi-host runs "
+        "(jax.distributed.initialize over DCN)",
+    )
+    p.add_argument(
+        "--num-processes",
+        type=int,
+        default=None,
+        help="total number of processes in the multi-host run",
+    )
+    p.add_argument(
+        "--process-id",
+        type=int,
+        default=None,
+        help="this process's index in [0, num-processes)",
+    )
     return p
+
+
+def _report(result, devices: int, elapsed: float, args, logger) -> None:
+    """The rank-0 output block (SURVEY.md §2.1.4), shared by every engine
+    path: value + remoteness + elapsed, optional table dump."""
+    from gamesmanmpi_tpu.core.values import value_name
+
+    print(f"game: {result.game.name}")
+    print(f"devices: {devices}")
+    print(f"positions: {result.num_positions}")
+    print(f"value: {value_name(result.value)}")
+    print(f"remoteness: {result.remoteness}")
+    print(f"elapsed: {elapsed:.3f}s")
+    print(
+        f"throughput: {result.stats['positions_per_sec']:.0f} positions/sec"
+    )
+    if args.table_out:
+        from gamesmanmpi_tpu.utils.checkpoint import save_result_npz
+
+        save_result_npz(args.table_out, result)
+        print(f"table written: {args.table_out}")
+    if logger is not None:
+        logger.close()
 
 
 def main(argv=None) -> int:
@@ -81,6 +125,23 @@ def main(argv=None) -> int:
     # Honor GAMESMAN_PLATFORM=cpu|tpu|axon (and GAMESMAN_FAKE_DEVICES) before
     # any backend init; --devices N on a faked-CPU run needs >= N devices.
     apply_platform_env(default_fake_devices=max(args.devices, 1))
+    if args.coordinator:
+        # Must run before the first backend touch so every process joins the
+        # same PJRT world; the mesh then spans all addressable devices.
+        if args.num_processes is None or args.process_id is None:
+            print(
+                "error: --coordinator requires --num-processes and "
+                "--process-id",
+                file=sys.stderr,
+            )
+            return 2
+        from gamesmanmpi_tpu.parallel.mesh import init_distributed
+
+        init_distributed(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
     t0 = time.perf_counter()
 
     import pathlib
@@ -111,31 +172,40 @@ def main(argv=None) -> int:
             # Module validation, solver_launcher.py-style (SURVEY.md §3.1).
             print(f"error: invalid game module {args.game!r}: {e}", file=sys.stderr)
             return 2
-        if hasattr(module, "level_of") and hasattr(module, "max_moves"):
-            # Modules that declare the two engine extras (topological level
-            # + static move bound) are lifted onto the batched protocol and
-            # driven by the real engine — all solver flags work, including
-            # --devices (the host callbacks run per shard-batch).
-            from gamesmanmpi_tpu.compat import TensorizedModule
-
-            game = TensorizedModule(module)
-        else:
-            game = None
+        engine_capable = hasattr(module, "level_of")
         for flag, name in (
             (args.devices > 1, "--devices"),
             (args.paranoid, "--paranoid"),
             (args.checkpoint_dir, "--checkpoint-dir"),
         ):
-            if flag and game is None:
+            if flag and not engine_capable:
                 print(
                     f"warning: {name} needs the tensorized compat path and "
-                    "is ignored on the host solve; define level_of(pos) and "
-                    "max_moves in the module (or wrap it with "
-                    "gamesmanmpi_tpu.compat.TensorizedModule) to drive the "
+                    "is ignored on the host solve; define level_of(pos) in "
+                    "the module (max_moves is auto-derived) to drive the "
                     "TPU engine",
                     file=sys.stderr,
                 )
-        if game is None:
+        if engine_capable:
+            # Modules that declare a topological level function are lifted
+            # onto the batched protocol and driven by the real engine —
+            # all solver flags work, including --devices (host callbacks
+            # run per shard-batch). max_moves is taken from the module or
+            # auto-derived with grow-and-retry (compat.solve_module_jitted).
+            from gamesmanmpi_tpu.compat import solve_module_jitted
+
+            with maybe_profile(args.profile_dir):
+                result = solve_module_jitted(
+                    module,
+                    devices=args.devices,
+                    paranoid=args.paranoid,
+                    logger=logger,
+                    checkpointer=checkpointer,
+                )
+            _report(result, args.devices, time.perf_counter() - t0, args,
+                    logger)
+            return 0
+        else:
             with maybe_profile(args.profile_dir):
                 value, remoteness, table = solve_module(module)
             elapsed = time.perf_counter() - t0
@@ -197,24 +267,7 @@ def main(argv=None) -> int:
         )
     with maybe_profile(args.profile_dir):
         result = solver.solve()
-    elapsed = time.perf_counter() - t0
-
-    print(f"game: {game.name}")
-    print(f"devices: {args.devices}")
-    print(f"positions: {result.num_positions}")
-    print(f"value: {value_name(result.value)}")
-    print(f"remoteness: {result.remoteness}")
-    print(f"elapsed: {elapsed:.3f}s")
-    print(
-        f"throughput: {result.stats['positions_per_sec']:.0f} positions/sec"
-    )
-    if args.table_out:
-        from gamesmanmpi_tpu.utils.checkpoint import save_result_npz
-
-        save_result_npz(args.table_out, result)
-        print(f"table written: {args.table_out}")
-    if logger is not None:
-        logger.close()
+    _report(result, args.devices, time.perf_counter() - t0, args, logger)
     return 0
 
 

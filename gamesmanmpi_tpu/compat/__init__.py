@@ -17,7 +17,13 @@ SURVEY.md §2.1.1) can be:
 from gamesmanmpi_tpu.compat.shim import (
     load_game_module,
     solve_module,
+    solve_module_jitted,
     TensorizedModule,
 )
 
-__all__ = ["load_game_module", "solve_module", "TensorizedModule"]
+__all__ = [
+    "load_game_module",
+    "solve_module",
+    "solve_module_jitted",
+    "TensorizedModule",
+]

@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from gamesmanmpi_tpu.core.bitops import SENTINEL
+from gamesmanmpi_tpu.core.bitops import SENTINEL64 as SENTINEL
 from gamesmanmpi_tpu.core.values import UNDECIDED
 from gamesmanmpi_tpu.games.base import TensorGame
 from gamesmanmpi_tpu.solve.oracle import (
@@ -35,6 +35,75 @@ from gamesmanmpi_tpu.solve.oracle import (
     normalize_value,
     oracle_solve,
 )
+
+
+# Bounded BFS probe size for auto-deriving max_moves. Small games are fully
+# explored (exact bound); larger games get an estimate that the grow-and-
+# retry loop in solve_module_jitted corrects. Tests shrink this to force the
+# retry path deterministically.
+_PROBE_LIMIT = 1024
+
+
+def _probe_max_moves(initial, gen, do, prim) -> int:
+    """Max observed branching over a bounded BFS from the initial position."""
+    seen = {int(initial)}
+    frontier = [int(initial)]
+    best = 1
+    while frontier and len(seen) < _PROBE_LIMIT:
+        nxt = []
+        for pos in frontier:
+            if normalize_value(prim(pos)) != UNDECIDED:
+                continue
+            moves = list(gen(pos))
+            best = max(best, len(moves))
+            for m in moves:
+                child = int(do(pos, m))
+                if child not in seen:
+                    seen.add(child)
+                    nxt.append(child)
+                if len(seen) >= _PROBE_LIMIT:
+                    break
+        frontier = nxt
+    return best
+
+
+def solve_module_jitted(module, *, devices: int = 1, max_retries: int = 6,
+                        **kwargs):
+    """Drive an unmodified scalar module through the jitted engine.
+
+    Lifts the module with TensorizedModule (auto-deriving max_moves when the
+    module doesn't declare it) and solves; if a position mid-solve turns out
+    to have more moves than the probe saw, the expand callback raises and
+    this loop doubles max_moves and re-solves (each retry builds a fresh
+    wrapper, so its private kernel cache is dropped with it).
+
+    `level_of` cannot be auto-derived the same way: a topological level
+    function is a *global* invariant of the game graph (every move strictly
+    increases it), and no bounded sample can certify one — so modules must
+    still declare it (or callers pass level_fn=).
+
+    kwargs go to the solver (paranoid=, logger=, checkpointer=, ...).
+    Returns a SolveResult.
+    """
+    game = TensorizedModule(module)
+    for attempt in range(max_retries + 1):
+        if devices > 1:
+            from gamesmanmpi_tpu.parallel import ShardedSolver
+
+            solver = ShardedSolver(game, num_shards=devices, **kwargs)
+        else:
+            from gamesmanmpi_tpu.solve import Solver
+
+            solver = Solver(game, **kwargs)
+        try:
+            return solver.solve()
+        except Exception as e:  # XlaRuntimeError wraps the callback's raise
+            if (
+                "GAMESMAN_MAX_MOVES_OVERFLOW" not in str(e)
+                or attempt == max_retries
+            ):
+                raise
+            game = TensorizedModule(module, max_moves=2 * game.max_moves)
 
 
 def load_game_module(path):
@@ -101,12 +170,12 @@ class TensorizedModule(TensorGame):
         if max_moves is None:
             max_moves = getattr(module, "max_moves", None)
         if max_moves is None:
-            # Guessing from one position would under-size boards where moves
-            # open up later and abort mid-solve from inside pure_callback.
-            raise ValueError(
-                "max_moves is required: pass max_moves= or define max_moves "
-                "in the module (the static [B, M] kernel width)"
-            )
+            # Auto-derive the static [B, M] kernel width by a bounded BFS
+            # probe. Games whose branching grows beyond the probed sample
+            # under-size it; _expand_host then raises a recognizable error
+            # and solve_module_jitted grows max_moves and retries — the
+            # probe-and-grow design BASELINE's "runs unmodified" asks for.
+            max_moves = _probe_max_moves(self._initial, gen, do, prim)
         self.max_moves = int(max_moves)
         self.max_level_jump = int(
             max_level_jump or getattr(module, "max_level_jump", 1)
@@ -135,9 +204,13 @@ class TensorizedModule(TensorGame):
                 continue
             moves = list(self._gen(pos))
             if len(moves) > self.max_moves:
+                # The unique token is the retry contract with
+                # solve_module_jitted (exception types don't survive the
+                # callback boundary; generic words like "max_moves" could
+                # collide with a game module's own error text).
                 raise ValueError(
-                    f"position {pos:#x} has {len(moves)} moves > "
-                    f"max_moves={self.max_moves}; raise max_moves"
+                    f"GAMESMAN_MAX_MOVES_OVERFLOW: position {pos:#x} has "
+                    f"{len(moves)} moves > max_moves={self.max_moves}"
                 )
             for j, m in enumerate(moves):
                 kids[i, j] = self._do(pos, m)

@@ -15,7 +15,14 @@ from gamesmanmpi_tpu.core.values import (
 )
 from gamesmanmpi_tpu.core.codec import pack_cells, unpack_cells
 from gamesmanmpi_tpu.core.hashing import splitmix64, owner_shard
-from gamesmanmpi_tpu.core.bitops import popcount64, msb_index64, SENTINEL
+from gamesmanmpi_tpu.core.bitops import (
+    SENTINEL32,
+    SENTINEL64,
+    popcount,
+    msb_index,
+    sentinel_for,
+    state_dtype_for,
+)
 
 __all__ = [
     "WIN",
@@ -29,7 +36,10 @@ __all__ = [
     "unpack_cells",
     "splitmix64",
     "owner_shard",
-    "popcount64",
-    "msb_index64",
-    "SENTINEL",
+    "popcount",
+    "msb_index",
+    "sentinel_for",
+    "state_dtype_for",
+    "SENTINEL32",
+    "SENTINEL64",
 ]

@@ -19,9 +19,6 @@ import numpy as np
 SENTINEL64 = np.uint64(0xFFFF_FFFF_FFFF_FFFF)
 SENTINEL32 = np.uint32(0xFFFF_FFFF)
 
-# Back-compat alias (pre-dtype code paths; uint64 default).
-SENTINEL = SENTINEL64
-
 U64_ONE = np.uint64(1)
 
 
@@ -61,11 +58,3 @@ def msb_index(x):
     return (width - 1) - jax.lax.clz(x).astype(jnp.int32)
 
 
-def popcount64(x):
-    """Population count of a uint64 array (back-compat wrapper)."""
-    return popcount(jnp.asarray(x, jnp.uint64))
-
-
-def msb_index64(x):
-    """MSB index of a uint64 array (back-compat wrapper)."""
-    return msb_index(jnp.asarray(x, jnp.uint64))

@@ -39,7 +39,12 @@ def get_game(spec: str) -> TensorGame:
     kw = _parse_kwargs(rest)
     name = name.strip().lower()
     def _flag(key):
-        return kw.get(key, "0") not in ("0", "false", "False", "")
+        v = kw.get(key, "0").strip().lower()
+        if v in ("0", "false", "no", "off", ""):
+            return False
+        if v in ("1", "true", "yes", "on"):
+            return True
+        raise ValueError(f"bad boolean for {key!r} in spec {spec!r}: {v!r}")
 
     if name in ("tictactoe", "ttt", "mnk"):
         return TicTacToe(

@@ -43,7 +43,9 @@ class TensorGame(abc.ABC):
     name: str = "game"
     #: static maximum number of moves from any position (M in [B, M] kernels)
     max_moves: int
-    #: upper bound (exclusive) on level_of over reachable states
+    #: upper bound (exclusive) on level_of over reachable states; the engines
+    #: enforce it during forward discovery (a broken level_of would otherwise
+    #: loop forever) and use it for capacity planning
     num_levels: int
     #: max of level_of(child) - level_of(parent) over all moves
     max_level_jump: int = 1

@@ -202,12 +202,26 @@ def resolve_level(game: TensorGame, states, window):
     return values, remoteness, misses
 
 
-# Device-resident level store budget for the fast path (bytes of packed
-# states kept on device between the forward and backward phases; levels past
-# the budget are spilled to host and re-uploaded during backward).
-_DEVICE_STORE_BYTES = int(
-    os.environ.get("GAMESMAN_DEVICE_STORE_MB", "2048")
-) * (1 << 20)
+def _device_store_bytes() -> int:
+    """Device-resident level-store budget for the fast path (bytes of packed
+    states kept on device between the forward and backward phases; levels
+    past the budget are spilled to host and re-uploaded during backward).
+
+    Read lazily per Solver so a malformed GAMESMAN_DEVICE_STORE_MB degrades
+    to the default with a warning instead of breaking package import, and so
+    the knob can change between Solver instances.
+    """
+    raw = os.environ.get("GAMESMAN_DEVICE_STORE_MB", "2048")
+    try:
+        mb = int(raw)
+    except ValueError:
+        import warnings
+
+        warnings.warn(
+            f"GAMESMAN_DEVICE_STORE_MB={raw!r} is not an integer; using 2048"
+        )
+        mb = 2048
+    return mb << 20
 
 
 class _Level:
@@ -245,6 +259,7 @@ class Solver:
         self.logger = logger
         self.checkpointer = checkpointer
         self.fast = bool(game.uniform_level_jump) and not force_generic
+        self.device_store_bytes = _device_store_bytes()
 
     # ---------------------------------------------------------------- kernels
 
@@ -310,6 +325,16 @@ class Solver:
             n = int(count)  # the one host sync per level
             if n == 0:
                 break
+            if k + 1 >= g.num_levels:
+                # num_levels is the declared exclusive bound on level_of over
+                # reachable states; children past it mean the game's
+                # level_of/num_levels contract is broken (and, unchecked,
+                # a buggy level function could loop forever here).
+                raise SolverError(
+                    f"game {g.name}: children found at level {k + 1} but "
+                    f"num_levels={g.num_levels} — level_of/num_levels "
+                    "inconsistent"
+                )
             next_cap = bucket_size(n, self.min_bucket)
             if next_cap <= uniq.shape[0]:
                 nxt = jax.lax.slice(uniq, (0,), (next_cap,))
@@ -327,7 +352,7 @@ class Solver:
                     ]
                 )
             rec = _Level(n, None, nxt)
-            if stored_bytes + nxt.nbytes > _DEVICE_STORE_BYTES:
+            if stored_bytes + nxt.nbytes > self.device_store_bytes:
                 # Device-store budget exhausted: keep this level on host only
                 # (backward re-uploads it); the live frontier still chains on
                 # device.
@@ -444,6 +469,12 @@ class Solver:
             kid_levels = np.asarray(levels[:n])
             for lv in np.unique(kid_levels):
                 lv = int(lv)
+                if lv >= g.num_levels:
+                    raise SolverError(
+                        f"game {g.name}: children found at level {lv} but "
+                        f"num_levels={g.num_levels} — level_of/num_levels "
+                        "inconsistent"
+                    )
                 batch = kids[kid_levels == lv]
                 if lv in pools:
                     pools[lv] = np.union1d(pools[lv], batch)

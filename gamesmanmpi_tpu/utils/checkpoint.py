@@ -86,8 +86,10 @@ class LevelCheckpointer:
     # SURVEY.md §5.4 — the backward phase then loads completed levels).
 
     def save_frontiers(self, pools) -> None:
+        # Frontiers keep the game's state dtype (uint32 games stay uint32 —
+        # at north-star scale the snapshot is the biggest file on disk).
         arrays = {
-            f"level_{k:04d}": np.asarray(v, np.uint64) for k, v in pools.items()
+            f"level_{k:04d}": np.asarray(v) for k, v in pools.items()
         }
         np.savez_compressed(self.dir / "frontiers.npz", **arrays)
         manifest = self.load_manifest()
@@ -95,7 +97,7 @@ class LevelCheckpointer:
         self.manifest_path.write_text(json.dumps(manifest))
 
     def load_frontiers(self):
-        """-> {level: sorted uint64 states} or None if no snapshot exists."""
+        """-> {level: sorted packed states} or None if no snapshot exists."""
         if not self.load_manifest().get("frontiers"):
             return None
         path = self.dir / "frontiers.npz"

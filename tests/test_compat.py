@@ -51,6 +51,74 @@ def test_tensorized_module_through_jit_engine():
     assert_table_parity(result, oracle_table)
 
 
+def test_auto_max_moves_probe():
+    """A module with no max_moves gets it derived by the BFS probe."""
+    module = load_game_module(REF_GAMES / "ten_to_zero.py")
+    game = TensorizedModule(
+        module,
+        level_fn=lambda pos: module.initial_position - pos,
+        max_level_jump=2,
+        num_levels=11,
+    )
+    assert game.max_moves == 2  # 10-to-0 is fully explored by the probe
+    result = Solver(game, paranoid=True).solve()
+    assert result.value == WIN
+
+
+def _branchy_module():
+    """Branching explodes past the probe sample: 0->1->...->6, then six
+    moves from 6; primitive at >= 7."""
+    import types
+
+    m = types.ModuleType("branchy")
+    m.initial_position = 0
+    m.gen_moves = lambda pos: [1] if pos < 6 else list(range(1, 7))
+    m.do_move = lambda pos, mv: pos + mv
+    m.primitive = lambda pos: "LOSE" if pos >= 7 else "UNDECIDED"
+    m.level_of = lambda pos: pos
+    m.max_level_jump = 6
+    m.num_levels = 14
+    return m
+
+
+def test_auto_max_moves_grow_and_retry(monkeypatch):
+    """When the probe under-samples, solve_module_jitted must grow max_moves
+    and re-solve instead of failing (BASELINE "runs unmodified")."""
+    import gamesmanmpi_tpu.compat.shim as shim
+
+    module = _branchy_module()
+    monkeypatch.setattr(shim, "_PROBE_LIMIT", 4)
+    # The under-sized wrapper really is under-sized (retry must fire).
+    assert TensorizedModule(module).max_moves == 1
+    result = shim.solve_module_jitted(module)
+    assert result.value == WIN  # position 6 moves straight to a LOSE
+    assert result.remoteness == 7
+    assert result.num_positions == 13  # 0..12
+
+
+def test_tensorized_module_sharded_multidevice():
+    """Host callbacks under shard_map/all_to_all with devices>1: the
+    unmodified-module path through the ShardedSolver, table parity vs the
+    host oracle."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 (fake) devices")
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+
+    module = load_game_module(REF_GAMES / "ten_to_zero.py")
+    game = TensorizedModule(
+        module,
+        level_fn=lambda pos: module.initial_position - pos,
+        max_level_jump=2,
+        num_levels=11,
+    )
+    result = ShardedSolver(game, num_shards=2, paranoid=True).solve()
+    _, _, oracle_table = solve_module(module)
+    assert result.value == WIN
+    assert_table_parity(result, oracle_table)
+
+
 def test_tensorized_module_tictactoe():
     module = load_game_module(REF_GAMES / "tictactoe.py")
     game = TensorizedModule(

@@ -13,9 +13,9 @@ from gamesmanmpi_tpu.core import (
     unpack_cells,
     owner_shard,
     splitmix64,
-    popcount64,
-    msb_index64,
-    SENTINEL,
+    popcount,
+    msb_index,
+    SENTINEL64,
 )
 from gamesmanmpi_tpu.core.hashing import owner_shard_np
 from gamesmanmpi_tpu.core.values import MAX_REMOTENESS
@@ -62,6 +62,6 @@ def test_owner_shard_total_and_deterministic():
 
 
 def test_bitops():
-    xs = jnp.asarray(np.array([1, 2, 3, 2**40, SENTINEL], dtype=np.uint64))
-    assert list(np.asarray(popcount64(xs))) == [1, 1, 2, 1, 64]
-    assert list(np.asarray(msb_index64(xs))) == [0, 1, 1, 40, 63]
+    xs = jnp.asarray(np.array([1, 2, 3, 2**40, SENTINEL64], dtype=np.uint64))
+    assert list(np.asarray(popcount(xs))) == [1, 1, 2, 1, 64]
+    assert list(np.asarray(msb_index(xs))) == [0, 1, 1, 40, 63]

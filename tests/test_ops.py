@@ -3,7 +3,7 @@
 import jax.numpy as jnp
 import numpy as np
 
-from gamesmanmpi_tpu.core.bitops import SENTINEL
+from gamesmanmpi_tpu.core.bitops import SENTINEL64 as SENTINEL
 from gamesmanmpi_tpu.core.values import WIN, LOSE, TIE, UNDECIDED
 from gamesmanmpi_tpu.ops import (
     bucket_size,

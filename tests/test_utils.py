@@ -147,14 +147,16 @@ def test_paranoid_catches_zero_move_undecided():
         Solver(BrokenGame(total=4, moves=(1, 2)), paranoid=True).solve()
 
 
-def test_tensorized_module_requires_max_moves():
+def test_tensorized_module_requires_level_fn():
+    """level_of cannot be auto-derived (a global invariant, see
+    compat.solve_module_jitted); max_moves CAN (probe + grow-and-retry)."""
     import pytest
 
     from gamesmanmpi_tpu.compat import TensorizedModule, load_game_module
 
     module = load_game_module(REF_GAMES / "ten_to_zero.py")
-    with pytest.raises(ValueError, match="max_moves"):
-        TensorizedModule(module, level_fn=lambda p: 10 - p)
+    with pytest.raises(ValueError, match="level"):
+        TensorizedModule(module)
 
 
 def test_cli_compat_warns_on_unsupported_flags(tmp_path, capsys):
@@ -195,3 +197,37 @@ def test_cli_tensorized_compat_module(tmp_path, capsys):
     assert "value: WIN" in captured.out
     assert "remoteness: 7" in captured.out
     assert "warning" not in captured.err
+
+
+def test_cli_coordinator_flag_plumbing(monkeypatch, capsys):
+    """--coordinator must drive jax.distributed.initialize (mocked) before
+    the solve, with the CLI's process-group arguments passed through."""
+    import gamesmanmpi_tpu.parallel.mesh as mesh_mod
+
+    calls = {}
+
+    def fake_init(**kwargs):
+        calls.update(kwargs)
+
+    monkeypatch.setattr(mesh_mod.jax.distributed, "initialize", fake_init)
+    rc = cli_main(
+        [
+            "subtract:total=6,moves=1-2",
+            "--coordinator", "10.0.0.1:8476",
+            "--num-processes", "1",
+            "--process-id", "0",
+        ]
+    )
+    assert rc == 0
+    assert calls == {
+        "coordinator_address": "10.0.0.1:8476",
+        "num_processes": 1,
+        "process_id": 0,
+    }
+
+
+def test_cli_coordinator_requires_process_args(capsys):
+    rc = cli_main(["tictactoe", "--coordinator", "10.0.0.1:8476"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "--num-processes" in captured.err
