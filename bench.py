@@ -109,6 +109,18 @@ def main() -> int:
     import gamesmanmpi_tpu  # noqa: F401  (enables x64 before first trace)
     import jax
 
+    # Persistent compilation cache: round 1 showed first-run compiles
+    # dominating (143k vs 813k pos/s run 0 vs 1). The cache dir lives in the
+    # repo, so later benchmark rounds on the same platform skip compiles.
+    cache_dir = os.environ.get(
+        "GAMESMAN_COMPILE_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_compile_cache"),
+    )
+    if cache_dir != "0":
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
     from gamesmanmpi_tpu.games import get_game
     from gamesmanmpi_tpu.solve import Solver
 

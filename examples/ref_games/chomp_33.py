@@ -1,0 +1,52 @@
+"""3x3 Chomp as a reference-style scalar module (SURVEY.md §2.1.1 API).
+
+Same packing as gamesmanmpi_tpu.games.chomp.Chomp(3, 3): column heights at
+2 bits each, little-endian — so oracle tables compare position-for-position
+with the tensorized game. Declares level_of, so the compat shim can drive
+the jitted engine too (max_moves is auto-derived).
+"""
+
+W, H = 3, 3
+BITS = 2  # heights 0..3
+
+
+def _heights(pos):
+    return [(pos >> (c * BITS)) & ((1 << BITS) - 1) for c in range(W)]
+
+
+def _pack(heights):
+    out = 0
+    for c, h in enumerate(heights):
+        out |= h << (c * BITS)
+    return out
+
+
+initial_position = _pack([H] * W)
+
+
+def gen_moves(pos):
+    hs = _heights(pos)
+    return [
+        (c, r)
+        for c in range(W)
+        for r in range(H)
+        if (c, r) != (0, 0) and hs[c] > r
+    ]
+
+
+def do_move(pos, move):
+    c, r = move
+    hs = _heights(pos)
+    return _pack([min(h, r) if i >= c else h for i, h in enumerate(hs)])
+
+
+def primitive(pos):
+    return "LOSE" if pos == 1 else "UNDECIDED"
+
+
+def level_of(pos):
+    return W * H - sum(_heights(pos))
+
+
+max_level_jump = W * H - 1
+num_levels = W * H

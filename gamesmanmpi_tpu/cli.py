@@ -241,8 +241,9 @@ def main(argv=None) -> int:
             print(
                 "known games: tictactoe[:m=,n=,k=,sym=], "
                 "connect4[:w=,h=,k=,sym=], subtract[:total=,moves=,misere=], "
-                "nim[:heaps=,misere=] — or a path to a reference-style game "
-                "module file (sym=1 enables board-symmetry reduction)",
+                "nim[:heaps=,misere=], chomp[:w=,h=] — or a path to a "
+                "reference-style game module file (sym=1 enables "
+                "board-symmetry reduction)",
                 file=sys.stderr,
             )
             return 2

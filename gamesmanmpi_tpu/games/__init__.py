@@ -17,6 +17,7 @@ from gamesmanmpi_tpu.games.tictactoe import TicTacToe
 from gamesmanmpi_tpu.games.subtract import Subtract
 from gamesmanmpi_tpu.games.nim import Nim
 from gamesmanmpi_tpu.games.connect4 import Connect4
+from gamesmanmpi_tpu.games.chomp import Chomp
 
 
 def _parse_kwargs(spec: str) -> dict:
@@ -69,7 +70,20 @@ def get_game(spec: str) -> TensorGame:
             heaps=_intlist(kw.get("heaps", "3-4-5")),
             misere=_flag("misere"),
         )
+    if name == "chomp":
+        return Chomp(
+            width=int(kw.get("w", kw.get("width", 4))),
+            height=int(kw.get("h", kw.get("height", 3))),
+        )
     raise KeyError(f"unknown game spec {spec!r}")
 
 
-__all__ = ["TensorGame", "TicTacToe", "Subtract", "Nim", "Connect4", "get_game"]
+__all__ = [
+    "TensorGame",
+    "TicTacToe",
+    "Subtract",
+    "Nim",
+    "Connect4",
+    "Chomp",
+    "get_game",
+]

@@ -1,0 +1,120 @@
+"""Chomp: the poisoned-cookie game, tensorized.
+
+A GamesCrafters classic in the same family as the reference's shipped
+teaching games (SURVEY.md §2.2 games/ dir; the reference's game modules are
+interchangeable plugins, so widening the catalog is parity work, not scope
+creep). Rules: a width x height bar of cookies; a move picks a remaining
+cookie and eats it together with every cookie above and to the right; the
+bottom-left cookie is poisoned, and the player forced to eat it — it is the
+only one left — loses (primitive LOSE at the poison-only position; eating
+poison voluntarily is never legal here, which is the standard normal-play
+formulation). Strategy stealing makes every board larger than 1x1 a
+first-player WIN, the closed-form check the tests use.
+
+State encoding: the remaining cookies always form a staircase (downward-
+closed) region, so the position is exactly the vector of column heights
+h_0 >= h_1 >= ... >= h_{w-1}, packed little-endian at bit_length(height)
+bits per column — 7x7 fits 21 bits (uint32). A move at (col c, row r)
+clamps every column >= c to height r: one vectorized min over the height
+lane, unrolled over the static move list (w*h-1 moves).
+
+Moves eat 1..w*h-1 cookies, so levels (cookies eaten) jump arbitrarily —
+this is a generic-path (multi-jump) game like the subtraction family, and
+the widest-M game in the catalog (kernel width w*h-1).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from gamesmanmpi_tpu.core.values import LOSE, UNDECIDED
+from gamesmanmpi_tpu.games.base import TensorGame
+
+
+class Chomp(TensorGame):
+    def __init__(self, width: int = 4, height: int = 3):
+        if width < 1 or height < 1:
+            raise ValueError("board must be at least 1x1")
+        self.w = int(width)
+        self.h = int(height)
+        self.bits = max(int(self.h).bit_length(), 1)  # heights 0..h
+        self.state_bits = self.bits * self.w
+        if self.state_bits > 63:
+            raise ValueError(f"board too large to pack: {width}x{height}")
+        self.name = f"chomp_{width}x{height}"
+        # Static move list: every cell but the poisoned (0, 0).
+        self._moves = [
+            (c, r)
+            for c in range(self.w)
+            for r in range(self.h)
+            if (c, r) != (0, 0)
+        ]
+        self.max_moves = max(len(self._moves), 1)
+        self.num_levels = self.w * self.h
+        self.max_level_jump = max(self.w * self.h - 1, 1)
+        self.uniform_level_jump = False
+
+    # -------------------------------------------------------------- packing
+
+    def _heights(self, states):
+        """[B] packed -> [B, w] int32 column heights."""
+        dt = self.state_dtype
+        mask = dt((1 << self.bits) - 1)
+        cols = [
+            ((states >> dt(c * self.bits)) & mask).astype(jnp.int32)
+            for c in range(self.w)
+        ]
+        return jnp.stack(cols, axis=-1)
+
+    def _pack(self, heights):
+        """[B, w] int32 -> [B] packed."""
+        dt = self.state_dtype
+        out = jnp.zeros(heights.shape[:-1], dtype=dt)
+        for c in range(self.w):
+            out = out | (heights[..., c].astype(dt) << dt(c * self.bits))
+        return out
+
+    # -------------------------------------------------------------- protocol
+
+    def initial_state(self):
+        packed = 0
+        for c in range(self.w):
+            packed |= self.h << (c * self.bits)
+        return self.state_dtype(packed)
+
+    def expand(self, states):
+        if not self._moves:  # 1x1 board: poison only, no legal moves ever
+            shape = states.shape + (1,)
+            return (
+                jnp.full(shape, self.sentinel, dtype=states.dtype),
+                jnp.zeros(shape, dtype=bool),
+            )
+        hs = self._heights(states)  # [B, w]
+        col_idx = jnp.arange(self.w)
+        children = []
+        masks = []
+        for c, r in self._moves:
+            legal = hs[..., c] > r
+            clamped = jnp.where(col_idx >= c, jnp.minimum(hs, r), hs)
+            children.append(self._pack(clamped))
+            masks.append(legal)
+        return jnp.stack(children, axis=-1), jnp.stack(masks, axis=-1)
+
+    def primitive(self, states):
+        # Poison-only board: h = (1, 0, ..., 0), packed == 1.
+        return jnp.where(
+            states == self.state_dtype(1),
+            jnp.uint8(LOSE),
+            jnp.uint8(UNDECIDED),
+        )
+
+    def level_of(self, states):
+        return self.w * self.h - jnp.sum(self._heights(states), axis=-1)
+
+    def describe(self, state) -> str:
+        hs = [
+            (int(state) >> (c * self.bits)) & ((1 << self.bits) - 1)
+            for c in range(self.w)
+        ]
+        return f"{self.name} heights={hs}"

@@ -75,6 +75,7 @@ from gamesmanmpi_tpu.solve.engine import (
     LevelTable,
     SolveResult,
     SolverError,
+    _backward_block,
     _device_store_bytes,
     canonical_children,
     canonical_scalar,
@@ -254,6 +255,7 @@ class ShardedSolver:
         self.checkpointer = checkpointer
         self.fast = bool(game.uniform_level_jump) and not force_generic
         self.device_store_bytes = _device_store_bytes()
+        self.backward_block = _backward_block()
         #: number of capacity-overflow retries taken (forward + backward);
         #: the observable for the spill-path tests.
         self.spill_retries = 0
@@ -514,6 +516,53 @@ class ShardedSolver:
             for k, shards in pools.items()
         }
 
+    def _run_backward_step(self, stacked, cap: int, window_caps: tuple,
+                           window_flat) -> tuple:
+        """One backward kernel call with the qcap overflow-retry loop."""
+        qcap = self._initial_route_cap(cap) if window_caps else 0
+        while True:
+            values, rem, misses, qcounts = self._backward_fn(
+                cap, window_caps, qcap
+            )(stacked, *window_flat)
+            if qcap == 0:
+                break
+            max_sent = int(np.asarray(qcounts).max())
+            if max_sent <= qcap:
+                break
+            self.spill_retries += 1
+            qcap = bucket_size(max_sent)
+        return values, rem, misses
+
+    def _resolve_blocked(self, stacked, window_caps: tuple, window_flat):
+        """Backward-resolve a level, in column blocks when it is wide.
+
+        Per-shard temporaries (child blocks, routing buffers) scale with
+        the block, not the level — the HBM bound the 6x6/6x7 capacity plan
+        relies on (docs/ARCHITECTURE.md). The window stays whole: it is
+        13 B/position, the budget the plan is written against.
+        """
+        cap = stacked.shape[1]
+        block = self.backward_block
+        if cap <= block:
+            return self._run_backward_step(stacked, cap, window_caps,
+                                           window_flat)
+        values, rems = [], []
+        misses = None
+        for off in range(0, cap, block):
+            v, r, m = self._run_backward_step(
+                stacked[:, off : off + block], block, window_caps,
+                window_flat,
+            )
+            values.append(v)
+            rems.append(r)
+            # Device-side accumulation; synced only under --paranoid.
+            misses = m if misses is None else misses + m
+        return (
+            jnp.concatenate(values, axis=1),
+            jnp.concatenate(rems, axis=1),
+            misses,
+        )
+
     def _repartition(self, states: np.ndarray) -> List[np.ndarray]:
         """Split a sorted global state array into per-shard sorted arrays."""
         owners = owner_shard_np(states, self.S)
@@ -586,18 +635,9 @@ class ShardedSolver:
                 window_flat = []
                 for L in window_levels:
                     window_flat.extend(dev_cache[L])
-                qcap = self._initial_route_cap(cap) if window_levels else 0
-                while True:
-                    values_dev, rem_dev, misses, qcounts = self._backward_fn(
-                        cap, window_caps, qcap
-                    )(rec.dev, *window_flat)
-                    if qcap == 0:
-                        break
-                    max_sent = int(np.asarray(qcounts).max())
-                    if max_sent <= qcap:
-                        break
-                    self.spill_retries += 1
-                    qcap = bucket_size(max_sent)
+                values_dev, rem_dev, misses = self._resolve_blocked(
+                    rec.dev, window_caps, window_flat
+                )
                 if self.paranoid and int(np.asarray(misses).sum()) > 0:
                     raise SolverError(
                         f"level {k}: consistency failures (missed child "

@@ -202,26 +202,40 @@ def resolve_level(game: TensorGame, states, window):
     return values, remoteness, misses
 
 
-def _device_store_bytes() -> int:
-    """Device-resident level-store budget for the fast path (bytes of packed
-    states kept on device between the forward and backward phases; levels
-    past the budget are spilled to host and re-uploaded during backward).
-
-    Read lazily per Solver so a malformed GAMESMAN_DEVICE_STORE_MB degrades
-    to the default with a warning instead of breaking package import, and so
-    the knob can change between Solver instances.
-    """
-    raw = os.environ.get("GAMESMAN_DEVICE_STORE_MB", "2048")
+def _env_int(name: str, default: int) -> int:
+    """Read an integer env knob lazily; malformed values degrade to the
+    default with a warning instead of breaking package import."""
+    raw = os.environ.get(name, str(default))
     try:
-        mb = int(raw)
+        return int(raw)
     except ValueError:
         import warnings
 
-        warnings.warn(
-            f"GAMESMAN_DEVICE_STORE_MB={raw!r} is not an integer; using 2048"
-        )
-        mb = 2048
-    return mb << 20
+        warnings.warn(f"{name}={raw!r} is not an integer; using {default}")
+        return default
+
+
+def _backward_block() -> int:
+    """Max positions resolved per backward kernel call (per shard).
+
+    The backward step's temporaries scale with cap*max_moves (child blocks,
+    lookup gathers, routing buffers in the sharded solver); levels wider
+    than this are processed in column blocks against the same window, so
+    peak memory is bounded by the block, not the level. Power-of-two,
+    lazily read from GAMESMAN_BACKWARD_BLOCK (positions; 0 = unbounded,
+    never block).
+    """
+    n = _env_int("GAMESMAN_BACKWARD_BLOCK", 1 << 21)
+    if n <= 0:
+        return 1 << 62  # unbounded
+    return max(256, 1 << (n - 1).bit_length())
+
+
+def _device_store_bytes() -> int:
+    """Device-resident level-store budget for the fast path (bytes of packed
+    states kept on device between the forward and backward phases; levels
+    past the budget are spilled to host and re-uploaded during backward)."""
+    return _env_int("GAMESMAN_DEVICE_STORE_MB", 2048) << 20
 
 
 class _Level:
@@ -260,6 +274,7 @@ class Solver:
         self.checkpointer = checkpointer
         self.fast = bool(game.uniform_level_jump) and not force_generic
         self.device_store_bytes = _device_store_bytes()
+        self.backward_block = _backward_block()
 
     # ---------------------------------------------------------------- kernels
 
@@ -304,6 +319,31 @@ class Solver:
             return f
 
         return get_kernel(self.game, "bwd", (cap, tuple(wcaps)), build)
+
+    def _resolve_blocked(self, states_dev, wcaps: tuple, window_args: tuple):
+        """Backward-resolve a level, in column blocks when it is wide.
+
+        Levels wider than `backward_block` run the same kernel per block
+        against the same window — peak temporaries are bounded by the block
+        (SURVEY.md §7 "Memory budget"); results concatenate on device.
+        """
+        cap = states_dev.shape[0]
+        block = self.backward_block
+        if cap <= block:
+            return self._bwd(cap, wcaps)(states_dev, *window_args)
+        values, rems = [], []
+        misses = None
+        for off in range(0, cap, block):
+            v, r, m = self._bwd(block, wcaps)(
+                jax.lax.slice(states_dev, (off,), (off + block,)),
+                *window_args,
+            )
+            values.append(v)
+            rems.append(r)
+            # Accumulate on device — callers sync the total only under
+            # --paranoid, so block dispatch never serializes on the host.
+            misses = m if misses is None else misses + m
+        return jnp.concatenate(values), jnp.concatenate(rems), misses
 
     # ------------------------------------------------------------- fast phase
 
@@ -416,8 +456,8 @@ class Solver:
                 else:
                     args = prev
                     wcaps = (prev[0].shape[0],)
-                values_dev, rem_dev, misses = self._bwd(cap, wcaps)(
-                    states_dev, *args
+                values_dev, rem_dev, misses = self._resolve_blocked(
+                    states_dev, wcaps, args
                 )
                 if self.paranoid and int(misses) > 0:
                     raise SolverError(
@@ -531,9 +571,10 @@ class Solver:
                 for L in window_levels:
                     window_flat.extend(padded_cache[L])
                 wcaps = tuple(padded_cache[L][0].shape[0] for L in window_levels)
-                values_dev, rem_dev, misses = self._bwd(
-                    padded.shape[0], wcaps
-                )(jnp.asarray(padded), *[jnp.asarray(a) for a in window_flat])
+                values_dev, rem_dev, misses = self._resolve_blocked(
+                    jnp.asarray(padded), wcaps,
+                    tuple(jnp.asarray(a) for a in window_flat),
+                )
                 if self.paranoid and int(misses) > 0:
                     raise SolverError(
                         f"level {k}: {int(misses)} consistency failures (child "

@@ -72,3 +72,31 @@ def test_result_lookup():
     with pytest.raises(KeyError):
         # An unreachable "position": both players on the same cell.
         result.lookup(np.uint64(1 | (1 << 9)))
+
+
+def test_blocked_backward_parity():
+    """Wide levels resolved in column blocks (GAMESMAN_BACKWARD_BLOCK bound)
+    must produce the identical table."""
+    from helpers import full_table
+
+    base = Solver(get_game("tictactoe")).solve()
+    blocked = Solver(get_game("tictactoe"), paranoid=True)
+    blocked.backward_block = 256  # well below the widest level's capacity
+    result = blocked.solve()
+    assert full_table(result) == full_table(base)
+
+
+def test_chomp_parity_and_strategy_stealing():
+    """Chomp 3x3: full-table oracle parity; every board >1x1 is a
+    first-player WIN (strategy stealing), the closed-form anchor."""
+    result, oracle_table = _solve_both("chomp:w=3,h=3", "chomp_33.py")
+    assert result.value == WIN
+    assert_table_parity(result, oracle_table)
+
+
+def test_chomp_boards_win_and_1x1_loses():
+    assert Solver(get_game("chomp:w=4,h=3")).solve().value == WIN
+    assert Solver(get_game("chomp:w=2,h=2")).solve().value == WIN
+    # 1x1 is the poison-only position itself: primitive LOSE, remoteness 0.
+    r = Solver(get_game("chomp:w=1,h=1")).solve()
+    assert r.value == LOSE and r.remoteness == 0

@@ -40,6 +40,7 @@ CASES = [
     ("subtract:total=10,moves=1-2", "ten_to_zero.py"),
     ("nim:heaps=3-4-5", "nim_345.py"),
     ("connect4:w=4,h=4", "connect4_4x4.py"),
+    ("chomp:w=3,h=3", "chomp_33.py"),
 ]
 
 

@@ -67,3 +67,12 @@ def test_route_capacity_spill_path(spec):
     assert result.value == single.value
     assert result.remoteness == single.remoteness
     assert full_table(result) == full_table(single)
+
+
+def test_sharded_blocked_backward_parity():
+    """Column-blocked owner-routed backward: same tables, bounded temporaries."""
+    single = Solver(get_game("tictactoe")).solve()
+    solver = ShardedSolver(get_game("tictactoe"), num_shards=8, paranoid=True)
+    solver.backward_block = 256
+    result = solver.solve()
+    assert full_table(result) == full_table(single)
