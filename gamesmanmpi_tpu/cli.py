@@ -71,6 +71,16 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="dump the full solved table as .npz (packed cells per level)",
     )
+    p.add_argument(
+        "--query",
+        action="append",
+        default=None,
+        metavar="POS",
+        help="after solving, also print the value/remoteness of this packed "
+        "position (decimal or 0x-hex; repeatable). Queries are "
+        "canonicalized, so symmetry-reduced solves answer for any class "
+        "member",
+    )
     # Multi-host bring-up (SURVEY.md §5.8 control plane): one process per
     # host, jax.distributed over DCN, mesh over all addressable devices.
     # docs/ARCHITECTURE.md "Multi-host launch" shows a v4-32 example.
@@ -114,6 +124,19 @@ def _report(result, devices: int, elapsed: float, args, logger) -> None:
 
         save_result_npz(args.table_out, result)
         print(f"table written: {args.table_out}")
+    for q in args.query or ():
+        # The reference prints only the root; point queries answer for any
+        # reachable position from the solved table (SolveResult.lookup
+        # canonicalizes, so sym=1 tables answer for all class members).
+        try:
+            value, rem = result.lookup(int(q, 0))
+            print(f"query {q}: value={value_name(value)} remoteness={rem}")
+        except KeyError:
+            print(f"query {q}: not reachable")
+        except (ValueError, OverflowError) as e:
+            # Bad literal / doesn't fit the game's state dtype — report per
+            # query; the solve itself already succeeded.
+            print(f"query {q}: invalid position ({e})")
     if logger is not None:
         logger.close()
 
@@ -219,6 +242,19 @@ def main(argv=None) -> int:
 
                 save_table_npz(args.table_out, table)
                 print(f"table written: {args.table_out}")
+            for q in args.query or ():
+                try:
+                    hit = table.get(int(q, 0))
+                except ValueError as e:
+                    print(f"query {q}: invalid position ({e})")
+                    continue
+                if hit is None:
+                    print(f"query {q}: not reachable")
+                else:
+                    print(
+                        f"query {q}: value={value_name(hit[0])} "
+                        f"remoteness={hit[1]}"
+                    )
             if logger is not None:
                 logger.log(
                     {

@@ -8,6 +8,7 @@ Spec grammar: "name" or "name:key=value,key=value", e.g.
     tictactoe            tictactoe:m=4,n=4,k=4
     connect4:w=5,h=4     subtract:total=10,moves=1-2,misere=1
     nim:heaps=3-4-5      nim:heaps=1-2-10,misere=1
+    chomp:w=4,h=3        chomp:w=3,h=3,sym=1
 """
 
 from __future__ import annotations
@@ -74,6 +75,7 @@ def get_game(spec: str) -> TensorGame:
         return Chomp(
             width=int(kw.get("w", kw.get("width", 4))),
             height=int(kw.get("h", kw.get("height", 3))),
+            sym=_flag("sym"),
         )
     raise KeyError(f"unknown game spec {spec!r}")
 

@@ -33,16 +33,20 @@ from gamesmanmpi_tpu.games.base import TensorGame
 
 
 class Chomp(TensorGame):
-    def __init__(self, width: int = 4, height: int = 3):
+    def __init__(self, width: int = 4, height: int = 3, sym: bool = False):
         if width < 1 or height < 1:
             raise ValueError("board must be at least 1x1")
         self.w = int(width)
         self.h = int(height)
+        self.sym = bool(sym)
+        if self.sym and self.w != self.h:
+            raise ValueError("sym=1 (transpose symmetry) needs a square board")
         self.bits = max(int(self.h).bit_length(), 1)  # heights 0..h
         self.state_bits = self.bits * self.w
         if self.state_bits > 63:
             raise ValueError(f"board too large to pack: {width}x{height}")
-        self.name = f"chomp_{width}x{height}"
+        suffix = "_sym" if self.sym else ""
+        self.name = f"chomp_{width}x{height}{suffix}"
         # Static move list: every cell but the poisoned (0, 0).
         self._moves = [
             (c, r)
@@ -74,6 +78,24 @@ class Chomp(TensorGame):
         for c in range(self.w):
             out = out | (heights[..., c].astype(dt) << dt(c * self.bits))
         return out
+
+    def canonicalize(self, states):
+        """Transpose-class representative (square boards, sym=1).
+
+        Chomp is self-dual under transposing the staircase (the poison cell
+        (0,0) is fixed), so value/remoteness are invariant within a class.
+        The transposed height vector is h'_r = #{c : h_c > r} — a
+        branch-free count per row lane.
+        """
+        if not self.sym:
+            return states
+        hs = self._heights(states)  # [B, w]
+        rows = [
+            jnp.sum((hs > r).astype(jnp.int32), axis=-1)
+            for r in range(self.h)
+        ]
+        flipped = self._pack(jnp.stack(rows, axis=-1))
+        return jnp.minimum(states, flipped)
 
     # -------------------------------------------------------------- protocol
 

@@ -55,7 +55,7 @@ replacing the reference's `mpirun -np 1` vs `-np N` (SURVEY.md §4.2).
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 import jax
 import jax.numpy as jnp
@@ -542,7 +542,8 @@ class ShardedSolver:
         13 B/position, the budget the plan is written against.
         """
         cap = stacked.shape[1]
-        block = self.backward_block
+        # Power-of-two floor: divides the (power-of-two) cap exactly.
+        block = 1 << max(self.backward_block, 1).bit_length() - 1
         if cap <= block:
             return self._run_backward_step(stacked, cap, window_caps,
                                            window_flat)

@@ -328,7 +328,10 @@ class Solver:
         (SURVEY.md §7 "Memory budget"); results concatenate on device.
         """
         cap = states_dev.shape[0]
-        block = self.backward_block
+        # Largest power of two <= backward_block: caps are powers of two, so
+        # this always divides cap exactly (no ragged final block), even when
+        # the attribute was set directly to an odd value.
+        block = 1 << max(self.backward_block, 1).bit_length() - 1
         if cap <= block:
             return self._bwd(cap, wcaps)(states_dev, *window_args)
         values, rems = [], []

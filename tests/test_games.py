@@ -105,3 +105,18 @@ def test_connect4_describe_and_moves():
     # One move fills one cell.
     levels = np.asarray(game.level_of(children[0]))
     assert (levels == 1).all()
+
+
+def test_mnk_444_forward_smoke():
+    """BASELINE config #2 (4x4 tictactoe / mnk(4,4,4)): the vmapped move-gen
+    kernel compiles and expands correctly on the bigger board (full solve is
+    exercised on TPU via the bench ladder, not in CI)."""
+    game = get_game("tictactoe:m=4,n=4,k=4")
+    s = game.initial_state()
+    states = jnp.asarray(np.array([s], dtype=np.uint64))
+    children, mask = game.expand(states)
+    assert int(np.asarray(mask).sum()) == 16  # 16 opening moves
+    levels = np.asarray(game.level_of(children[0]))
+    assert (levels == 1).all()
+    prim = np.asarray(game.primitive(children[0]))
+    assert (prim == 0).all()  # no opening move ends the game

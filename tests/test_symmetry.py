@@ -60,3 +60,17 @@ def test_sharded_sym_invariance():
     assert (sharded.value, sharded.remoteness) == (single.value, single.remoteness)
     assert sharded.num_positions == single.num_positions == 765
     assert full_table(sharded) == full_table(single)
+
+
+def test_chomp_sym_transpose_square():
+    plain = Solver(get_game("chomp:w=3,h=3")).solve()
+    sym = Solver(get_game("chomp:w=3,h=3,sym=1"), paranoid=True).solve()
+    assert (sym.value, sym.remoteness) == (plain.value, plain.remoteness)
+    assert sym.num_positions < plain.num_positions
+    for pos, expected in full_table(plain).items():
+        assert sym.lookup(pos) == expected
+
+
+def test_chomp_sym_rejects_non_square():
+    with pytest.raises(ValueError, match="square"):
+        get_game("chomp:w=4,h=3,sym=1")

@@ -231,3 +231,25 @@ def test_cli_coordinator_requires_process_args(capsys):
     captured = capsys.readouterr()
     assert rc == 2
     assert "--num-processes" in captured.err
+
+
+def test_cli_query_flag(capsys):
+    rc = cli_main(
+        ["subtract:total=10,moves=1-2", "--query", "9", "--query", "0x3",
+         "--query", "99"]
+    )
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "query 9: value=LOSE remoteness=" in captured.out
+    assert "query 0x3: value=LOSE" in captured.out  # 3 % 3 == 0 -> LOSE
+    assert "query 99: not reachable" in captured.out
+
+
+def test_cli_query_flag_compat_host(capsys):
+    rc = cli_main(
+        [str(REF_GAMES / "ten_to_zero.py"), "--query", "3", "--query", "77"]
+    )
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "query 3: value=LOSE" in captured.out
+    assert "query 77: not reachable" in captured.out
