@@ -72,6 +72,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="dump the full solved table as .npz (packed cells per level)",
     )
     p.add_argument(
+        "--no-tables",
+        action="store_true",
+        help="big-run mode: materialize only the root level's table on host "
+        "(positions are still counted; combine with --checkpoint-dir to "
+        "persist full tables level-by-level instead)",
+    )
+    p.add_argument(
         "--query",
         action="append",
         default=None,
@@ -224,6 +231,7 @@ def main(argv=None) -> int:
                     paranoid=args.paranoid,
                     logger=logger,
                     checkpointer=checkpointer,
+                    store_tables=not args.no_tables,
                 )
             _report(result, args.devices, time.perf_counter() - t0, args,
                     logger)
@@ -292,6 +300,7 @@ def main(argv=None) -> int:
             paranoid=args.paranoid,
             logger=logger,
             checkpointer=checkpointer,
+            store_tables=not args.no_tables,
         )
     else:
         from gamesmanmpi_tpu.solve import Solver
@@ -301,6 +310,7 @@ def main(argv=None) -> int:
             paranoid=args.paranoid,
             logger=logger,
             checkpointer=checkpointer,
+            store_tables=not args.no_tables,
         )
     with maybe_profile(args.profile_dir):
         result = solver.solve()

@@ -128,8 +128,11 @@ def _sharded_forward_step(game: TensorGame, S: int, route_cap: int, local):
     """Per-shard forward body: expand -> owner-bucket -> all_to_all -> dedup.
 
     local: [1, cap] this shard's frontier slice (shard_map gives the leading
-    mesh axis). Returns ([1, S*route_cap] unique children, [1] count,
-    [1, S] per-destination send counts for overflow detection).
+    mesh axis). Returns ([1, S*route_cap] unique children, then REPLICATED
+    control-plane outputs: [S] per-shard unique counts and [S, S] per-
+    (src,dst) send counts for overflow detection). Control outputs are
+    all_gathered on device so the host can read them under multi-host
+    execution too, where a P(AXIS)-sharded array is not fully addressable.
     """
     sentinel = game.sentinel
     local = local[0]
@@ -142,7 +145,9 @@ def _sharded_forward_step(game: TensorGame, S: int, route_cap: int, local):
     routed = jax.lax.all_to_all(send, AXIS, split_axis=0, concat_axis=0,
                                 tiled=True)
     uniq, count = sort_unique(routed.reshape(-1))
-    return uniq[None], count[None], counts[None]
+    all_counts = jax.lax.all_gather(count, AXIS)  # [S] replicated
+    all_sends = jax.lax.all_gather(counts, AXIS)  # [S, S] replicated
+    return uniq[None], all_counts, all_sends
 
 
 def _sharded_backward_step(game: TensorGame, S: int, qcap: int, local,
@@ -208,7 +213,10 @@ def _sharded_backward_step(game: TensorGame, S: int, qcap: int, local,
     # routing overflow, which the host retries) + zero-move UNDECIDED
     # positions (see engine.resolve_level).
     misses = jnp.sum(mask & ~hit) + jnp.sum(undecided & ~jnp.any(mask, axis=-1))
-    return values[None], remoteness[None], misses[None], qcounts[None]
+    # Control plane replicated for multi-host readability (see forward step).
+    total_misses = jax.lax.psum(misses, AXIS)
+    all_qcounts = jax.lax.all_gather(qcounts, AXIS)  # [S, S] replicated
+    return values[None], remoteness[None], total_misses, all_qcounts
 
 
 class _SLevel:
@@ -245,8 +253,10 @@ class ShardedSolver:
         logger=None,
         checkpointer=None,
         force_generic: bool = False,
+        store_tables: bool = True,
     ):
         self.game = game
+        self.store_tables = store_tables
         self.mesh = mesh if mesh is not None else make_mesh(num_shards)
         self.S = self.mesh.devices.shape[0]
         self.min_bucket = min_bucket
@@ -278,7 +288,8 @@ class ShardedSolver:
                 per_shard,
                 mesh=mesh,
                 in_specs=P(AXIS),
-                out_specs=(P(AXIS), P(AXIS), P(AXIS)),
+                out_specs=(P(AXIS), P(), P()),
+                check_vma=False,  # all_gathered control outputs ARE replicated
             )
 
         return get_kernel(
@@ -330,7 +341,8 @@ class ShardedSolver:
                 per_shard,
                 mesh=mesh,
                 in_specs=(P(AXIS),) + (P(AXIS),) * (3 * n_windows),
-                out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+                out_specs=(P(AXIS), P(AXIS), P(), P()),
+                check_vma=False,  # psum/all_gather outputs ARE replicated
             )
 
         return get_kernel(
@@ -338,6 +350,41 @@ class ShardedSolver:
             "sbwd",
             (self._mesh_key, cap, tuple(window_caps), qcap),
             build,
+        )
+
+    def _root_fn(self, cap: int):
+        """Replicated (value, remoteness) of one state from a device triple.
+
+        The FINISHED analog for multi-host runs: the root's answer leaves
+        the device as a psum-replicated scalar pair, never as a host
+        download of a cross-process sharded array.
+        """
+        mesh = self.mesh
+
+        def build(game):
+            def per_shard(states, values, rem, query):
+                ts, tv, tr = states[0], values[0], rem[0]
+                idx = jnp.clip(
+                    jnp.searchsorted(ts, query[0]), 0, ts.shape[0] - 1
+                )
+                hit = ts[idx] == query[0]
+                v = jnp.where(hit, tv[idx].astype(jnp.int32), 0)
+                r = jnp.where(hit, tr[idx], 0)
+                return (
+                    jax.lax.psum(v, AXIS),
+                    jax.lax.psum(r, AXIS),
+                )
+
+            return jax.shard_map(
+                per_shard,
+                mesh=mesh,
+                in_specs=(P(AXIS), P(AXIS), P(AXIS), P()),
+                out_specs=(P(), P()),
+                check_vma=False,  # psum outputs ARE replicated
+            )
+
+        return get_kernel(
+            self.game, "sroot", (self._mesh_key, cap), build
         )
 
     def _level_fn(self, cap: int):
@@ -569,12 +616,18 @@ class ShardedSolver:
         owners = owner_shard_np(states, self.S)
         return [states[owners == s] for s in range(self.S)]
 
-    def _backward(self, levels: Dict[int, _SLevel]) -> Dict[int, LevelTable]:
+    def _backward(self, levels: Dict[int, _SLevel], root_level: int,
+                  init) -> Dict[int, LevelTable]:
         """Deepest-first owner-routed resolve; unified fast/generic path.
 
         The window cache holds the device triples (states, values,
         remoteness) of the last `max_level_jump` resolved levels — each
         P(AXIS)-sharded, so per-shard window memory stays O(level/S).
+
+        With store_tables=False only the root level's table is materialized
+        on host (plus whatever the checkpointer persists) — the big-run mode
+        where accumulating every level's table in host RAM is the remaining
+        O(total-positions) cost (docs/ARCHITECTURE.md capacity plan).
         """
         g = self.game
         S = self.S
@@ -644,27 +697,44 @@ class ShardedSolver:
                         f"level {k}: consistency failures (missed child "
                         "lookups or zero-move non-primitive positions)"
                     )
-                # Global table for this level (kept sharded on device during
-                # the solve; materialized for the result).
-                shards = rec.host_shards()
-                values = np.asarray(values_dev)
-                remoteness = np.asarray(rem_dev)
-                gs, gv, gr = [], [], []
-                for s in range(S):
-                    n = int(rec.counts[s])
-                    gs.append(shards[s])
-                    gv.append(values[s, :n])
-                    gr.append(remoteness[s, :n])
-                states = np.concatenate(gs)
-                order = np.argsort(states)
-                table = LevelTable(
-                    states=states[order],
-                    values=np.concatenate(gv)[order],
-                    remoteness=np.concatenate(gr)[order],
+                need_table = (
+                    self.store_tables or self.checkpointer is not None
                 )
-            resolved[k] = table
+                if need_table:
+                    # Global table for this level (kept sharded on device
+                    # during the solve; materialized for the result).
+                    shards = rec.host_shards()
+                    values = np.asarray(values_dev)
+                    remoteness = np.asarray(rem_dev)
+                    gs, gv, gr = [], [], []
+                    for s in range(S):
+                        n = int(rec.counts[s])
+                        gs.append(shards[s])
+                        gv.append(values[s, :n])
+                        gr.append(remoteness[s, :n])
+                    states = np.concatenate(gs)
+                    order = np.argsort(states)
+                    table = LevelTable(
+                        states=states[order],
+                        values=np.concatenate(gv)[order],
+                        remoteness=np.concatenate(gr)[order],
+                    )
+                else:
+                    table = None  # big-run mode: nothing leaves the device
+            if table is not None and (self.store_tables or k == root_level):
+                resolved[k] = table
+            if k == root_level:
+                # The root answer leaves the device replicated (multi-host
+                # safe) — the only result a big-run solve must produce.
+                v, r = self._root_fn(cap)(
+                    rec.dev, values_dev, rem_dev,
+                    jnp.full((1,), init, dtype=g.state_dtype),
+                )
+                self._root_answer = (int(v), int(r))
             dev_cache[k] = (rec.dev, values_dev, rem_dev)
             rec.dev = None  # the cache owns the device copy now
+            if not self.store_tables:
+                rec.host = None  # bound host RAM in big-run mode
             for done in [d for d in dev_cache if d > k + g.max_level_jump]:
                 del dev_cache[done]
             if self.logger is not None:
@@ -672,13 +742,17 @@ class ShardedSolver:
                     {
                         "phase": "backward",
                         "level": k,
-                        "n": int(table.states.shape[0]),
+                        "n": int(rec.counts.sum()),
                         "shards": S,
                         "resumed": from_checkpoint,
                         "secs": time.perf_counter() - t0,
                     }
                 )
-            if self.checkpointer is not None and not from_checkpoint:
+            if (
+                self.checkpointer is not None
+                and not from_checkpoint
+                and table is not None
+            ):
                 self.checkpointer.save_level(k, table)
         return resolved
 
@@ -718,16 +792,17 @@ class ShardedSolver:
                 }
             )
         t_forward = time.perf_counter() - t0
-        resolved = self._backward(levels)
+        # Positions counted from the per-shard counters, not the tables —
+        # valid in store_tables=False mode too.
+        num_positions = sum(int(rec.counts.sum()) for rec in levels.values())
+        resolved = self._backward(levels, start_level, init)
         t_total = time.perf_counter() - t0
-        root = resolved[start_level]
-        i = int(np.searchsorted(root.states, init))
-        num_positions = sum(t.states.shape[0] for t in resolved.values())
+        root_value, root_rem = self._root_answer
         stats = {
             "game": g.name,
             "shards": self.S,
             "positions": num_positions,
-            "levels": len(resolved),
+            "levels": len(levels),
             "spill_retries": self.spill_retries,
             "secs_forward": t_forward,
             "secs_total": t_total,
@@ -735,6 +810,4 @@ class ShardedSolver:
         }
         if self.logger is not None:
             self.logger.log({"phase": "done", **stats})
-        return SolveResult(
-            g, int(root.values[i]), int(root.remoteness[i]), resolved, stats
-        )
+        return SolveResult(g, root_value, root_rem, resolved, stats)

@@ -85,6 +85,10 @@ class SolveResult:
 
     @property
     def num_positions(self) -> int:
+        # stats carries the authoritative count (valid in store_tables=False
+        # mode, where `levels` holds only the root level).
+        if "positions" in self.stats:
+            return self.stats["positions"]
         return sum(t.states.shape[0] for t in self.levels.values())
 
     def lookup(self, state) -> tuple[int, int]:
@@ -266,12 +270,16 @@ class Solver:
         logger=None,
         checkpointer=None,
         force_generic: bool = False,
+        store_tables: bool = True,
     ):
         self.game = game
         self.min_bucket = min_bucket
         self.paranoid = paranoid
         self.logger = logger
         self.checkpointer = checkpointer
+        #: False = big-run mode: only the root level's table is materialized
+        #: on host (plus checkpoints); see the sharded solver's docstring.
+        self.store_tables = store_tables
         self.fast = bool(game.uniform_level_jump) and not force_generic
         self.device_store_bytes = _device_store_bytes()
         self.backward_block = _backward_block()
@@ -418,7 +426,8 @@ class Solver:
             k += 1
         return levels
 
-    def _backward_fast(self, levels: Dict[int, _Level]) -> Dict[int, LevelTable]:
+    def _backward_fast(self, levels: Dict[int, _Level],
+                       root_level: int) -> Dict[int, LevelTable]:
         """Deepest-first resolve; the window is the previous (deeper) level."""
         g = self.game
         resolved: Dict[int, LevelTable] = {}
@@ -469,14 +478,24 @@ class Solver:
                         "max_level_jump inconsistent — or non-primitive "
                         "positions with zero legal moves)"
                     )
-                table = LevelTable(
-                    states=rec.host_states(),
-                    values=np.asarray(values_dev[:n]),
-                    remoteness=np.asarray(rem_dev[:n]),
-                )
-            resolved[k] = table
+                if (
+                    self.store_tables
+                    or k == root_level
+                    or self.checkpointer is not None
+                ):
+                    table = LevelTable(
+                        states=rec.host_states(),
+                        values=np.asarray(values_dev[:n]),
+                        remoteness=np.asarray(rem_dev[:n]),
+                    )
+                else:
+                    table = None  # big-run mode: no host materialization
+            if table is not None and (self.store_tables or k == root_level):
+                resolved[k] = table
             prev = (states_dev, values_dev, rem_dev)
             rec.dev = None  # release the forward copy
+            if not self.store_tables:
+                rec.host = None
             if self.logger is not None:
                 self.logger.log(
                     {
@@ -535,11 +554,16 @@ class Solver:
                 )
             k += 1
 
-    def _backward_generic(self, pools: Dict[int, np.ndarray]) -> Dict[int, LevelTable]:
+    def _backward_generic(self, pools: Dict[int, np.ndarray],
+                          root_level: int) -> Dict[int, LevelTable]:
         """Resolve all levels deepest-first against a multi-level window.
 
         Levels already present in the checkpoint (a previous, preempted run)
         are loaded instead of recomputed — restart-from-level recovery.
+        store_tables=False only bounds result-RAM here (tables are still
+        materialized transiently for the host window cache; the multi-jump
+        games in the catalog are small — the big-run mode that avoids
+        downloads entirely is the fast path and the sharded solver).
         """
         g = self.game
         resolved: Dict[int, LevelTable] = {}
@@ -589,7 +613,8 @@ class Solver:
                 remoteness = np.asarray(rem_dev[:n])
                 table = LevelTable(states=states, values=values,
                                    remoteness=remoteness)
-            resolved[k] = table
+            if self.store_tables or k == root_level:
+                resolved[k] = table
             cap = padded.shape[0]
             pv = np.full(cap, UNDECIDED, dtype=np.uint8)
             pr = np.zeros(cap, dtype=np.int32)
@@ -641,7 +666,8 @@ class Solver:
                         {k: rec.host_states() for k, rec in levels.items()}
                     )
             t_forward = time.perf_counter() - t0
-            resolved = self._backward_fast(levels)
+            num_positions = sum(rec.n for rec in levels.values())
+            resolved = self._backward_fast(levels, start_level)
         else:
             if saved is not None:
                 pools = {
@@ -654,14 +680,14 @@ class Solver:
                 if self.checkpointer is not None:
                     self.checkpointer.save_frontiers(pools)
             t_forward = time.perf_counter() - t0
-            resolved = self._backward_generic(pools)
+            num_positions = sum(int(a.shape[0]) for a in pools.values())
+            resolved = self._backward_generic(pools, start_level)
 
         t_total = time.perf_counter() - t0
         root = resolved[start_level]
         i = int(np.searchsorted(root.states, init))
         value = int(root.values[i])
         remoteness = int(root.remoteness[i])
-        num_positions = sum(t.states.shape[0] for t in resolved.values())
         stats = {
             "game": g.name,
             "positions": num_positions,

@@ -100,3 +100,14 @@ def test_chomp_boards_win_and_1x1_loses():
     # 1x1 is the poison-only position itself: primitive LOSE, remoteness 0.
     r = Solver(get_game("chomp:w=1,h=1")).solve()
     assert r.value == LOSE and r.remoteness == 0
+
+
+def test_store_tables_false_root_only():
+    """Big-run mode: same root answer and position count, only the root
+    level materialized (fast and generic paths)."""
+    for spec in ("tictactoe", "subtract:total=10,moves=1-2"):
+        full = Solver(get_game(spec)).solve()
+        lean = Solver(get_game(spec), store_tables=False).solve()
+        assert (lean.value, lean.remoteness) == (full.value, full.remoteness)
+        assert lean.num_positions == full.num_positions
+        assert len(lean.levels) == 1  # root only

@@ -76,3 +76,30 @@ def test_sharded_blocked_backward_parity():
     solver.backward_block = 256
     result = solver.solve()
     assert full_table(result) == full_table(single)
+
+
+def test_sharded_store_tables_false():
+    """Big-run mode: nothing leaves the devices except the psum-replicated
+    root answer and the per-shard counters (multi-host safe)."""
+    full = ShardedSolver(get_game("tictactoe"), num_shards=4).solve()
+    lean = ShardedSolver(
+        get_game("tictactoe"), num_shards=4, store_tables=False
+    ).solve()
+    assert (lean.value, lean.remoteness) == (full.value, full.remoteness)
+    assert lean.num_positions == full.num_positions
+    assert len(lean.levels) == 0  # no host tables at all
+
+
+def test_sharded_root_answer_via_kernel_matches_table():
+    """The replicated root-lookup kernel and the materialized root table
+    must agree (store_tables=True computes both)."""
+    result = ShardedSolver(get_game("nim:heaps=2-3-4"), num_shards=4).solve()
+    root_level = min(result.levels)
+    table = result.levels[root_level]
+    import numpy as np
+
+    i = int(np.searchsorted(table.states, result.game.initial_state()))
+    assert (result.value, result.remoteness) == (
+        int(table.values[i]),
+        int(table.remoteness[i]),
+    )
