@@ -135,35 +135,62 @@ def main() -> int:
     spec = os.environ.get("BENCH_GAME", default_spec)
     repeats = int(os.environ.get("BENCH_REPEATS", "2"))
 
-    game = get_game(spec)
-    best = None
-    for i in range(max(repeats, 1)):
-        solver = Solver(game)
-        t0 = time.perf_counter()
-        result = solver.solve()
-        dt = time.perf_counter() - t0
-        pps = result.num_positions / dt
-        print(
-            f"run {i}: {result.num_positions} positions in {dt:.3f}s "
-            f"= {pps:,.0f} pos/s (value={result.value}, "
-            f"remoteness={result.remoteness})",
-            file=sys.stderr,
-        )
-        best = max(best or 0.0, pps)
+    def run_solves(game_spec: str, nruns: int):
+        """Best-of-N solve of one board; returns (best pps, best stats)."""
+        game = get_game(game_spec)
+        best_pps, best_stats = 0.0, None
+        for i in range(max(nruns, 1)):
+            # store_tables=False: the metric measures SOLVING, not the
+            # ~600 MB result download over the relay (VERDICT.md r2 weak #5);
+            # the root's (value, remoteness) is still checked every run.
+            solver = Solver(game, store_tables=False)
+            t0 = time.perf_counter()
+            result = solver.solve()
+            dt = time.perf_counter() - t0
+            pps = result.num_positions / dt
+            print(
+                f"run {i} [{game.name}]: {result.num_positions} positions "
+                f"in {dt:.3f}s = {pps:,.0f} pos/s "
+                f"(fwd {result.stats['secs_forward']:.1f}s / "
+                f"bwd {result.stats['secs_backward']:.1f}s, "
+                f"value={result.value}, remoteness={result.remoteness})",
+                file=sys.stderr,
+            )
+            if pps > best_pps:
+                best_pps, best_stats = pps, dict(result.stats)
+        return best_pps, best_stats
+
+    best, stats = run_solves(spec, repeats)
+
+    # Secondary: the mirror-symmetry variant (halves the 6x6+ table; the
+    # capacity plan depends on its throughput cost — VERDICT.md r2 item 7).
+    sym = None
+    if os.environ.get("BENCH_SYM", "1") not in ("0", "off") and "sym" not in spec:
+        try:
+            sep = "," if ":" in spec else ":"
+            sym_pps, sym_stats = run_solves(spec + sep + "sym=1", 1)
+            sym = {
+                "positions_per_sec": round(sym_pps, 1),
+                "positions": sym_stats["positions"],
+            }
+        except Exception as e:  # pragma: no cover - diagnostic only
+            print(f"sym bench failed: {e!r}", file=sys.stderr)
 
     north_star_per_chip = 4.5e12 / 3600.0 / 32.0  # 39.06M pos/s/chip
-    print(
-        json.dumps(
-            {
-                "metric": f"{game.name}_positions_solved_per_sec_per_chip",
-                "value": round(best, 1),
-                "unit": "positions/sec/chip",
-                "vs_baseline": round(best / north_star_per_chip, 6),
-                "device": dev.platform,
-                "fallback_cpu": fallback,
-            }
-        )
-    )
+    record = {
+        "metric": f"{get_game(spec).name}_positions_solved_per_sec_per_chip",
+        "value": round(best, 1),
+        "unit": "positions/sec/chip",
+        "vs_baseline": round(best / north_star_per_chip, 6),
+        "device": dev.platform,
+        "fallback_cpu": fallback,
+        "secs_forward": round(stats["secs_forward"], 3),
+        "secs_backward": round(stats["secs_backward"], 3),
+        "positions": stats["positions"],
+    }
+    if sym is not None:
+        record["sym"] = sym
+    print(json.dumps(record))
     return 0
 
 

@@ -19,17 +19,18 @@ def sort_unique(states):
     Returns (sorted_unique [N] with all uniques first then SENTINEL tail,
              count of unique non-sentinel entries, int32).
 
-    One sort + prefix-sum scatter compaction: after the sort, the survivor
-    of each duplicate run is its first element; cumsum of the keep-mask is
-    each survivor's target slot, and a scatter-with-drop writes them — O(N)
-    instead of the naive mark-and-resort second O(N log N) pass.
+    Sort, mark duplicate-run followers as SENTINEL, then re-sort: sentinels
+    (all-ones) sink to the tail, compacting survivors to the front in sorted
+    order. The obvious O(N) alternative — cumsum + scatter compaction — is
+    1.7x SLOWER on TPU v5e (tools/microbench.py: 393 ms vs 231 ms at 32M
+    uint32): XLA lowers arbitrary-index scatters to a serialized path, while
+    its TPU sort is a fast vectorized network. Mark+resort keeps the whole
+    kernel on the happy path.
     """
     sentinel = sentinel_for(states.dtype)
     s = jnp.sort(states)
     first = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
     keep = first & (s != sentinel)
-    idx = jnp.cumsum(keep) - 1  # target slot per survivor (sorted order kept)
-    out = jnp.full(s.shape, sentinel, dtype=s.dtype)
-    out = out.at[jnp.where(keep, idx, s.shape[0])].set(s, mode="drop")
+    out = jnp.sort(jnp.where(keep, s, sentinel))
     count = jnp.sum(keep).astype(jnp.int32)
     return out, count

@@ -3,13 +3,23 @@
 Reference counterpart: `pos in resolved` dict probes plus the SEND_BACK
 round-trip to the owner rank (src/process.py LOOK_UP path, SURVEY.md §3.2-3.3).
 Here solved levels are sorted uint32/uint64 arrays with SENTINEL tails, and a
-whole frontier's child queries become one vectorized binary search
-(searchsorted + gather) per level of the lookup window — no messages, no dict.
+whole frontier's child queries become one vectorized search per level of the
+lookup window — no messages, no dict.
+
+TPU notes (tools/microbench.py, v5e): `jnp.searchsorted`'s default
+binary-search method ('scan') costs log2(N) dependent gathers per key —
+7.0 s for 32M keys in an 8M table — while method='sort' (sort-merge join)
+does the same in 1.0 s; and three separate value gathers cost ~0.35 s each,
+so for uint32 games the (state, value, remoteness) record is fused into ONE
+uint64 payload gather (state in the high 32 bits doubles as the hit check).
+This kernel is the backward pass's dominant cost; these two choices are what
+took the r02 solve off the 8x-slower-than-CPU floor.
 """
 
 import jax.numpy as jnp
 
 from gamesmanmpi_tpu.core.bitops import sentinel_for
+from gamesmanmpi_tpu.core.codec import pack_cells, unpack_cells
 from gamesmanmpi_tpu.core.values import UNDECIDED
 
 
@@ -22,11 +32,27 @@ def lookup_sorted(keys, table_states, table_values, table_remoteness):
     hit [K] bool).
     """
     sentinel = sentinel_for(keys.dtype)
-    idx = jnp.searchsorted(table_states, keys)
-    idx = jnp.clip(idx, 0, table_states.shape[0] - 1)
-    hit = (table_states[idx] == keys) & (keys != sentinel)
-    values = jnp.where(hit, table_values[idx], jnp.uint8(UNDECIDED))
-    remoteness = jnp.where(hit, table_remoteness[idx], 0)
+    n = table_states.shape[0]
+    idx = jnp.searchsorted(table_states, keys, method="sort")
+    idx = jnp.clip(idx, 0, n - 1).astype(jnp.int32)
+    cells = pack_cells(table_values, table_remoteness)
+    if keys.dtype == jnp.uint32:
+        # Fused record: one u64 gather instead of three (state high, cell low).
+        payload = (table_states.astype(jnp.uint64) << jnp.uint64(32)) | (
+            cells.astype(jnp.uint64)
+        )
+        p = payload[idx]
+        hit = ((p >> jnp.uint64(32)).astype(keys.dtype) == keys) & (
+            keys != sentinel
+        )
+        values, remoteness = unpack_cells(
+            (p & jnp.uint64(0xFFFF_FFFF)).astype(jnp.uint32)
+        )
+    else:
+        hit = (table_states[idx] == keys) & (keys != sentinel)
+        values, remoteness = unpack_cells(cells[idx])
+    values = jnp.where(hit, values, jnp.uint8(UNDECIDED))
+    remoteness = jnp.where(hit, remoteness, 0)
     return values, remoteness, hit
 
 

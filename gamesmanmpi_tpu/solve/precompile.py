@@ -1,0 +1,137 @@
+"""Background parallel kernel compilation.
+
+On the axon-relayed TPU this project runs on, XLA compilation is a remote
+RPC with a ~15 s floor PER PROGRAM regardless of size, executables cannot be
+serialized (the persistent compilation cache silently stores nothing), and —
+measured in tools/microbench.py — the compile service accepts concurrent
+requests (4 compiles complete in ~11 s wall vs ~15-17 s for one). A solve
+that naively compiles its ~30 shapes serially therefore spends ~8 minutes
+compiling a ~30 s computation, which is exactly what BENCH_r02 measured.
+
+This module turns compilation into background work: kernels are lowered
+eagerly (cheap, host-side) and compiled on DAEMON worker threads, so the
+solver overlaps compilation of upcoming capacities with execution of current
+ones, the precise backward shapes (known the moment forward discovery ends)
+compile while the deepest levels resolve — and speculative compiles still in
+flight can never block interpreter exit (a stock ThreadPoolExecutor's
+non-daemon workers would).
+
+There is no reference counterpart (SURVEY.md §2.2 — the reference is pure
+interpreted Python); this is infrastructure the XLA execution model makes
+necessary, the moral analog of the reference relying on mpi4py being
+imported once, not per message.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Dict, Hashable
+
+import jax
+
+
+def _workers() -> int:
+    raw = os.environ.get("GAMESMAN_COMPILE_WORKERS", "8")
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        return 8
+
+
+class Precompiler:
+    """Schedules jit-function compilations on daemon worker threads.
+
+    Keys match the engine's kernel-cache keys, so a kernel is compiled at
+    most once per process whether it was scheduled ahead of time or demanded
+    synchronously. `get` returns the AOT-compiled executable when the
+    schedule won the race, else None (caller falls back to calling the jit
+    function, which compiles inline). Successfully consumed futures are
+    evicted so executables are owned by the caller's kernel cache, not
+    pinned here.
+    """
+
+    def __init__(self):
+        self._q: "queue.Queue" = queue.Queue()
+        self._futures: Dict[Hashable, Future] = {}
+        self._lock = threading.Lock()
+        self._threads_started = False
+
+    def _ensure_threads(self) -> None:
+        if self._threads_started:
+            return
+        self._threads_started = True
+        for i in range(_workers()):
+            t = threading.Thread(
+                target=self._worker, name=f"gm-compile-{i}", daemon=True
+            )
+            t.start()
+
+    def _worker(self) -> None:
+        while True:
+            fut, fn, avals = self._q.get()
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn.lower(*avals).compile())
+            except BaseException as e:  # noqa: BLE001 - report via future
+                fut.set_exception(e)
+
+    def schedule(self, key: Hashable, fn, avals: tuple) -> None:
+        """Schedule `fn.lower(*avals).compile()` in the background (idempotent).
+
+        fn must be a jax.jit-wrapped callable; avals are
+        jax.ShapeDtypeStruct leaves matching the call signature.
+        """
+        with self._lock:
+            if key in self._futures:
+                return
+            self._ensure_threads()
+            fut = Future()
+            self._futures[key] = fut
+            self._q.put((fut, fn, avals))
+
+    def get(self, key: Hashable, block: bool = True):
+        """The compiled executable for `key`, or None if never scheduled.
+
+        block=True waits for an in-flight compile (still a win: the wait is
+        the residual, not the full compile, and other compiles progress
+        meanwhile). A successful result is evicted — the caller caches it.
+        """
+        with self._lock:
+            fut = self._futures.get(key)
+        if fut is None:
+            return None
+        if not block and not fut.done():
+            return None
+        try:
+            result = fut.result()
+        except Exception:
+            # A failed background compile (OOM-sized speculative cap, relay
+            # hiccup) must not kill the solve — the caller's inline jit path
+            # remains correct; drop the future so a retry is possible.
+            result = None
+        with self._lock:
+            self._futures.pop(key, None)
+        return result
+
+    def scheduled(self, key: Hashable) -> bool:
+        with self._lock:
+            return key in self._futures
+
+
+_GLOBAL: Precompiler | None = None
+
+
+def global_precompiler() -> Precompiler:
+    global _GLOBAL
+    if _GLOBAL is None:
+        _GLOBAL = Precompiler()
+    return _GLOBAL
+
+
+def sds(shape, dtype) -> jax.ShapeDtypeStruct:
+    """Shorthand ShapeDtypeStruct for schedule() avals."""
+    return jax.ShapeDtypeStruct(shape, dtype)
