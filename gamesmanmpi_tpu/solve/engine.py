@@ -67,6 +67,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gamesmanmpi_tpu.core.codec import pack_cells, unpack_cells
 from gamesmanmpi_tpu.core.values import UNDECIDED
 from gamesmanmpi_tpu.games.base import TensorGame
 from gamesmanmpi_tpu.ops.combine import combine_children
@@ -236,6 +237,69 @@ def expand_core(game: TensorGame, states):
     return sort_unique(children.reshape(-1))
 
 
+def expand_provenance(game: TensorGame, states):
+    """Forward expand that also keeps the dedup sort's provenance.
+
+    Returns (uniq [B*M], count, uidx [B*M] int32, prim [B] uint8):
+    uidx[b*M + m] is the index of child (b, m) within the `uniq` prefix
+    (-1 for padding/invalid children), and prim is primitive(states).
+
+    Rationale: the forward dedup sort already determines where every child
+    lands in the next level's sorted table. Carrying the origin slot through
+    the sort (one extra operand) and routing the run-index back (one pair
+    sort) preserves that knowledge, so the backward pass needs NO search and
+    NO re-expansion — child values become a single gather (see
+    resolve_provenance). Costs one extra pair sort in forward; saves the
+    sort-merge join (the backward pass's dominant cost) per level.
+    """
+    prim = game.primitive(states)
+    active = (states != game.sentinel) & (prim == UNDECIDED)
+    children, _ = canonical_children(game, states, active)
+    flat = children.reshape(-1)
+    origin = jax.lax.iota(jnp.int32, flat.shape[0])
+    s, o = jax.lax.sort((flat, origin), num_keys=1, is_stable=False)
+    first = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
+    keep = first & (s != game.sentinel)
+    # Every slot in a duplicate run shares the survivor's unique-index
+    # (cumsum over run-first markers is constant within the run).
+    uid = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    uid = jnp.where(s != game.sentinel, uid, -1)
+    _, uidx = jax.lax.sort((o, uid), num_keys=1, is_stable=False)
+    uniq = jnp.sort(jnp.where(keep, s, game.sentinel))
+    count = jnp.sum(keep).astype(jnp.int32)
+    return uniq, count, uidx, prim
+
+
+def resolve_provenance(n, prim, uidx, wvals, wrem, max_moves: int):
+    """Backward resolve from stored provenance: gathers + combine only.
+
+    n: scalar int32 — number of real rows (real states are a prefix of the
+    capacity, a dedup-compaction invariant). prim: [C] uint8 (from forward).
+    uidx: [C*M] int32 child indices into the deeper level's prefix (-1 =
+    no child). wvals/wrem: deeper level's solved values/remoteness [W].
+
+    Lookup misses are structurally impossible here (the indices were
+    derived from the very sort that built the deeper level), so the
+    consistency counter only tracks non-primitive zero-move rows.
+    """
+    C = prim.shape[0]
+    valid = jax.lax.iota(jnp.int32, C) < n
+    undecided = valid & (prim == UNDECIDED)
+    m = uidx.reshape(C, max_moves)
+    mask = (m >= 0) & undecided[:, None]
+    cells = pack_cells(wvals, wrem)
+    got = cells[jnp.clip(m, 0, cells.shape[0] - 1)]
+    cv, cr = unpack_cells(got)
+    values, remoteness = combine_children(cv, cr, mask)
+    values = jnp.where(
+        undecided, values,
+        jnp.where(valid, prim, jnp.uint8(UNDECIDED)),
+    )
+    remoteness = jnp.where(undecided, remoteness, 0)
+    misses = jnp.sum(undecided & ~jnp.any(mask, axis=-1))
+    return values, remoteness, misses
+
+
 def expand_with_levels(game: TensorGame, states):
     """Generic-path forward: expand_core + each child's topological level."""
     uniq, count = expand_core(game, states)
@@ -284,11 +348,13 @@ def _backward_block() -> int:
     than this are processed in column blocks against the same window, so
     peak memory is bounded by the block, not the level. Power-of-two,
     lazily read from GAMESMAN_BACKWARD_BLOCK (positions; 0 = unbounded,
-    never block). Default 16M: a 16M-row block's temporaries peak at a few
-    GB on the 16 GB v5e, and blocking below the largest 5x5-class level
-    costs extra window sort-merges per block for no memory benefit.
+    never block). Default 4M rows: the provenance resolve blocks for free
+    (no per-block window re-join — just gathers against the shared table),
+    and a 4M-row block's ~0.6 GB of temporaries leaves the v5e's ~15 GB
+    usable HBM to the stored levels + provenance (a 16M-row block OOMed the
+    5x5 solve with provenance resident).
     """
-    n = _env_int("GAMESMAN_BACKWARD_BLOCK", 1 << 24)
+    n = _env_int("GAMESMAN_BACKWARD_BLOCK", 1 << 22)
     if n <= 0:
         return 1 << 62  # unbounded
     return max(256, 1 << (n - 1).bit_length())
@@ -296,20 +362,32 @@ def _backward_block() -> int:
 
 def _device_store_bytes() -> int:
     """Device-resident level-store budget for the fast path (bytes of packed
-    states kept on device between the forward and backward phases; levels
-    past the budget are spilled to host and re-uploaded during backward)."""
-    return _env_int("GAMESMAN_DEVICE_STORE_MB", 2048) << 20
+    states, plus forward provenance, kept on device between the forward and
+    backward phases; levels past the budget are spilled to host and
+    re-uploaded during backward, and their provenance is dropped). Default
+    sized for the 16 GB v5e: ~8 GB stored leaves ~2x headroom for the
+    biggest level's kernel temporaries."""
+    return _env_int("GAMESMAN_DEVICE_STORE_MB", 8192) << 20
 
 
 class _Level:
-    """One discovered level: host states + optionally the device copy."""
+    """One discovered level: host states + optionally the device copy.
 
-    __slots__ = ("n", "host", "dev")
+    prim/uidx are the forward pass's provenance (expand_provenance): this
+    level's primitive values and its out-edge indices into the NEXT level's
+    prefix. Device-only, kept while the store budget allows; when absent the
+    backward pass falls back to the sort-merge join.
+    """
 
-    def __init__(self, n: int, host: Optional[np.ndarray], dev):
+    __slots__ = ("n", "host", "dev", "prim", "uidx")
+
+    def __init__(self, n: int, host: Optional[np.ndarray], dev,
+                 prim=None, uidx=None):
         self.n = n  # real (non-sentinel) count
         self.host = host  # np [n] sorted, or None if only on device
         self.dev = dev  # jnp [cap] sorted + sentinel tail, or None
+        self.prim = prim  # jnp [cap] uint8, or None
+        self.uidx = uidx  # jnp [cap*M] int32, or None
 
     def host_states(self) -> np.ndarray:
         if self.host is None:
@@ -371,8 +449,8 @@ class Solver:
     # kernel outlives this Solver (see _KERNELS).
 
     @staticmethod
-    def _fwd_builder(game):
-        return lambda states: expand_core(game, states)
+    def _fwdp_builder(game):
+        return lambda states: expand_provenance(game, states)
 
     @staticmethod
     def _bwd_builder(game):
@@ -385,9 +463,21 @@ class Solver:
 
         return f
 
-    def _fwd(self, cap: int):
-        """Fast-path forward: states[cap] -> (uniq [cap*M], count)."""
-        return get_kernel(self.game, "fwd", cap, self._fwd_builder)
+    @staticmethod
+    def _bwdp_builder(game):
+        M = game.max_moves
+        return lambda n, prim, uidx, wvals, wrem: resolve_provenance(
+            n, prim, uidx, wvals, wrem, M
+        )
+
+    def _fwdp(self, cap: int):
+        """Provenance forward: states[cap] -> (uniq, count, uidx, prim)."""
+        return get_kernel(self.game, "fwdp", cap, self._fwdp_builder)
+
+    def _bwdp(self, cap: int, wcap: int):
+        """Provenance backward: (n, prim[cap], uidx[cap*M], wvals[wcap],
+        wrem[wcap]) -> (values, rem, misses)."""
+        return get_kernel(self.game, "bwdp", (cap, wcap), self._bwdp_builder)
 
     def _fwd_generic(self, cap: int):
         return get_kernel(
@@ -422,14 +512,6 @@ class Solver:
         by_space = 1 << min(g.state_bits, 34)
         return bucket_size(max(min(by_mem, by_space), 1), self.min_bucket)
 
-    def _sched_fwd(self, cap: int) -> None:
-        if cap > self._cap_ceiling:
-            return
-        schedule_kernel(
-            self.game, "fwd", cap, self._fwd_builder,
-            (sds((cap,), self.game.state_dtype),),
-        )
-
     def _sched_bwd(self, cap: int, wcaps: tuple) -> None:
         if cap > self._cap_ceiling:
             return
@@ -439,6 +521,29 @@ class Solver:
             avals += [sds((w,), dt), sds((w,), np.uint8), sds((w,), np.int32)]
         schedule_kernel(
             self.game, "bwd", (cap, tuple(wcaps)), self._bwd_builder, avals
+        )
+
+    def _sched_fwdp(self, cap: int) -> None:
+        if cap > self._cap_ceiling:
+            return
+        schedule_kernel(
+            self.game, "fwdp", cap, self._fwdp_builder,
+            (sds((cap,), self.game.state_dtype),),
+        )
+
+    def _sched_bwdp(self, cap: int, wcap: int) -> None:
+        if cap > self._cap_ceiling:
+            return
+        M = self.game.max_moves
+        avals = (
+            sds((), np.int32),
+            sds((cap,), np.uint8),
+            sds((cap * M,), np.int32),
+            sds((wcap,), np.uint8),
+            sds((wcap,), np.int32),
+        )
+        schedule_kernel(
+            self.game, "bwdp", (cap, wcap), self._bwdp_builder, avals
         )
 
     def _schedule_initial_ladder(self) -> None:
@@ -452,8 +557,8 @@ class Solver:
         for _ in range(7):
             if cap > self._cap_ceiling:
                 break
-            self._sched_fwd(cap)
-            self._sched_bwd(cap, (cap,))
+            self._sched_fwdp(cap)
+            self._sched_bwdp(min(cap, self._block_size()), cap)
             cap *= 2
 
     def _block_size(self) -> int:
@@ -463,6 +568,34 @@ class Solver:
         _resolve_blocked and the backward compile scheduler — their kernel
         keys must agree."""
         return 1 << max(self.backward_block, 1).bit_length() - 1
+
+    def _resolve_blocked_prov(self, n: int, prim, uidx, wvals, wrem):
+        """Provenance resolve, in column blocks when the level is wide.
+
+        Same blocking contract as _resolve_blocked: per-block temporaries
+        bounded by the block; the window (wvals/wrem) is shared by every
+        block; results concatenate on device; misses accumulate on device.
+        """
+        C = prim.shape[0]
+        M = self.game.max_moves
+        block = self._block_size()
+        if C <= block:
+            return self._bwdp(C, C)(np.int32(n), prim, uidx, wvals, wrem)
+        values, rems = [], []
+        misses = None
+        for off in range(0, C, block):
+            nb = np.int32(min(max(n - off, 0), block))
+            v, r, m = self._bwdp(block, C)(
+                nb,
+                jax.lax.slice(prim, (off,), (off + block,)),
+                jax.lax.slice(uidx, (off * M,), ((off + block) * M,)),
+                wvals,
+                wrem,
+            )
+            values.append(v)
+            rems.append(r)
+            misses = m if misses is None else misses + m
+        return jnp.concatenate(values), jnp.concatenate(rems), misses
 
     def _resolve_blocked(self, states_dev, wcaps: tuple, window_args: tuple):
         """Backward-resolve a level, in column blocks when it is wide.
@@ -492,21 +625,45 @@ class Solver:
     # ------------------------------------------------------------- fast phase
 
     def _forward_fast(self, init, start_level: int) -> Dict[int, _Level]:
-        """Device-resident forward sweep for uniform_level_jump games."""
+        """Device-resident forward sweep for uniform_level_jump games.
+
+        Two latency hiders on top of the level loop:
+
+        * the expand kernel is expand_provenance — its uidx/prim outputs are
+          stored (budget permitting) so the backward pass becomes pure
+          gathers (see resolve_provenance);
+        * the next level's expand is dispatched SPECULATIVELY at the current
+          capacity before the unique-count host sync (~65 ms on the relay);
+          most levels keep their bucket, so the device computes through the
+          sync instead of idling. A mispredicted bucket just re-dispatches
+          at the right capacity — the speculative result is dropped.
+        """
         g = self.game
         levels: Dict[int, _Level] = {}
-        frontier = jnp.asarray(
-            pad_to(np.array([init], dtype=g.state_dtype), self.min_bucket)
-        )
-        levels[start_level] = _Level(1, np.array([init], dtype=g.state_dtype),
-                                     frontier)
+        host0 = np.array([init], dtype=g.state_dtype)
+        frontier = jnp.asarray(pad_to(host0, self.min_bucket))
+        levels[start_level] = _Level(1, host0, frontier)
         stored_bytes = frontier.nbytes
         k = start_level
+        speculate = os.environ.get("GAMESMAN_SPECULATE", "1") not in (
+            "0", "off", "false",
+        )
+        pending = self._fwdp(frontier.shape[0])(frontier)
         while True:
             t0 = time.perf_counter()
             cap = frontier.shape[0]
-            uniq, count = self._fwd(cap)(frontier)
+            uniq, count, uidx, prim = pending
+            spec = spec_input = None
+            if speculate:
+                spec_input = jax.lax.slice(uniq, (0,), (cap,))
+                spec = self._fwdp(cap)(spec_input)
             n = int(count)  # the one host sync per level
+            rec = levels[k]
+            extra = prim.nbytes + uidx.nbytes
+            if n > 0 and stored_bytes + extra <= self.device_store_bytes:
+                # Keep this level's provenance for the gather-only backward.
+                rec.prim, rec.uidx = prim, uidx
+                stored_bytes += extra
             if n == 0:
                 break
             if k + 1 >= g.num_levels:
@@ -523,24 +680,32 @@ class Solver:
             if next_cap > cap:
                 # Frontier grew into a new bucket: queue compiles two and
                 # four doublings ahead so growth never outruns the pool.
+                # Backward kernels block at _block_size() — schedule the key
+                # the backward pass will actually request.
                 for ahead in (next_cap * 2, next_cap * 4):
-                    self._sched_fwd(ahead)
-                    self._sched_bwd(ahead, (ahead,))
-            if next_cap <= uniq.shape[0]:
-                nxt = jax.lax.slice(uniq, (0,), (next_cap,))
+                    self._sched_fwdp(ahead)
+                    self._sched_bwdp(min(ahead, self._block_size()), ahead)
+            if next_cap == cap and spec is not None:
+                nxt = spec_input
+                pending = spec
             else:
-                # bucket(n) can exceed cap*M for non-power-of-two branching
-                # factors (e.g. M=7: n in (1024, 1792] at cap=256); extend
-                # with sentinel padding on device — no host round-trip.
-                nxt = jnp.concatenate(
-                    [
-                        uniq,
-                        jnp.full(
-                            next_cap - uniq.shape[0], g.sentinel,
-                            dtype=uniq.dtype,
-                        ),
-                    ]
-                )
+                if next_cap <= uniq.shape[0]:
+                    nxt = jax.lax.slice(uniq, (0,), (next_cap,))
+                else:
+                    # bucket(n) can exceed cap*M for non-power-of-two
+                    # branching factors (e.g. M=7: n in (1024, 1792] at
+                    # cap=256); extend with sentinel padding on device — no
+                    # host round-trip.
+                    nxt = jnp.concatenate(
+                        [
+                            uniq,
+                            jnp.full(
+                                next_cap - uniq.shape[0], g.sentinel,
+                                dtype=uniq.dtype,
+                            ),
+                        ]
+                    )
+                pending = self._fwdp(next_cap)(nxt)
             rec = _Level(n, None, nxt)
             if stored_bytes + nxt.nbytes > self.device_store_bytes:
                 # Device-store budget exhausted: keep this level on host only
@@ -611,11 +776,12 @@ class Solver:
             if k in completed:
                 continue
             C = common[k]
-            wcaps = (C,) if k + 1 in levels else ()
-            if C > block:
-                self._sched_bwd(block, wcaps)
+            rec = levels[k]
+            if k + 1 in levels and rec.uidx is not None:
+                self._sched_bwdp(min(C, block), C)
             else:
-                self._sched_bwd(C, wcaps)
+                wcaps = (C,) if k + 1 in levels else ()
+                self._sched_bwd(min(C, block), wcaps)
         prev = None  # (states_dev, values_dev, rem_dev) of level k+1, at its C
         for k in ks:
             t0 = time.perf_counter()
@@ -645,25 +811,41 @@ class Solver:
                 values_dev = jnp.asarray(pad_to_cap_u8(table.values, cap))
                 rem_dev = jnp.asarray(pad_to_cap_i32(table.remoteness, cap))
             else:
-                if prev is None:
-                    args, wcaps = (), ()
-                else:
-                    # Slice the deeper level down to its own bucket, then pad
-                    # to this level's common capacity — window and states
-                    # share one shape (see _backward_plan).
+                if prev is not None and rec.uidx is not None:
+                    # Gather-only resolve from forward provenance: no
+                    # search, no re-expansion (see resolve_provenance).
                     wcap = caps[k + 1]
-                    ws = jax.lax.slice(prev[0], (0,), (wcap,))
                     wv = jax.lax.slice(prev[1], (0,), (wcap,))
                     wr = jax.lax.slice(prev[2], (0,), (wcap,))
-                    args = (
-                        self._pad_dev(ws, C, g.sentinel),
+                    values_dev, rem_dev, misses = self._resolve_blocked_prov(
+                        n,
+                        self._pad_dev(rec.prim, C, np.uint8(UNDECIDED)),
+                        self._pad_dev(
+                            rec.uidx, C * g.max_moves, np.int32(-1)
+                        ),
                         self._pad_dev(wv, C, np.uint8(UNDECIDED)),
                         self._pad_dev(wr, C, np.int32(0)),
                     )
-                    wcaps = (C,)
-                values_dev, rem_dev, misses = self._resolve_blocked(
-                    states_dev, wcaps, args
-                )
+                else:
+                    if prev is None:
+                        args, wcaps = (), ()
+                    else:
+                        # Slice the deeper level down to its own bucket, then
+                        # pad to this level's common capacity — window and
+                        # states share one shape (see _backward_plan).
+                        wcap = caps[k + 1]
+                        ws = jax.lax.slice(prev[0], (0,), (wcap,))
+                        wv = jax.lax.slice(prev[1], (0,), (wcap,))
+                        wr = jax.lax.slice(prev[2], (0,), (wcap,))
+                        args = (
+                            self._pad_dev(ws, C, g.sentinel),
+                            self._pad_dev(wv, C, np.uint8(UNDECIDED)),
+                            self._pad_dev(wr, C, np.int32(0)),
+                        )
+                        wcaps = (C,)
+                    values_dev, rem_dev, misses = self._resolve_blocked(
+                        states_dev, wcaps, args
+                    )
                 if self.paranoid and int(misses) > 0:
                     raise SolverError(
                         f"level {k}: {int(misses)} consistency failures (child "
@@ -687,6 +869,15 @@ class Solver:
                 resolved[k] = table
             prev = (states_dev, values_dev, rem_dev)
             rec.dev = None  # release the forward copy
+            rec.prim = rec.uidx = None  # release provenance
+            if not from_checkpoint and C >= (1 << 21):
+                # Bound enqueue run-ahead: with no per-level downloads the
+                # host races through the whole backward, allocating every
+                # level's padded inputs before any kernel retires — enough
+                # to OOM HBM at 5x5 scale. An 8-byte fetch (~65 ms) per BIG
+                # level caps liveness at ~one level's working set; small
+                # levels stay fully async.
+                np.asarray(misses)
             if not self.store_tables:
                 rec.host = None
             if self.logger is not None:

@@ -91,9 +91,10 @@ def test_checkpoint_resume_skips_recompute(tmp_path):
     def _poisoned(*a, **k):
         raise AssertionError("resume recomputed a level")
 
-    resumed_solver._fwd = _poisoned
+    resumed_solver._fwdp = _poisoned
     resumed_solver._fwd_generic = _poisoned
     resumed_solver._bwd = _poisoned
+    resumed_solver._bwdp = _poisoned
     resumed = resumed_solver.solve()
     assert resumed.value == first.value
     assert resumed.remoteness == first.remoteness

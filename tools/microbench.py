@@ -78,7 +78,22 @@ def main():
 
     # 2. dedup variants
     from gamesmanmpi_tpu.ops.dedup import sort_unique
-    timeit(f"sort_unique (scatter compact) [{N>>20}M]", sort_unique, keys)
+    timeit(f"sort_unique (current impl)   [{N>>20}M]", sort_unique, keys)
+
+    def sort_unique_scatter(states):
+        """The rejected O(N) compaction: cumsum + scatter (r2's impl)."""
+        sentinel = jnp.uint32(0xFFFFFFFF)
+        s = jnp.sort(states)
+        first = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
+        keep = first & (s != sentinel)
+        idx = (jnp.cumsum(keep.astype(jnp.int32)) - 1)
+        out = jnp.full(s.shape, sentinel, dtype=s.dtype)
+        out = out.at[jnp.where(keep, idx, s.shape[0])].set(s, mode="drop")
+        count = jnp.sum(keep).astype(jnp.int32)
+        return out, count
+
+    timeit(f"sort_unique (scatter compact)[{N>>20}M]", sort_unique_scatter,
+           keys)
 
     def sort_unique_resort(states):
         sentinel = jnp.uint32(0xFFFFFFFF)
