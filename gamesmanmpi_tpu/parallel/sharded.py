@@ -68,7 +68,7 @@ from gamesmanmpi_tpu.core.values import UNDECIDED
 from gamesmanmpi_tpu.games.base import TensorGame
 from gamesmanmpi_tpu.ops.combine import combine_children
 from gamesmanmpi_tpu.ops.dedup import sort_unique
-from gamesmanmpi_tpu.ops.lookup import lookup_window
+from gamesmanmpi_tpu.ops.lookup import lookup_sorted, lookup_window
 from gamesmanmpi_tpu.ops.padding import bucket_size
 from gamesmanmpi_tpu.parallel.mesh import AXIS, make_mesh
 from gamesmanmpi_tpu.solve.engine import (
@@ -77,10 +77,28 @@ from gamesmanmpi_tpu.solve.engine import (
     SolverError,
     _backward_block,
     _device_store_bytes,
+    _env_int,
     canonical_children,
     canonical_scalar,
     get_kernel,
 )
+
+
+def _window_block() -> int:
+    """Max per-shard window-level capacity kept resident in HBM.
+
+    Window levels wider than this are spilled to host after resolving and
+    STREAMED back through HBM in blocks during lookup (see
+    _run_backward_step_streamed) — per-shard peak window memory becomes
+    O(block), not O(level/S). This is the capacity mechanism the 7x6 row of
+    docs/ARCHITECTURE.md's plan needs: at that scale one window level is
+    ~244 GB/chip on a v4-32, far beyond HBM. Power-of-two positions per
+    shard, env GAMESMAN_WINDOW_BLOCK.
+    """
+    n = _env_int("GAMESMAN_WINDOW_BLOCK", 1 << 22)
+    if n <= 0:
+        return 1 << 62  # 0 = never spill (mirrors GAMESMAN_BACKWARD_BLOCK)
+    return max(256, 1 << (n - 1).bit_length())
 
 
 def _pad_shards(shard_arrays: List[np.ndarray], cap: int) -> np.ndarray:
@@ -150,6 +168,68 @@ def _sharded_forward_step(game: TensorGame, S: int, route_cap: int, local):
     return uniq[None], all_counts, all_sends
 
 
+def _route_core(game: TensorGame, S: int, qcap: int, local):
+    """Shared backward prologue: expand + owner-route the child queries.
+
+    local: [cap] (already unwrapped). Returns (queries [S, qcap], qcounts
+    [S], s_owner, pos, order) — the bookkeeping un-permutes replies back to
+    the [B, M] child layout in _reply_core. Used by both the fused backward
+    step and the streamed route phase so the two can never drift.
+    """
+    sentinel = game.sentinel
+    prim = game.primitive(local)
+    undecided = (local != sentinel) & (prim == UNDECIDED)
+    children, _ = canonical_children(game, local, undecided)
+    flat = children.reshape(-1)
+    send, qcounts, s_owner, pos, order = _route_by_owner(
+        flat, S, qcap, sentinel
+    )
+    queries = jax.lax.all_to_all(send, AXIS, split_axis=0, concat_axis=0,
+                                 tiled=True)
+    return queries, qcounts, s_owner, pos, order
+
+
+def _reply_core(game: TensorGame, S: int, qcap: int, local, reply, s_owner,
+                pos, order):
+    """Shared backward epilogue: un-permute reply cells + negamax combine.
+
+    reply: [S, qcap] packed cells AFTER the return all_to_all (a hit always
+    carries a decided value — WIN/LOSE/TIE != UNDECIDED=0 — so the
+    UNDECIDED cell doubles as the miss flag). Children are re-expanded for
+    the mask (cheap elementwise). Returns (values [cap], remoteness [cap],
+    misses scalar, NOT yet psum'd).
+    """
+    sentinel = game.sentinel
+    valid = local != sentinel
+    prim = game.primitive(local)
+    undecided = valid & (prim == UNDECIDED)
+    children, mask = canonical_children(game, local, undecided)
+    B, M = children.shape
+    if qcap == 0:
+        child_vals = jnp.full((B, M), UNDECIDED, dtype=jnp.uint8)
+        child_rem = jnp.zeros((B, M), dtype=jnp.int32)
+        hit = jnp.zeros((B, M), dtype=bool)
+    else:
+        in_range = (s_owner < S) & (pos < qcap)
+        got = reply[jnp.clip(s_owner, 0, S - 1), jnp.clip(pos, 0, qcap - 1)]
+        got = jnp.where(in_range, got, 0)
+        flat_reply = (
+            jnp.zeros((B * M,), dtype=reply.dtype).at[order].set(got)
+        )
+        child_vals, child_rem = unpack_cells(flat_reply.reshape(B, M))
+        hit = child_vals != UNDECIDED
+    values, remoteness = combine_children(child_vals, child_rem, mask)
+    values = jnp.where(undecided, values, jnp.where(valid, prim, UNDECIDED))
+    remoteness = jnp.where(undecided, remoteness, 0)
+    # Consistency counters (SURVEY.md §5.2): missed child lookups (including
+    # routing overflow, which the host retries) + zero-move UNDECIDED
+    # positions (see engine.resolve_level).
+    misses = jnp.sum(mask & ~hit) + jnp.sum(
+        undecided & ~jnp.any(mask, axis=-1)
+    )
+    return values, remoteness, misses
+
+
 def _sharded_backward_step(game: TensorGame, S: int, qcap: int, local,
                            window_flat):
     """Per-shard backward body: owner-routed child-value reduction.
@@ -168,55 +248,127 @@ def _sharded_backward_step(game: TensorGame, S: int, qcap: int, local,
     Returns ([1, cap] values, [1, cap] remoteness, [1] misses,
     [1, S] per-destination query counts for overflow detection).
     """
-    sentinel = game.sentinel
     local = local[0]
-    valid = local != sentinel
-    prim = game.primitive(local)
-    undecided = valid & (prim == UNDECIDED)
-    children, mask = canonical_children(game, local, undecided)
-    B, M = children.shape
     if qcap == 0:
-        child_vals = jnp.full((B, M), UNDECIDED, dtype=jnp.uint8)
-        child_rem = jnp.zeros((B, M), dtype=jnp.int32)
-        hit = jnp.zeros((B, M), dtype=bool)
+        reply = s_owner = pos = order = None
         qcounts = jnp.zeros((S,), dtype=jnp.int32)
     else:
         window = tuple(
             (window_flat[i][0], window_flat[i + 1][0], window_flat[i + 2][0])
             for i in range(0, len(window_flat), 3)
         )
-        flat = children.reshape(-1)
-        send, qcounts, s_owner, pos, order = _route_by_owner(
-            flat, S, qcap, sentinel
+        queries, qcounts, s_owner, pos, order = _route_core(
+            game, S, qcap, local
         )
-        queries = jax.lax.all_to_all(send, AXIS, split_axis=0, concat_axis=0,
-                                     tiled=True)
         vals, rems, _ = lookup_window(queries.reshape(-1), window)
-        # One reply collective: (value, remoteness) packed as uint32 cells.
-        # A hit always carries a decided value (WIN/LOSE/TIE != UNDECIDED=0),
-        # so cell==0-valued UNDECIDED doubles as the miss flag.
         reply = pack_cells(vals, rems).reshape(S, qcap)
         reply = jax.lax.all_to_all(reply, AXIS, split_axis=0, concat_axis=0,
                                    tiled=True)
-        in_range = (s_owner < S) & (pos < qcap)
-        got = reply[jnp.clip(s_owner, 0, S - 1), jnp.clip(pos, 0, qcap - 1)]
-        got = jnp.where(in_range, got, 0)
-        flat_reply = (
-            jnp.zeros((B * M,), dtype=reply.dtype).at[order].set(got)
-        )
-        child_vals, child_rem = unpack_cells(flat_reply.reshape(B, M))
-        hit = child_vals != UNDECIDED
-    values, remoteness = combine_children(child_vals, child_rem, mask)
-    values = jnp.where(undecided, values, jnp.where(valid, prim, UNDECIDED))
-    remoteness = jnp.where(undecided, remoteness, 0)
-    # Consistency counters (SURVEY.md §5.2): missed child lookups (including
-    # routing overflow, which the host retries) + zero-move UNDECIDED
-    # positions (see engine.resolve_level).
-    misses = jnp.sum(mask & ~hit) + jnp.sum(undecided & ~jnp.any(mask, axis=-1))
+    values, remoteness, misses = _reply_core(
+        game, S, qcap, local, reply, s_owner, pos, order
+    )
     # Control plane replicated for multi-host readability (see forward step).
     total_misses = jax.lax.psum(misses, AXIS)
     all_qcounts = jax.lax.all_gather(qcounts, AXIS)  # [S, S] replicated
     return values[None], remoteness[None], total_misses, all_qcounts
+
+
+def _sharded_route_step(game: TensorGame, S: int, qcap: int, local):
+    """Streamed backward, phase 1: expand + owner-route the child queries.
+
+    Splits _sharded_backward_step at the window boundary (same _route_core)
+    so the window can be streamed through HBM between phases instead of
+    being resident. The routing bookkeeping (s_owner, pos, order — how to
+    un-permute replies back to the [B, M] child layout) leaves the kernel
+    as P(AXIS) outputs and is fed back to _sharded_reply_step unchanged.
+    """
+    local = local[0]
+    queries, qcounts, s_owner, pos, order = _route_core(game, S, qcap, local)
+    all_qcounts = jax.lax.all_gather(qcounts, AXIS)  # [S, S] replicated
+    # The accumulator is born on device here (one extra output) — creating
+    # it outside would cost a dedicated zeros kernel compile per shape.
+    acc = jnp.zeros(queries.shape, dtype=jnp.uint32)
+    return (
+        queries[None],
+        acc[None],
+        s_owner.astype(jnp.int32)[None],
+        pos.astype(jnp.int32)[None],
+        order.astype(jnp.int32)[None],
+        all_qcounts,
+    )
+
+
+def _sharded_lookup_acc_step(queries, acc, wstates, wvals, wrem):
+    """Streamed backward, phase 2 (once per window block): local lookup.
+
+    Looks this shard's routed queries up in ONE block of its window slice
+    and accumulates hits into the packed-cell buffer. Blocks partition a
+    sorted level slice, so each query hits in at most one block across the
+    whole stream; a hit cell is nonzero (decided value), so accumulate is a
+    select. No collectives — pure local compute.
+    """
+    q = queries[0].reshape(-1)
+    v, r, h = lookup_sorted(q, wstates[0], wvals[0], wrem[0])
+    cell = pack_cells(v, r)
+    out = jnp.where(h, cell, acc[0].reshape(-1))
+    return out.reshape(acc[0].shape)[None]
+
+
+def _sharded_reply_step(game: TensorGame, S: int, qcap: int, local, acc,
+                        s_owner, pos, order):
+    """Streamed backward, phase 3: reply all_to_all + negamax combine.
+
+    The tail of _sharded_backward_step (same _reply_core): accumulated
+    cells travel back to the querying shards, are un-permuted into the
+    [B, M] child layout, and combined.
+    """
+    local = local[0]
+    reply = jax.lax.all_to_all(acc[0], AXIS, split_axis=0, concat_axis=0,
+                               tiled=True)
+    values, remoteness, misses = _reply_core(
+        game, S, qcap, local, reply, s_owner[0], pos[0], order[0]
+    )
+    total_misses = jax.lax.psum(misses, AXIS)
+    return values[None], remoteness[None], total_misses
+
+
+class _HostSpill:
+    """A resolved level spilled to host, multi-host safe.
+
+    Holds each ADDRESSABLE shard's rows as numpy (downloaded via
+    `addressable_shards`, so each process touches only its own devices —
+    a plain np.asarray on a P(AXIS)-sharded array raises under multi-host
+    execution) and re-uploads column blocks as global arrays via
+    jax.make_array_from_single_device_arrays.
+    """
+
+    def __init__(self, global_shape, sharding, shards):
+        self.global_shape = global_shape  # (S, cap)
+        self.sharding = sharding
+        #: list of (device, index-tuple, np rows [1, cap]) per local shard
+        self.shards = shards
+
+    @classmethod
+    def download(cls, arr) -> "_HostSpill":
+        shards = [
+            (s.device, s.index, np.asarray(s.data))
+            for s in arr.addressable_shards
+        ]
+        return cls(arr.shape, arr.sharding, shards)
+
+    @property
+    def cap(self) -> int:
+        return self.global_shape[1]
+
+    def block(self, off: int, width: int):
+        """Upload rows [:, off:off+width] as a global [S, width] array."""
+        parts = [
+            jax.device_put(rows[:, off:off + width], device)
+            for device, _, rows in self.shards
+        ]
+        return jax.make_array_from_single_device_arrays(
+            (self.global_shape[0], width), self.sharding, parts
+        )
 
 
 class _SLevel:
@@ -269,6 +421,12 @@ class ShardedSolver:
         #: number of capacity-overflow retries taken (forward + backward);
         #: the observable for the spill-path tests.
         self.spill_retries = 0
+        #: per-shard window capacity above which resolved levels spill to
+        #: host and stream back through HBM in blocks during lookup.
+        self.window_block = _window_block()
+        #: number of window blocks streamed through HBM (observable for the
+        #: window-streaming tests; 0 when every window stayed resident).
+        self.window_stream_blocks = 0
         # Mesh identity participates in the process-wide kernel cache key
         # (same shard count over different device sets must not share).
         self._mesh_key = tuple(d.id for d in self.mesh.devices.flat)
@@ -350,6 +508,63 @@ class ShardedSolver:
             "sbwd",
             (self._mesh_key, cap, tuple(window_caps), qcap),
             build,
+        )
+
+    def _route_fn(self, cap: int, qcap: int):
+        """Compiled streamed-backward phase 1 (see _sharded_route_step)."""
+        mesh, S = self.mesh, self.S
+
+        def build(game):
+            def per_shard(local):
+                return _sharded_route_step(game, S, qcap, local)
+
+            return jax.shard_map(
+                per_shard,
+                mesh=mesh,
+                in_specs=P(AXIS),
+                out_specs=(P(AXIS),) * 5 + (P(),),
+                check_vma=False,  # all_gathered qcounts ARE replicated
+            )
+
+        return get_kernel(
+            self.game, "srt", (self._mesh_key, cap, qcap), build
+        )
+
+    def _lookup_acc_fn(self, qcap: int, wcap: int):
+        """Compiled streamed-backward phase 2 (one window block)."""
+        mesh = self.mesh
+
+        def build(game):
+            return jax.shard_map(
+                _sharded_lookup_acc_step,
+                mesh=mesh,
+                in_specs=(P(AXIS),) * 5,
+                out_specs=P(AXIS),
+            )
+
+        return get_kernel(
+            self.game, "sla", (self._mesh_key, qcap, wcap), build
+        )
+
+    def _reply_fn(self, cap: int, qcap: int):
+        """Compiled streamed-backward phase 3 (see _sharded_reply_step)."""
+        mesh, S = self.mesh, self.S
+
+        def build(game):
+            def per_shard(local, acc, s_owner, pos, order):
+                return _sharded_reply_step(game, S, qcap, local, acc,
+                                           s_owner, pos, order)
+
+            return jax.shard_map(
+                per_shard,
+                mesh=mesh,
+                in_specs=(P(AXIS),) * 5,
+                out_specs=(P(AXIS), P(AXIS), P()),
+                check_vma=False,  # psum misses ARE replicated
+            )
+
+        return get_kernel(
+            self.game, "srp", (self._mesh_key, cap, qcap), build
         )
 
     def _root_fn(self, cap: int):
@@ -580,27 +795,54 @@ class ShardedSolver:
             qcap = bucket_size(max_sent)
         return values, rem, misses
 
-    def _resolve_blocked(self, stacked, window_caps: tuple, window_flat):
-        """Backward-resolve a level, in column blocks when it is wide.
+    def _run_backward_step_streamed(self, stacked, cap: int, windows):
+        """One backward step with the window STREAMED through HBM in blocks.
 
-        Per-shard temporaries (child blocks, routing buffers) scale with
-        the block, not the level — the HBM bound the 6x6/6x7 capacity plan
-        relies on (docs/ARCHITECTURE.md). The window stays whole: it is
-        13 B/position, the budget the plan is written against.
+        windows: list of (states, values, remoteness) _HostSpill triples,
+        each [S, wcapL] (padded, per-shard-sorted slices). Route once, then
+        per window block: upload [S, wblock] slices, look up, accumulate
+        packed cells; reply once. Per-shard window memory is O(wblock);
+        queries/bookkeeping are O(cap·M) — both independent of level size.
+
+        Known cost at extreme scale: when the RESOLVING side also blocks
+        (_resolve_blocked_streamed), the window is re-uploaded once per
+        resolve block — host->device traffic x (level/backward_block). The
+        fix direction is a rotating HBM pool of window blocks shared across
+        resolve blocks; not needed below 7x6 scale.
         """
+        qcap = self._initial_route_cap(cap)
+        while True:
+            queries, acc, s_owner, pos, order, qcounts = self._route_fn(
+                cap, qcap
+            )(stacked)
+            max_sent = int(np.asarray(qcounts).max())
+            if max_sent <= qcap:
+                break
+            self.spill_retries += 1
+            qcap = bucket_size(max_sent)
+        for ws, wv, wr in windows:
+            wb = min(self.window_block, ws.cap)
+            for off in range(0, ws.cap, wb):
+                blk = (ws.block(off, wb), wv.block(off, wb),
+                       wr.block(off, wb))
+                acc = self._lookup_acc_fn(qcap, wb)(queries, acc, *blk)
+                self.window_stream_blocks += 1
+        return self._reply_fn(cap, qcap)(stacked, acc, s_owner, pos, order)
+
+    def _blocked_loop(self, stacked, step):
+        """Column-block the resolving side: run `step(block_slice, block)`
+        per block and concatenate. Shared by the resident and streamed
+        resolvers — the block arithmetic must stay identical for their
+        kernel keys to match the pre-scheduled shapes."""
         cap = stacked.shape[1]
         # Power-of-two floor: divides the (power-of-two) cap exactly.
         block = 1 << max(self.backward_block, 1).bit_length() - 1
         if cap <= block:
-            return self._run_backward_step(stacked, cap, window_caps,
-                                           window_flat)
+            return step(stacked, cap)
         values, rems = [], []
         misses = None
         for off in range(0, cap, block):
-            v, r, m = self._run_backward_step(
-                stacked[:, off : off + block], block, window_caps,
-                window_flat,
-            )
+            v, r, m = step(stacked[:, off : off + block], block)
             values.append(v)
             rems.append(r)
             # Device-side accumulation; synced only under --paranoid.
@@ -609,6 +851,34 @@ class ShardedSolver:
             jnp.concatenate(values, axis=1),
             jnp.concatenate(rems, axis=1),
             misses,
+        )
+
+    def _resolve_blocked(self, stacked, window_caps: tuple, window_flat):
+        """Backward-resolve a level, in column blocks when it is wide.
+
+        Per-shard temporaries (child blocks, routing buffers) scale with
+        the block, not the level — the HBM bound the 6x6/6x7 capacity plan
+        relies on (docs/ARCHITECTURE.md). The window stays resident here;
+        levels wider than window_block take _resolve_blocked_streamed
+        instead, which streams the window through HBM too.
+        """
+        return self._blocked_loop(
+            stacked,
+            lambda blk, c: self._run_backward_step(
+                blk, c, window_caps, window_flat
+            ),
+        )
+
+    def _resolve_blocked_streamed(self, stacked, windows):
+        """Streamed-window resolve, also blocking the resolving side.
+
+        Composes both blockings: per-shard peak is O(resolve block) for
+        children/routing and O(window block) for the window — the full 7x6
+        memory shape (docs/ARCHITECTURE.md capacity plan).
+        """
+        return self._blocked_loop(
+            stacked,
+            lambda blk, c: self._run_backward_step_streamed(blk, c, windows),
         )
 
     def _repartition(self, states: np.ndarray) -> List[np.ndarray]:
@@ -633,6 +903,10 @@ class ShardedSolver:
         S = self.S
         resolved: Dict[int, LevelTable] = {}
         dev_cache: Dict[int, tuple] = {}
+        # Window levels wider than window_block per shard live here as host
+        # numpy triples and are streamed back through HBM in blocks during
+        # lookup (per-shard window memory O(block), not O(level/S)).
+        host_cache: Dict[int, tuple] = {}
         completed = (
             set(self.checkpointer.completed_levels())
             if self.checkpointer is not None
@@ -681,17 +955,34 @@ class ShardedSolver:
                 window_levels = [
                     k + j
                     for j in range(1, g.max_level_jump + 1)
-                    if (k + j) in dev_cache
+                    if (k + j) in dev_cache or (k + j) in host_cache
                 ]
-                window_caps = tuple(
-                    dev_cache[L][0].shape[1] for L in window_levels
-                )
-                window_flat = []
-                for L in window_levels:
-                    window_flat.extend(dev_cache[L])
-                values_dev, rem_dev, misses = self._resolve_blocked(
-                    rec.dev, window_caps, window_flat
-                )
+                if all(L in dev_cache for L in window_levels):
+                    window_caps = tuple(
+                        dev_cache[L][0].shape[1] for L in window_levels
+                    )
+                    window_flat = []
+                    for L in window_levels:
+                        window_flat.extend(dev_cache[L])
+                    values_dev, rem_dev, misses = self._resolve_blocked(
+                        rec.dev, window_caps, window_flat
+                    )
+                else:
+                    # At least one window level was spilled: stream ALL of
+                    # them (a resident one is downloaded once — mixing
+                    # resident and streamed lookups would double the kernel
+                    # shapes for a rare multi-jump corner).
+                    windows = []
+                    for L in window_levels:
+                        if L in host_cache:
+                            windows.append(host_cache[L])
+                        else:
+                            windows.append(tuple(
+                                _HostSpill.download(a) for a in dev_cache[L]
+                            ))
+                    values_dev, rem_dev, misses = (
+                        self._resolve_blocked_streamed(rec.dev, windows)
+                    )
                 if self.paranoid and int(np.asarray(misses).sum()) > 0:
                     raise SolverError(
                         f"level {k}: consistency failures (missed child "
@@ -731,12 +1022,23 @@ class ShardedSolver:
                     jnp.full((1,), init, dtype=g.state_dtype),
                 )
                 self._root_answer = (int(v), int(r))
-            dev_cache[k] = (rec.dev, values_dev, rem_dev)
+            if cap <= self.window_block:
+                dev_cache[k] = (rec.dev, values_dev, rem_dev)
+            else:
+                # Too wide to keep resident as a window: spill to host (via
+                # addressable shards — multi-host safe), to be streamed back
+                # in blocks by shallower levels' lookups.
+                host_cache[k] = tuple(
+                    _HostSpill.download(a)
+                    for a in (rec.dev, values_dev, rem_dev)
+                )
             rec.dev = None  # the cache owns the device copy now
             if not self.store_tables:
                 rec.host = None  # bound host RAM in big-run mode
             for done in [d for d in dev_cache if d > k + g.max_level_jump]:
                 del dev_cache[done]
+            for done in [d for d in host_cache if d > k + g.max_level_jump]:
+                del host_cache[done]
             if self.logger is not None:
                 self.logger.log(
                     {

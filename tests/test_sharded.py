@@ -103,3 +103,33 @@ def test_sharded_root_answer_via_kernel_matches_table():
         int(table.values[i]),
         int(table.remoteness[i]),
     )
+
+
+@pytest.mark.parametrize("spec", ["tictactoe", "nim:heaps=3-4-5"])
+def test_sharded_window_streaming_parity(spec):
+    """Window levels wider than window_block must spill to host and stream
+    back through HBM in blocks (the 7x6 capacity mechanism) — with
+    identical tables and the streaming path demonstrably taken, on both
+    the fast (tictactoe) and generic multi-jump (nim) paths."""
+    single = Solver(get_game(spec), paranoid=True).solve()
+    solver = ShardedSolver(get_game(spec), num_shards=8, paranoid=True)
+    # Below even the smallest bucket (min_bucket=256): every window spills
+    # and streams in >=2 blocks.
+    solver.window_block = 128
+    result = solver.solve()
+    assert solver.window_stream_blocks > 0
+    assert result.value == single.value
+    assert result.remoteness == single.remoteness
+    assert full_table(result) == full_table(single)
+
+
+def test_sharded_window_streaming_composes_with_blocked_backward():
+    """Both blockings at once: resolving side in column blocks AND window
+    side streamed — the full 7x6 memory shape."""
+    single = Solver(get_game("tictactoe")).solve()
+    solver = ShardedSolver(get_game("tictactoe"), num_shards=8, paranoid=True)
+    solver.window_block = 128
+    solver.backward_block = 256
+    result = solver.solve()
+    assert solver.window_stream_blocks > 0
+    assert full_table(result) == full_table(single)
