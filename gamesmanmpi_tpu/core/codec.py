@@ -8,6 +8,7 @@ and checkpoints are flat arrays (utils/checkpoint.py).
 """
 
 import jax.numpy as jnp
+import numpy as np
 
 from gamesmanmpi_tpu.core.values import MAX_REMOTENESS
 
@@ -31,4 +32,18 @@ def unpack_cells(cells):
     """Inverse of pack_cells -> (values uint8, remoteness int32)."""
     values = (cells & _VALUE_MASK).astype(jnp.uint8)
     remoteness = (cells >> _VALUE_BITS).astype(jnp.int32)
+    return values, remoteness
+
+
+def pack_cells_np(values, remoteness):
+    """NumPy twin of pack_cells for host-side code (checkpoint writers)."""
+    v = values.astype(np.uint32) & _VALUE_MASK
+    r = np.clip(remoteness, 0, MAX_REMOTENESS).astype(np.uint32)
+    return v | (np.uint32(r) << np.uint32(_VALUE_BITS))
+
+
+def unpack_cells_np(cells):
+    """NumPy twin of unpack_cells."""
+    values = (cells & _VALUE_MASK).astype(np.uint8)
+    remoteness = (cells >> _VALUE_BITS).astype(np.int32)
     return values, remoteness

@@ -62,7 +62,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from gamesmanmpi_tpu.core.codec import pack_cells, unpack_cells
+from gamesmanmpi_tpu.core.codec import (
+    pack_cells,
+    pack_cells_np,
+    unpack_cells,
+    unpack_cells_np,
+)
 from gamesmanmpi_tpu.core.hashing import owner_shard, owner_shard_np
 from gamesmanmpi_tpu.core.values import UNDECIDED
 from gamesmanmpi_tpu.games.base import TensorGame
@@ -924,31 +929,68 @@ class ShardedSolver:
             cap = rec.dev.shape[1]
             from_checkpoint = k in completed
             if from_checkpoint:
-                # Restart-from-level: reload the solved table, re-partition
-                # it by owner to refill the per-shard window cache.
-                table = self.checkpointer.load_level(k)
-                table = LevelTable(
-                    states=np.asarray(table.states, dtype=g.state_dtype),
-                    values=table.values,
-                    remoteness=table.remoteness,
-                )
-                shards = rec.host_shards()
-                expected = np.sort(np.concatenate(shards)) if shards else \
-                    np.empty(0, g.state_dtype)
-                if table.states.shape[0] != expected.shape[0] or not (
-                    table.states == expected
-                ).all():
-                    raise SolverError(
-                        f"checkpointed level {k} does not match the "
-                        "discovered frontier — stale checkpoint directory?"
-                    )
-                owners = owner_shard_np(table.states, S)
+                # Restart-from-level: refill the per-shard window cache
+                # from the checkpoint. Per-shard files at a matching shard
+                # count load shard-to-shard with no global assembly; a
+                # global file (or a different shard count) goes through
+                # assemble + repartition.
                 pv = np.full((S, cap), UNDECIDED, dtype=np.uint8)
                 pr = np.zeros((S, cap), dtype=np.int32)
-                for s in range(S):
-                    sel = owners == s
-                    pv[s, : sel.sum()] = table.values[sel]
-                    pr[s, : sel.sum()] = table.remoteness[sel]
+                table = None
+                if self.checkpointer.level_shard_count(k) == S:
+                    shards = rec.host_shards()
+                    loaded = []
+                    for s in range(S):
+                        st, cells = self.checkpointer.load_level_shard(k, s)
+                        if st.shape[0] != shards[s].shape[0] or not (
+                            st.astype(g.state_dtype) == shards[s]
+                        ).all():
+                            raise SolverError(
+                                f"checkpointed level {k} (shard {s}) does "
+                                "not match the discovered frontier — stale "
+                                "checkpoint directory?"
+                            )
+                        v, r = unpack_cells_np(cells)
+                        pv[s, : v.shape[0]] = v
+                        pr[s, : r.shape[0]] = r
+                        loaded.append((st, v, r))
+                    if self.store_tables:
+                        # Assemble from the shards already in hand (a
+                        # load_level call would re-read every file).
+                        states = np.concatenate([t[0] for t in loaded])
+                        order = np.argsort(states)
+                        table = LevelTable(
+                            states=states[order].astype(g.state_dtype),
+                            values=np.concatenate(
+                                [t[1] for t in loaded]
+                            )[order],
+                            remoteness=np.concatenate(
+                                [t[2] for t in loaded]
+                            )[order],
+                        )
+                else:
+                    table = self.checkpointer.load_level(k)
+                    table = LevelTable(
+                        states=np.asarray(table.states, dtype=g.state_dtype),
+                        values=table.values,
+                        remoteness=table.remoteness,
+                    )
+                    shards = rec.host_shards()
+                    expected = np.sort(np.concatenate(shards)) if shards \
+                        else np.empty(0, g.state_dtype)
+                    if table.states.shape[0] != expected.shape[0] or not (
+                        table.states == expected
+                    ).all():
+                        raise SolverError(
+                            f"checkpointed level {k} does not match the "
+                            "discovered frontier — stale checkpoint "
+                            "directory?"
+                        )
+                    owners = owner_shard_np(table.states, S)
+                    for s in range(S):
+                        sel = owners == s
+                        pv[s, : sel.sum()] = table.values[sel]
+                        pr[s, : sel.sum()] = table.remoteness[sel]
                 values_dev = jax.device_put(pv, self._sharding)
                 rem_dev = jax.device_put(pr, self._sharding)
             else:
@@ -974,12 +1016,15 @@ class ShardedSolver:
                     # shapes for a rare multi-jump corner).
                     windows = []
                     for L in window_levels:
-                        if L in host_cache:
-                            windows.append(host_cache[L])
-                        else:
-                            windows.append(tuple(
+                        if L not in host_cache:
+                            # Move (not copy) the resident level to the host
+                            # cache: one download, no double memory, and
+                            # shallower levels that window on L reuse it.
+                            host_cache[L] = tuple(
                                 _HostSpill.download(a) for a in dev_cache[L]
-                            ))
+                            )
+                            del dev_cache[L]
+                        windows.append(host_cache[L])
                     values_dev, rem_dev, misses = (
                         self._resolve_blocked_streamed(rec.dev, windows)
                     )
@@ -988,9 +1033,10 @@ class ShardedSolver:
                         f"level {k}: consistency failures (missed child "
                         "lookups or zero-move non-primitive positions)"
                     )
-                need_table = (
-                    self.store_tables or self.checkpointer is not None
-                )
+                # Checkpointing no longer forces a global table: levels are
+                # checkpointed per shard (VERDICT r2 item 4), so big-run +
+                # checkpoint does zero global materialization.
+                need_table = self.store_tables
                 if need_table:
                     # Global table for this level (kept sharded on device
                     # during the solve; materialized for the result).
@@ -1022,6 +1068,10 @@ class ShardedSolver:
                     jnp.full((1,), init, dtype=g.state_dtype),
                 )
                 self._root_answer = (int(v), int(r))
+            if self.checkpointer is not None and not from_checkpoint:
+                # One npz per addressable shard — each multi-host process
+                # writes only the shards it owns, nothing global assembles.
+                self._checkpoint_level_shards(k, rec, values_dev, rem_dev)
             if cap <= self.window_block:
                 dev_cache[k] = (rec.dev, values_dev, rem_dev)
             else:
@@ -1050,13 +1100,65 @@ class ShardedSolver:
                         "secs": time.perf_counter() - t0,
                     }
                 )
-            if (
-                self.checkpointer is not None
-                and not from_checkpoint
-                and table is not None
-            ):
-                self.checkpointer.save_level(k, table)
         return resolved
+
+    @staticmethod
+    def _shard_rows(rec, s: int):
+        """One shard's real rows of a level, downloading only that shard.
+
+        Uses addressable shards when the level is device-resident (multi-
+        host: a process can only ever reach its own shards), else the host
+        copy. Returns None when shard s is not addressable here.
+        """
+        if rec.dev is not None:
+            for sh in rec.dev.addressable_shards:
+                if sh.index[0].start == s:
+                    return np.asarray(sh.data)[0][: int(rec.counts[s])]
+            return None
+        return rec.host_shards()[s]
+
+    def _checkpoint_frontier_shards(self, levels) -> None:
+        """Per-shard frontier snapshot files, one shard at a time.
+
+        No global frontier array assembles anywhere (VERDICT r2 item 4):
+        each (level, shard) row set downloads individually, peak host
+        memory is one shard's frontiers, and under multi-host each process
+        writes only the shards its devices own (process 0 seals the
+        manifest).
+        """
+        for s in range(self.S):
+            pools = {}
+            for k, rec in levels.items():
+                rows = self._shard_rows(rec, s)
+                if rows is not None:
+                    pools[k] = rows
+            if pools or jax.process_count() == 1:
+                self.checkpointer.save_frontier_shard(s, pools)
+        if jax.process_index() == 0:
+            self.checkpointer.finish_frontier_shards(self.S)
+
+    def _checkpoint_level_shards(self, k: int, rec, values_dev,
+                                 rem_dev) -> None:
+        """Checkpoint one resolved level as per-shard npz files.
+
+        Downloads via addressable shards (multi-host: each process sees and
+        writes only its own devices' rows); the shard count is recorded in
+        the manifest by process 0 so resume can validate/repartition.
+        """
+
+        def rows(arr):
+            return {
+                s.index[0].start: np.asarray(s.data)[0]
+                for s in arr.addressable_shards
+            }
+
+        sv, sr, ss = rows(values_dev), rows(rem_dev), rows(rec.dev)
+        for s, states in ss.items():
+            n = int(rec.counts[s])
+            cells = pack_cells_np(sv[s][:n], sr[s][:n])
+            self.checkpointer.save_level_shard(k, s, states[:n], cells)
+        if jax.process_index() == 0:
+            self.checkpointer.finish_level_shards(k, self.S)
 
     # ------------------------------------------------------------------ solve
 
@@ -1066,12 +1168,26 @@ class ShardedSolver:
         init, start_level = canonical_scalar(g, g.initial_state())
         if self.checkpointer is not None:
             self.checkpointer.bind_game(g.name)
-        saved = (
-            self.checkpointer.load_frontiers()
+        saved_shards = (
+            self.checkpointer.load_frontier_shards(self.S)
             if self.checkpointer is not None
             else None
         )
-        if saved is not None:
+        saved = None
+        if saved_shards is None and self.checkpointer is not None:
+            saved = self.checkpointer.load_frontiers()
+        if saved_shards is not None:
+            # Per-shard snapshot at a matching shard count: shard-to-shard
+            # resume, no global assembly or repartition.
+            levels = {}
+            for k, arrs in saved_shards.items():
+                shards = [np.asarray(a, dtype=g.state_dtype) for a in arrs]
+                levels[k] = _SLevel(
+                    np.array([a.shape[0] for a in shards], dtype=np.int64),
+                    None,
+                    shards,
+                )
+        elif saved is not None:
             levels = {}
             for k, v in saved.items():
                 shards = self._repartition(np.asarray(v, dtype=g.state_dtype))
@@ -1086,13 +1202,9 @@ class ShardedSolver:
             shards, counts = self._seed(init)
             pools = {start_level: shards}
             levels = self._forward_generic(pools, start_level)
-        if saved is None and self.checkpointer is not None:
-            self.checkpointer.save_frontiers(
-                {
-                    k: np.sort(np.concatenate(rec.host_shards()))
-                    for k, rec in levels.items()
-                }
-            )
+        if (saved is None and saved_shards is None
+                and self.checkpointer is not None):
+            self._checkpoint_frontier_shards(levels)
         t_forward = time.perf_counter() - t0
         # Positions counted from the per-shard counters, not the tables —
         # valid in store_tables=False mode too.

@@ -254,3 +254,57 @@ def test_cli_query_flag_compat_host(capsys):
     assert rc == 0
     assert "query 3: value=LOSE" in captured.out
     assert "query 77: not reachable" in captured.out
+
+
+def test_sharded_checkpoint_per_shard_files(tmp_path):
+    """Sharded checkpoints are per-shard npz files — no global level or
+    frontier arrays are assembled to write them (VERDICT r2 item 4) — and
+    resume works shard-to-shard at the same shard count AND via
+    repartition at a different one."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        import pytest
+
+        pytest.skip("needs 4 fake devices")
+    import pathlib
+
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+
+    d = str(tmp_path / "shard_ckpt")
+    first = ShardedSolver(
+        get_game("tictactoe"), num_shards=4, store_tables=False,
+        checkpointer=LevelCheckpointer(d),
+    ).solve()
+    files = {p.name for p in pathlib.Path(d).iterdir()}
+    assert any(".shard_" in f and f.startswith("level_") for f in files)
+    assert any(f.startswith("frontiers.shard_") for f in files)
+    # Big-run mode + checkpoint must not write any GLOBAL level/frontier
+    # file (the single-host bottleneck the per-shard format removes).
+    assert not any(
+        f.startswith("level_") and ".shard_" not in f for f in files
+    )
+    assert "frontiers.npz" not in files
+
+    # Same-shard-count resume: shard-to-shard, and no recompute.
+    same = ShardedSolver(
+        get_game("tictactoe"), num_shards=4, store_tables=False,
+        checkpointer=LevelCheckpointer(d),
+    )
+
+    def _poisoned(*a, **k):
+        raise AssertionError("resume recomputed a level")
+
+    same._forward_fn = _poisoned
+    same._backward_fn = _poisoned
+    r_same = same.solve()
+    assert (r_same.value, r_same.remoteness) == (first.value, first.remoteness)
+
+    # Different shard count: assemble + repartition fallback.
+    r_other = ShardedSolver(
+        get_game("tictactoe"), num_shards=2,
+        checkpointer=LevelCheckpointer(d),
+    ).solve()
+    assert (r_other.value, r_other.remoteness) == (
+        first.value, first.remoteness,
+    )
