@@ -29,11 +29,13 @@ SURVEY.md §5.8 prescribes (VERDICT.md round 1, item 2).
 
 Device residency: for uniform_level_jump games the frontier chains on device
 shard-to-shard across levels (the next frontier IS the routed dedup output,
-resized to the next capacity bucket on device), and the backward window is
-the previously-resolved level's device triples. Host work per level is one
-scalar sync (counts) — no per-level np.union1d merging (VERDICT item 3).
-Multi-jump games (children span levels) keep host-side per-level pools in
-the forward phase only; their backward is the same device-resident pass.
+resized to the next capacity bucket on device); multi-jump games (children
+span levels) keep per-level POOLS on device, merged by a per-target-level
+sort-unique kernel (_merge_fn) as each level's routed children arrive. The
+backward window is the previously-resolved level's device triples (or a
+host-spilled stream, see _run_backward_step_streamed). Host work per level
+is counts syncs only — no np.union1d, no per-level downloads (VERDICT r1
+item 3, r2 item 5).
 
 Capacity planning: all_to_all buffers are [num_shards, capacity] with
 SENTINEL padding. Overflow (a shard sending more than capacity to one peer)
@@ -607,13 +609,75 @@ class ShardedSolver:
             self.game, "sroot", (self._mesh_key, cap), build
         )
 
-    def _level_fn(self, cap: int):
-        """Cached level_of kernel for multi-jump child grouping."""
+    def _merge_fn(self, pool_cap: int, child_cap: int):
+        """Merge routed children of one target level into its pool, on device.
+
+        Per shard: select children whose level_of == target (a replicated
+        scalar arg, so one kernel serves every level), concat with the
+        existing pool slice, sort-unique. Both inputs are per-shard sorted
+        owner-consistent sets, so the output is too. Replaces the old
+        host-side np.union1d pool merging (VERDICT r2 item 5).
+        """
+        mesh = self.mesh
+
+        def build(game):
+            def per_shard(pool, kids, target):
+                p, c = pool[0], kids[0]
+                lv = jnp.where(
+                    c != game.sentinel, game.level_of(c), -1
+                )
+                sel = jnp.where(lv == target[0], c, game.sentinel)
+                uniq, count = sort_unique(jnp.concatenate([p, sel]))
+                return uniq[None], jax.lax.all_gather(count, AXIS)
+
+            return jax.shard_map(
+                per_shard,
+                mesh=mesh,
+                in_specs=(P(AXIS), P(AXIS), P()),
+                out_specs=(P(AXIS), P()),
+                check_vma=False,  # all_gathered counts ARE replicated
+            )
+
         return get_kernel(
-            self.game, "lvl", cap,
-            lambda game: lambda states: jnp.where(
-                states != game.sentinel, game.level_of(states), -1
-            ),
+            self.game, "smrg", (self._mesh_key, pool_cap, child_cap), build
+        )
+
+    def _level_check_fn(self, cap: int):
+        """Children-per-target-level histogram + contract check.
+
+        Returns (bad, per_target[J]) replicated: `bad` counts children whose
+        level violates (kmin, kmax] — a broken level_of/max_level_jump/
+        num_levels contract, surfaced instead of silently dropping
+        positions — and per_target[j] counts children at level kmin+1+j, so
+        the merge loop skips target levels that received nothing.
+        """
+        mesh = self.mesh
+
+        def build(game):
+            J = game.max_level_jump
+
+            def per_shard(kids, kmin, kmax):
+                c = kids[0]
+                valid = c != game.sentinel
+                lv = jnp.where(valid, game.level_of(c), -1)
+                bad = jnp.sum(
+                    valid & ((lv <= kmin[0]) | (lv > kmax[0]))
+                )
+                per = jnp.stack(
+                    [jnp.sum(lv == kmin[0] + 1 + j) for j in range(J)]
+                )
+                return jax.lax.psum(bad, AXIS), jax.lax.psum(per, AXIS)
+
+            return jax.shard_map(
+                per_shard,
+                mesh=mesh,
+                in_specs=(P(AXIS), P(), P()),
+                out_specs=(P(), P()),
+                check_vma=False,  # psum outputs ARE replicated
+            )
+
+        return get_kernel(
+            self.game, "schk", (self._mesh_key, cap), build
         )
 
     # ------------------------------------------------------ capacity planning
@@ -707,81 +771,108 @@ class ShardedSolver:
             k += 1
         return levels
 
-    def _forward_generic(self, pools: Dict[int, List[np.ndarray]],
-                         start_level: int) -> Dict[int, _SLevel]:
-        """Host-pooled forward for multi-jump games (children span levels)."""
+    def _forward_generic(self, init, start_level: int) -> Dict[int, _SLevel]:
+        """Device-resident forward for multi-jump games (children span
+        levels).
+
+        Each expanded level's routed children are grouped by topological
+        level and merged into per-level device pools ON DEVICE (one
+        sort-unique merge per reachable target level — see _merge_fn); the
+        old path downloaded every level's children and merged host pools
+        with np.union1d. Host work per level is counts syncs only
+        (VERDICT r2 item 5). Levels pop in ascending order, so every
+        contribution to level L lands before L is expanded.
+        """
         g = self.game
         S = self.S
-        k = start_level
-        while pools and k <= max(pools):
-            if k not in pools:
-                k += 1
-                continue
+        J = g.max_level_jump
+        shards, counts = self._seed(init)
+        cap0 = bucket_size(1, self.min_bucket)
+        frontier0 = jax.device_put(_pad_shards(shards, cap0), self._sharding)
+        levels: Dict[int, _SLevel] = {}
+        #: level -> (dev [S, cap] per-shard sorted pool, np [S] counts)
+        pools: Dict[int, tuple] = {start_level: (frontier0, counts)}
+        stored_bytes = 0
+        while pools:
+            k = min(pools)
             t0 = time.perf_counter()
-            shards = pools[k]
-            cap = bucket_size(max(a.shape[0] for a in shards), self.min_bucket)
-            total = sum(a.shape[0] for a in shards)
-            stacked = jax.device_put(
-                _pad_shards(shards, cap), self._sharding
-            )
+            frontier, counts = pools.pop(k)
+            rec = _SLevel(counts, frontier, None)
+            levels[k] = rec
+            if stored_bytes + frontier.nbytes > self.device_store_bytes:
+                rec.host_shards()
+                rec.dev = None
+            else:
+                stored_bytes += frontier.nbytes
+            cap = frontier.shape[1]
             route_cap = self._initial_route_cap(cap)
             while True:
                 uniq, count, send_counts = self._forward_fn(cap, route_cap)(
-                    stacked
+                    frontier
                 )
                 max_sent = int(np.asarray(send_counts).max())
                 if max_sent <= route_cap:
                     break
                 self.spill_retries += 1
                 route_cap = bucket_size(max_sent)
-            uniq = np.asarray(uniq)
-            count = np.asarray(count).reshape(-1)
-            # Children land in their levels' pools, grouped by each child's
-            # topological level (computed on device in one pass).
-            for s in range(S):
-                n = int(count[s])
-                kids = uniq[s, :n]
-                if n == 0:
-                    continue
-                lcap = bucket_size(n, self.min_bucket)
-                kid_levels = np.asarray(
-                    self._level_fn(lcap)(
-                        jnp.asarray(_pad_shards([kids], lcap)[0])
+            ccounts = np.asarray(count).reshape(-1)
+            total = int(ccounts.sum())
+            if total > 0:
+                ccap = bucket_size(int(ccounts.max()), self.min_bucket)
+                children = self._resize_fn(uniq.shape[-1], ccap)(uniq)
+                kmax = min(k + J, g.num_levels - 1)
+                bad, per_target = self._level_check_fn(ccap)(
+                    children,
+                    np.full(1, k, np.int32),
+                    np.full(1, kmax, np.int32),
+                )
+                per_target = np.asarray(per_target)
+                if int(bad) > 0:
+                    raise SolverError(
+                        f"game {g.name}: {int(bad)} children outside levels "
+                        f"({k}, {kmax}] — level_of/max_level_jump/"
+                        "num_levels inconsistent"
                     )
-                )[:n]
-                for lv in np.unique(kid_levels):
-                    lv = int(lv)
-                    if lv >= g.num_levels:
-                        raise SolverError(
-                            f"game {g.name}: children found at level {lv} "
-                            f"but num_levels={g.num_levels} — "
-                            "level_of/num_levels inconsistent"
-                        )
-                    batch = kids[kid_levels == lv]
-                    if lv not in pools:
-                        pools[lv] = [np.empty(0, g.state_dtype)
-                                     for _ in range(S)]
-                    pools[lv][s] = np.union1d(pools[lv][s], batch)
+                empty_pool = None
+                for j in range(1, J + 1):
+                    L = k + j
+                    if L >= g.num_levels:
+                        break
+                    if int(per_target[j - 1]) == 0:
+                        continue  # no child landed here; skip the merge
+                    pool, _ = pools.get(L, (None, None))
+                    if pool is None:
+                        if empty_pool is None:
+                            empty_pool = jax.device_put(
+                                _pad_shards(
+                                    [np.empty(0, g.state_dtype)] * S,
+                                    bucket_size(1, self.min_bucket),
+                                ),
+                                self._sharding,
+                            )
+                        pool = empty_pool
+                    merged, mcount = self._merge_fn(pool.shape[1], ccap)(
+                        pool, children, np.full(1, L, np.int32)
+                    )
+                    mcounts = np.asarray(mcount).reshape(-1).astype(np.int64)
+                    mcap = bucket_size(int(mcounts.max()), self.min_bucket)
+                    pools[L] = (
+                        self._resize_fn(merged.shape[-1], mcap)(merged),
+                        mcounts,
+                    )
             if self.logger is not None:
                 self.logger.log(
                     {
                         "phase": "forward",
                         "level": k,
-                        "frontier": total,
+                        "frontier": int(counts.sum()),
+                        "children": total,
                         "shards": S,
                         "route_cap": route_cap,
                         "secs": time.perf_counter() - t0,
                     }
                 )
-            k += 1
-        return {
-            k: _SLevel(
-                np.array([a.shape[0] for a in shards], dtype=np.int64),
-                None,
-                shards,
-            )
-            for k, shards in pools.items()
-        }
+        return levels
 
     def _run_backward_step(self, stacked, cap: int, window_caps: tuple,
                            window_flat) -> tuple:
@@ -1103,7 +1194,16 @@ class ShardedSolver:
         return resolved
 
     @staticmethod
-    def _shard_rows(rec, s: int):
+    def _shard_id(shard) -> int:
+        """Global shard index of an addressable shard.
+
+        A 1-device sharding reports index (slice(None), ...) — start is
+        None, meaning offset 0 (this crashed num_shards=1 checkpointing
+        when formatted into a filename).
+        """
+        return shard.index[0].start or 0
+
+    def _shard_rows(self, rec, s: int):
         """One shard's real rows of a level, downloading only that shard.
 
         Uses addressable shards when the level is device-resident (multi-
@@ -1112,10 +1212,29 @@ class ShardedSolver:
         """
         if rec.dev is not None:
             for sh in rec.dev.addressable_shards:
-                if sh.index[0].start == s:
+                if self._shard_id(sh) == s:
                     return np.asarray(sh.data)[0][: int(rec.counts[s])]
             return None
+        if jax.process_count() > 1:
+            # A host-spilled level under multi-host cannot be attributed to
+            # one writer per shard (the spill itself is single-process);
+            # refuse rather than write racy snapshot files.
+            raise SolverError(
+                "frontier checkpointing of host-spilled levels is not "
+                "supported under multi-host execution — raise "
+                "GAMESMAN_DEVICE_STORE_MB or checkpoint from a single host"
+            )
         return rec.host_shards()[s]
+
+    @staticmethod
+    def _sync_processes(tag: str) -> None:
+        """Barrier across processes before sealing a checkpoint manifest —
+        process 0 must not mark shard sets complete while peers still
+        write (torn checkpoints on preemption otherwise)."""
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(tag)
 
     def _checkpoint_frontier_shards(self, levels) -> None:
         """Per-shard frontier snapshot files, one shard at a time.
@@ -1134,6 +1253,7 @@ class ShardedSolver:
                     pools[k] = rows
             if pools or jax.process_count() == 1:
                 self.checkpointer.save_frontier_shard(s, pools)
+        self._sync_processes("frontier_shards_written")
         if jax.process_index() == 0:
             self.checkpointer.finish_frontier_shards(self.S)
 
@@ -1148,7 +1268,7 @@ class ShardedSolver:
 
         def rows(arr):
             return {
-                s.index[0].start: np.asarray(s.data)[0]
+                self._shard_id(s): np.asarray(s.data)[0]
                 for s in arr.addressable_shards
             }
 
@@ -1157,6 +1277,7 @@ class ShardedSolver:
             n = int(rec.counts[s])
             cells = pack_cells_np(sv[s][:n], sr[s][:n])
             self.checkpointer.save_level_shard(k, s, states[:n], cells)
+        self._sync_processes(f"level_{k}_shards_written")
         if jax.process_index() == 0:
             self.checkpointer.finish_level_shards(k, self.S)
 
@@ -1199,9 +1320,7 @@ class ShardedSolver:
         elif self.fast:
             levels = self._forward_fast(init, start_level)
         else:
-            shards, counts = self._seed(init)
-            pools = {start_level: shards}
-            levels = self._forward_generic(pools, start_level)
+            levels = self._forward_generic(init, start_level)
         if (saved is None and saved_shards is None
                 and self.checkpointer is not None):
             self._checkpoint_frontier_shards(levels)

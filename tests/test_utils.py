@@ -308,3 +308,20 @@ def test_sharded_checkpoint_per_shard_files(tmp_path):
     assert (r_other.value, r_other.remoteness) == (
         first.value, first.remoteness,
     )
+
+
+def test_sharded_checkpoint_single_shard(tmp_path):
+    """num_shards=1 checkpoints and resumes (a 1-device sharding reports
+    shard index slice(None) — start None — which must map to shard 0)."""
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+
+    d = str(tmp_path / "one_shard")
+    first = ShardedSolver(
+        get_game("subtract:total=10,moves=1-2"), num_shards=1,
+        checkpointer=LevelCheckpointer(d),
+    ).solve()
+    resumed = ShardedSolver(
+        get_game("subtract:total=10,moves=1-2"), num_shards=1,
+        checkpointer=LevelCheckpointer(d),
+    ).solve()
+    assert (resumed.value, resumed.remoteness) == (first.value, first.remoteness)
