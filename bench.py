@@ -176,6 +176,22 @@ def main() -> int:
         except Exception as e:  # pragma: no cover - diagnostic only
             print(f"sym bench failed: {e!r}", file=sys.stderr)
 
+    # Roofline framing (SURVEY.md §5.5): analytic operand bytes of the
+    # sort/gather kernels vs the chip's HBM bandwidth. v5e HBM is 819 GB/s;
+    # XLA's sort makes ~log2(n) passes, so true HBM traffic is a multiple
+    # of operand bytes — this fraction is a LOWER bound on utilization
+    # (docs/ARCHITECTURE.md "Efficiency accounting").
+    roofline = float(os.environ.get("GAMESMAN_HBM_GBPS", "819"))
+    traffic = stats.get("bytes_sorted", 0) + stats.get("bytes_gathered", 0)
+    operand_gbps = traffic / max(stats["secs_total"], 1e-9) / 1e9
+    efficiency = {
+        "bytes_sorted": stats.get("bytes_sorted", 0),
+        "bytes_gathered": stats.get("bytes_gathered", 0),
+        "operand_gbps": round(operand_gbps, 3),
+        "hbm_roofline_gbps": roofline,
+        "roofline_frac": round(operand_gbps / roofline, 6),
+    }
+
     north_star_per_chip = 4.5e12 / 3600.0 / 32.0  # 39.06M pos/s/chip
     record = {
         "metric": f"{get_game(spec).name}_positions_solved_per_sec_per_chip",
@@ -187,6 +203,7 @@ def main() -> int:
         "secs_forward": round(stats["secs_forward"], 3),
         "secs_backward": round(stats["secs_backward"], 3),
         "positions": stats["positions"],
+        "efficiency": efficiency,
     }
     if sym is not None:
         record["sym"] = sym

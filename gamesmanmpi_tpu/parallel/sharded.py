@@ -434,6 +434,14 @@ class ShardedSolver:
         #: number of window blocks streamed through HBM (observable for the
         #: window-streaming tests; 0 when every window stayed resident).
         self.window_stream_blocks = 0
+        # Analytic traffic counters (SURVEY.md §5.5): payload bytes of the
+        # all_to_all collectives and operand bytes of the sort/gather
+        # kernels — the denominators that make positions/sec readable
+        # against ICI/HBM rooflines (docs/ARCHITECTURE.md "Efficiency
+        # accounting").
+        self.bytes_routed = 0
+        self.bytes_sorted = 0
+        self.bytes_gathered = 0
         # Mesh identity participates in the process-wide kernel cache key
         # (same shard count over different device sets must not share).
         self._mesh_key = tuple(d.id for d in self.mesh.devices.flat)
@@ -732,6 +740,9 @@ class ShardedSolver:
                     break
                 self.spill_retries += 1
                 route_cap = bucket_size(max_sent)
+            item = np.dtype(g.state_dtype).itemsize
+            self.bytes_routed += S * S * route_cap * item
+            self.bytes_sorted += S * S * route_cap * item
             counts = np.asarray(count).reshape(-1).astype(np.int64)
             total = int(counts.sum())
             if total == 0:
@@ -765,6 +776,8 @@ class ShardedSolver:
                         "children": total,
                         "shards": S,
                         "route_cap": route_cap,
+                        "bytes_routed": S * S * route_cap * item,
+                        "bytes_sorted": S * S * route_cap * item,
                         "secs": time.perf_counter() - t0,
                     }
                 )
@@ -796,10 +809,16 @@ class ShardedSolver:
         while pools:
             k = min(pools)
             t0 = time.perf_counter()
+            b0 = (self.bytes_routed, self.bytes_sorted)
             frontier, counts = pools.pop(k)
             rec = _SLevel(counts, frontier, None)
             levels[k] = rec
-            if stored_bytes + frontier.nbytes > self.device_store_bytes:
+            # Pending (not yet popped) pools are live device state too —
+            # count them against the budget when deciding whether this
+            # retained level may stay resident.
+            pending_bytes = sum(p.nbytes for p, _ in pools.values())
+            if (stored_bytes + pending_bytes + frontier.nbytes
+                    > self.device_store_bytes):
                 rec.host_shards()
                 rec.dev = None
             else:
@@ -815,6 +834,9 @@ class ShardedSolver:
                     break
                 self.spill_retries += 1
                 route_cap = bucket_size(max_sent)
+            item = np.dtype(g.state_dtype).itemsize
+            self.bytes_routed += S * S * route_cap * item
+            self.bytes_sorted += S * S * route_cap * item
             ccounts = np.asarray(count).reshape(-1)
             total = int(ccounts.sum())
             if total > 0:
@@ -854,6 +876,7 @@ class ShardedSolver:
                     merged, mcount = self._merge_fn(pool.shape[1], ccap)(
                         pool, children, np.full(1, L, np.int32)
                     )
+                    self.bytes_sorted += S * (pool.shape[1] + ccap) * item
                     mcounts = np.asarray(mcount).reshape(-1).astype(np.int64)
                     mcap = bucket_size(int(mcounts.max()), self.min_bucket)
                     pools[L] = (
@@ -869,6 +892,8 @@ class ShardedSolver:
                         "children": total,
                         "shards": S,
                         "route_cap": route_cap,
+                        "bytes_routed": self.bytes_routed - b0[0],
+                        "bytes_sorted": self.bytes_sorted - b0[1],
                         "secs": time.perf_counter() - t0,
                     }
                 )
@@ -889,6 +914,16 @@ class ShardedSolver:
                 break
             self.spill_retries += 1
             qcap = bucket_size(max_sent)
+        if qcap:
+            S = self.S
+            item = np.dtype(self.game.state_dtype).itemsize
+            # Queries out (state bytes) + packed cells back.
+            self.bytes_routed += S * S * qcap * (item + 4)
+            # Sort-merge join operands + fused payload gather w/ indices.
+            self.bytes_sorted += (
+                S * (S * qcap + sum(window_caps)) * (item + 4)
+            )
+            self.bytes_gathered += S * S * qcap * 12
         return values, rem, misses
 
     def _run_backward_step_streamed(self, stacked, cap: int, windows):
@@ -916,6 +951,9 @@ class ShardedSolver:
                 break
             self.spill_retries += 1
             qcap = bucket_size(max_sent)
+        S = self.S
+        item = np.dtype(self.game.state_dtype).itemsize
+        self.bytes_routed += S * S * qcap * (item + 4)
         for ws, wv, wr in windows:
             wb = min(self.window_block, ws.cap)
             for off in range(0, ws.cap, wb):
@@ -923,6 +961,8 @@ class ShardedSolver:
                        wr.block(off, wb))
                 acc = self._lookup_acc_fn(qcap, wb)(queries, acc, *blk)
                 self.window_stream_blocks += 1
+                self.bytes_sorted += S * (S * qcap + wb) * (item + 4)
+                self.bytes_gathered += S * S * qcap * 12
         return self._reply_fn(cap, qcap)(stacked, acc, s_owner, pos, order)
 
     def _blocked_loop(self, stacked, step):
@@ -1010,6 +1050,7 @@ class ShardedSolver:
         )
         for k in sorted(levels, reverse=True):
             t0 = time.perf_counter()
+            b0 = (self.bytes_routed, self.bytes_sorted, self.bytes_gathered)
             rec = levels[k]
             n_max = int(rec.counts.max()) if rec.counts.size else 0
             if rec.dev is None:
@@ -1188,6 +1229,9 @@ class ShardedSolver:
                         "n": int(rec.counts.sum()),
                         "shards": S,
                         "resumed": from_checkpoint,
+                        "bytes_routed": self.bytes_routed - b0[0],
+                        "bytes_sorted": self.bytes_sorted - b0[1],
+                        "bytes_gathered": self.bytes_gathered - b0[2],
                         "secs": time.perf_counter() - t0,
                     }
                 )
@@ -1338,8 +1382,12 @@ class ShardedSolver:
             "levels": len(levels),
             "spill_retries": self.spill_retries,
             "secs_forward": t_forward,
+            "secs_backward": t_total - t_forward,
             "secs_total": t_total,
             "positions_per_sec": num_positions / max(t_total, 1e-9),
+            "bytes_routed": self.bytes_routed,
+            "bytes_sorted": self.bytes_sorted,
+            "bytes_gathered": self.bytes_gathered,
         }
         if self.logger is not None:
             self.logger.log({"phase": "done", **stats})

@@ -427,6 +427,14 @@ class Solver:
         self.fast = bool(game.uniform_level_jump) and not force_generic
         self.device_store_bytes = _device_store_bytes()
         self.backward_block = _backward_block()
+        # Analytic traffic counters (SURVEY.md §5.5): operand bytes of the
+        # sort/gather kernels, the denominators that turn positions/sec
+        # into a roofline fraction for this memory-bound workload. Computed
+        # from static shapes (no device counters); XLA's TPU sort makes
+        # ~log2(n) passes, so HBM traffic is ~log2(n) x these bytes — the
+        # convention docs/ARCHITECTURE.md states.
+        self.bytes_sorted = 0
+        self.bytes_gathered = 0
         # Background compiles only pay off where compiles are expensive
         # (remote accelerator); on CPU they would just slow the test suite.
         flag = os.environ.get("GAMESMAN_PRECOMPILE", "auto")
@@ -717,6 +725,12 @@ class Solver:
                 stored_bytes += nxt.nbytes
             levels[k + 1] = rec
             frontier = nxt
+            # expand_provenance sorts: (child, origin int32) pair +
+            # (origin, uid) int32 pair + the compaction re-sort
+            # = cap*M*(2*itemsize + 12) bytes of sort operands.
+            item = np.dtype(g.state_dtype).itemsize
+            level_sort_bytes = cap * g.max_moves * (2 * item + 12)
+            self.bytes_sorted += level_sort_bytes
             if self.logger is not None:
                 self.logger.log(
                     {
@@ -724,6 +738,7 @@ class Solver:
                         "level": k,
                         "frontier": levels[k].n,
                         "children": n,
+                        "bytes_sorted": level_sort_bytes,
                         "secs": time.perf_counter() - t0,
                     }
                 )
@@ -798,6 +813,8 @@ class Solver:
             states_dev = self._pad_dev(states_dev, C, g.sentinel)
             cap = states_dev.shape[0]
             from_checkpoint = k in completed
+            item = np.dtype(g.state_dtype).itemsize
+            lvl_sort_bytes = lvl_gather_bytes = 0
             if from_checkpoint:
                 table = self.checkpointer.load_level(k)
                 states_host = rec.host_states()
@@ -812,6 +829,8 @@ class Solver:
                 rem_dev = jnp.asarray(pad_to_cap_i32(table.remoteness, cap))
             else:
                 if prev is not None and rec.uidx is not None:
+                    # uidx read (4 B) + packed-cell gather (4 B) per child.
+                    lvl_gather_bytes = C * g.max_moves * 8
                     # Gather-only resolve from forward provenance: no
                     # search, no re-expansion (see resolve_provenance).
                     wcap = caps[k + 1]
@@ -827,6 +846,11 @@ class Solver:
                         self._pad_dev(wr, C, np.int32(0)),
                     )
                 else:
+                    if prev is not None:
+                        # Sort-merge join operands + fused u64 payload
+                        # gather with its i32 indices.
+                        lvl_sort_bytes = (C * g.max_moves + C) * (item + 4)
+                        lvl_gather_bytes = C * g.max_moves * 12
                     if prev is None:
                         args, wcaps = (), ()
                     else:
@@ -880,6 +904,8 @@ class Solver:
                 np.asarray(misses)
             if not self.store_tables:
                 rec.host = None
+            self.bytes_sorted += lvl_sort_bytes
+            self.bytes_gathered += lvl_gather_bytes
             if self.logger is not None:
                 self.logger.log(
                     {
@@ -887,6 +913,8 @@ class Solver:
                         "level": k,
                         "n": n,
                         "resumed": from_checkpoint,
+                        "bytes_sorted": lvl_sort_bytes,
+                        "bytes_gathered": lvl_gather_bytes,
                         "secs": time.perf_counter() - t0,
                     }
                 )
@@ -1090,6 +1118,11 @@ class Solver:
             "secs_backward": t_total - t_forward,
             "secs_total": t_total,
             "positions_per_sec": num_positions / max(t_total, 1e-9),
+            # Roofline denominators (SURVEY.md §5.5): analytic operand
+            # bytes of the sort/gather kernels; see docs/ARCHITECTURE.md
+            # "Efficiency accounting" for how to read them.
+            "bytes_sorted": self.bytes_sorted,
+            "bytes_gathered": self.bytes_gathered,
         }
         if self.logger is not None:
             self.logger.log({"phase": "done", **stats})
