@@ -181,7 +181,11 @@ def main() -> int:
     # XLA's sort makes ~log2(n) passes, so true HBM traffic is a multiple
     # of operand bytes — this fraction is a LOWER bound on utilization
     # (docs/ARCHITECTURE.md "Efficiency accounting").
-    roofline = float(os.environ.get("GAMESMAN_HBM_GBPS", "819"))
+    try:
+        roofline = max(float(os.environ.get("GAMESMAN_HBM_GBPS", "819")),
+                       1e-9)
+    except ValueError:
+        roofline = 819.0
     traffic = stats.get("bytes_sorted", 0) + stats.get("bytes_gathered", 0)
     operand_gbps = traffic / max(stats["secs_total"], 1e-9) / 1e9
     efficiency = {

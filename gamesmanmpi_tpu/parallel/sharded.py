@@ -730,6 +730,7 @@ class ShardedSolver:
         k = start_level
         while True:
             t0 = time.perf_counter()
+            b0 = (self.bytes_routed, self.bytes_sorted)
             route_cap = self._initial_route_cap(cap)
             while True:
                 uniq, count, send_counts = self._forward_fn(cap, route_cap)(
@@ -776,8 +777,8 @@ class ShardedSolver:
                         "children": total,
                         "shards": S,
                         "route_cap": route_cap,
-                        "bytes_routed": S * S * route_cap * item,
-                        "bytes_sorted": S * S * route_cap * item,
+                        "bytes_routed": self.bytes_routed - b0[0],
+                        "bytes_sorted": self.bytes_sorted - b0[1],
                         "secs": time.perf_counter() - t0,
                     }
                 )

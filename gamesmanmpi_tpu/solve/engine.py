@@ -938,6 +938,12 @@ class Solver:
             uniq, levels, count = self._fwd_generic(padded.shape[0])(
                 jnp.asarray(padded)
             )
+            # expand_core's sort + compaction re-sort.
+            lvl_sort_bytes = (
+                2 * padded.shape[0] * g.max_moves
+                * np.dtype(g.state_dtype).itemsize
+            )
+            self.bytes_sorted += lvl_sort_bytes
             n = int(count)
             kids = np.asarray(uniq[:n])
             kid_levels = np.asarray(levels[:n])
@@ -961,6 +967,7 @@ class Solver:
                         "level": k,
                         "frontier": int(frontier.shape[0]),
                         "children": n,
+                        "bytes_sorted": lvl_sort_bytes,
                         "secs": time.perf_counter() - t0,
                     }
                 )
@@ -991,6 +998,7 @@ class Solver:
             padded = pad_to_bucket(states, self.min_bucket)
             n = states.shape[0]
             from_checkpoint = k in completed
+            lvl_sort_bytes = lvl_gather_bytes = 0
             if from_checkpoint:
                 table = self.checkpointer.load_level(k)
                 if table.states.shape[0] != n or not (
@@ -1010,6 +1018,15 @@ class Solver:
                 for L in window_levels:
                     window_flat.extend(padded_cache[L])
                 wcaps = tuple(padded_cache[L][0].shape[0] for L in window_levels)
+                # Per-window-level sort-merge joins + fused payload gathers.
+                item = np.dtype(g.state_dtype).itemsize
+                cm = padded.shape[0] * g.max_moves
+                lvl_sort_bytes = sum(
+                    (cm + w) * (item + 4) for w in wcaps
+                )
+                lvl_gather_bytes = cm * 12 * len(wcaps)
+                self.bytes_sorted += lvl_sort_bytes
+                self.bytes_gathered += lvl_gather_bytes
                 values_dev, rem_dev, misses = self._resolve_blocked(
                     jnp.asarray(padded), wcaps,
                     tuple(jnp.asarray(a) for a in window_flat),
@@ -1043,6 +1060,8 @@ class Solver:
                         "level": k,
                         "n": n,
                         "resumed": from_checkpoint,
+                        "bytes_sorted": lvl_sort_bytes,
+                        "bytes_gathered": lvl_gather_bytes,
                         "secs": time.perf_counter() - t0,
                     }
                 )

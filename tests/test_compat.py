@@ -130,3 +130,34 @@ def test_tensorized_module_tictactoe():
     result = Solver(game, paranoid=True).solve()
     assert result.value == TIE and result.remoteness == 9
     assert result.num_positions == 5478
+
+
+def test_tensorized_module_sharded_8_with_spill_retry():
+    """The advertised `--devices 8` compat path at full width, with the
+    route-capacity retry forced (VERDICT r2 weak #7 / item 8): an
+    unmodified scalar module through ShardedSolver at 8 shards, on a game
+    big enough to have real routing load, must survive an undersized first
+    routing capacity (spill_retries > 0) and keep full-table parity with
+    the host oracle."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (fake) devices")
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+
+    module = load_game_module(REF_GAMES / "tictactoe.py")
+    game = TensorizedModule(
+        module,
+        max_moves=9,
+        level_fn=lambda pos: bin(pos).count("1"),
+        num_levels=10,
+    )
+    solver = ShardedSolver(game, num_shards=8, paranoid=True)
+    # Undersized first attempt on every route: forces the overflow retry
+    # loop through the host-callback kernels too.
+    solver._initial_route_cap = lambda cap: 1
+    result = solver.solve()
+    assert solver.spill_retries > 0
+    _, _, oracle_table = solve_module(module)
+    assert result.value == TIE
+    assert_table_parity(result, oracle_table)
