@@ -168,13 +168,35 @@ def main() -> int:
     if os.environ.get("BENCH_SYM", "1") not in ("0", "off") and "sym" not in spec:
         try:
             sep = "," if ":" in spec else ":"
-            sym_pps, sym_stats = run_solves(spec + sep + "sym=1", 1)
+            # 2 runs: the sym kernels are a separate compile family, so the
+            # first run is compile-dominated; best-of reports the warm rate.
+            sym_pps, sym_stats = run_solves(spec + sep + "sym=1", 2)
             sym = {
                 "positions_per_sec": round(sym_pps, 1),
                 "positions": sym_stats["positions"],
             }
         except Exception as e:  # pragma: no cover - diagnostic only
             print(f"sym bench failed: {e!r}", file=sys.stderr)
+
+    # Board ladder (BASELINE.md configs #3-#4): one solve of the next
+    # board up, recorded alongside the primary metric. Default 6x4 (~95M
+    # positions, the widest uint32 board); BENCH_LADDER=0 disables,
+    # BENCH_LADDER=<spec> overrides.
+    ladder = None
+    ladder_spec = os.environ.get("BENCH_LADDER", "connect4:w=6,h=4")
+    if (ladder_spec not in ("0", "off", "") and ladder_spec != spec
+            and dev.platform != "cpu"):
+        try:
+            lad_pps, lad_stats = run_solves(ladder_spec, 2)
+            ladder = {
+                "game": lad_stats["game"],
+                "positions": lad_stats["positions"],
+                "positions_per_sec": round(lad_pps, 1),
+                "secs_forward": round(lad_stats["secs_forward"], 3),
+                "secs_backward": round(lad_stats["secs_backward"], 3),
+            }
+        except Exception as e:  # pragma: no cover - diagnostic only
+            print(f"ladder bench failed: {e!r}", file=sys.stderr)
 
     # Roofline framing (SURVEY.md §5.5): analytic operand bytes of the
     # sort/gather kernels vs the chip's HBM bandwidth. v5e HBM is 819 GB/s;
@@ -211,6 +233,8 @@ def main() -> int:
     }
     if sym is not None:
         record["sym"] = sym
+    if ladder is not None:
+        record["ladder"] = ladder
     print(json.dumps(record))
     return 0
 

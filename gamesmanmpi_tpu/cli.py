@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -88,6 +89,35 @@ def build_parser() -> argparse.ArgumentParser:
         "canonicalized, so symmetry-reduced solves answer for any class "
         "member",
     )
+    # Capacity knobs (CLI spellings of the GAMESMAN_* env vars; the flag
+    # wins when both are set). docs/ARCHITECTURE.md capacity plan.
+    p.add_argument(
+        "--backward-block",
+        type=int,
+        default=None,
+        metavar="POSITIONS",
+        help="resolve levels in column blocks of this many positions "
+        "(bounds backward temporaries; 0 = never block; env "
+        "GAMESMAN_BACKWARD_BLOCK)",
+    )
+    p.add_argument(
+        "--window-block",
+        type=int,
+        default=None,
+        metavar="POSITIONS",
+        help="sharded: spill window levels wider than this (per shard) to "
+        "host and stream them back through HBM in blocks (0 = never "
+        "spill; env GAMESMAN_WINDOW_BLOCK)",
+    )
+    p.add_argument(
+        "--device-store-mb",
+        type=int,
+        default=None,
+        metavar="MB",
+        help="device-resident budget for discovered levels + provenance "
+        "between forward and backward; excess spills to host (env "
+        "GAMESMAN_DEVICE_STORE_MB)",
+    )
     # Multi-host bring-up (SURVEY.md §5.8 control plane): one process per
     # host, jax.distributed over DCN, mesh over all addressable devices.
     # docs/ARCHITECTURE.md "Multi-host launch" shows a v4-32 example.
@@ -150,6 +180,15 @@ def _report(result, devices: int, elapsed: float, args, logger) -> None:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    # Capacity flags are CLI spellings of the env knobs the engines read at
+    # construction; set them before any solver is built.
+    for flag, env in (
+        (args.backward_block, "GAMESMAN_BACKWARD_BLOCK"),
+        (args.window_block, "GAMESMAN_WINDOW_BLOCK"),
+        (args.device_store_mb, "GAMESMAN_DEVICE_STORE_MB"),
+    ):
+        if flag is not None:
+            os.environ[env] = str(flag)
     from gamesmanmpi_tpu.utils.platform import apply_platform_env
 
     # Honor GAMESMAN_PLATFORM=cpu|tpu|axon (and GAMESMAN_FAKE_DEVICES) before
@@ -207,6 +246,9 @@ def main(argv=None) -> int:
             (args.devices > 1, "--devices"),
             (args.paranoid, "--paranoid"),
             (args.checkpoint_dir, "--checkpoint-dir"),
+            (args.backward_block is not None, "--backward-block"),
+            (args.window_block is not None, "--window-block"),
+            (args.device_store_mb is not None, "--device-store-mb"),
         ):
             if flag and not engine_capable:
                 print(

@@ -153,12 +153,14 @@ def get_kernel(game: TensorGame, kind: str, shape_key, builder):
     return fn
 
 
-def schedule_kernel(game: TensorGame, kind: str, shape_key, builder, avals):
+def schedule_kernel(game: TensorGame, kind: str, shape_key, builder, avals,
+                    heavy: bool = False):
     """Queue a background compile of a kernel (idempotent, never blocks).
 
     avals must match the call signature get_kernel's users will invoke the
     kernel with — the compiled executable is shared through the same cache
-    key.
+    key. heavy marks big-working-set programs that must not compile at
+    full pool concurrency (see precompile._heavy_slots).
     """
     if getattr(game, "_private_kernel_cache", None) is not None:
         # Per-instance-cached games (compat host-callback modules): their
@@ -172,7 +174,7 @@ def schedule_kernel(game: TensorGame, kind: str, shape_key, builder, avals):
     pre = global_precompiler()
     if pre.scheduled(key):
         return
-    pre.schedule(key, jax.jit(builder(game)), tuple(avals))
+    pre.schedule(key, jax.jit(builder(game)), tuple(avals), heavy=heavy)
 
 
 def canonical_scalar(game: TensorGame, state):
@@ -528,8 +530,16 @@ class Solver:
         for w in wcaps:
             avals += [sds((w,), dt), sds((w,), np.uint8), sds((w,), np.int32)]
         schedule_kernel(
-            self.game, "bwd", (cap, tuple(wcaps)), self._bwd_builder, avals
+            self.game, "bwd", (cap, tuple(wcaps)), self._bwd_builder, avals,
+            heavy=self._heavy(max((cap,) + tuple(wcaps))),
         )
+
+    def _heavy(self, cap: int) -> bool:
+        """Programs whose children block exceeds ~256 MB compile under the
+        heavy semaphore — concurrent big compiles crash the relay's
+        compile helper (see precompile._heavy_slots)."""
+        item = np.dtype(self.game.state_dtype).itemsize
+        return cap * self.game.max_moves * item > (256 << 20)
 
     def _sched_fwdp(self, cap: int) -> None:
         if cap > self._cap_ceiling:
@@ -537,6 +547,7 @@ class Solver:
         schedule_kernel(
             self.game, "fwdp", cap, self._fwdp_builder,
             (sds((cap,), self.game.state_dtype),),
+            heavy=self._heavy(cap),
         )
 
     def _sched_bwdp(self, cap: int, wcap: int) -> None:
@@ -551,7 +562,8 @@ class Solver:
             sds((wcap,), np.int32),
         )
         schedule_kernel(
-            self.game, "bwdp", (cap, wcap), self._bwdp_builder, avals
+            self.game, "bwdp", (cap, wcap), self._bwdp_builder, avals,
+            heavy=self._heavy(max(cap, wcap)),
         )
 
     def _schedule_initial_ladder(self) -> None:

@@ -27,6 +27,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from typing import Dict, Hashable
 
@@ -39,6 +40,21 @@ def _workers() -> int:
         return max(1, int(raw))
     except ValueError:
         return 8
+
+
+def _heavy_slots() -> int:
+    """Concurrent-compile limit for HEAVY programs (big-capacity kernels).
+
+    The relay's compile helper is a subprocess with finite memory: eight
+    concurrent ~GB-working-set compiles crashed it (HTTP 500) on the 6x5
+    uint64 board, while the same programs compile fine serially. Heavy jobs
+    therefore share a small semaphore; light jobs keep the full pool.
+    """
+    raw = os.environ.get("GAMESMAN_HEAVY_COMPILES", "2")
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        return 2
 
 
 class Precompiler:
@@ -58,6 +74,7 @@ class Precompiler:
         self._futures: Dict[Hashable, Future] = {}
         self._lock = threading.Lock()
         self._threads_started = False
+        self._heavy_sem = threading.Semaphore(_heavy_slots())
 
     def _ensure_threads(self) -> None:
         if self._threads_started:
@@ -69,21 +86,52 @@ class Precompiler:
             )
             t.start()
 
+    @staticmethod
+    def _transient(e: Exception) -> bool:
+        """Errors worth one retry: the relay compile service failing under
+        load (HTTP 500 / INTERNAL / UNAVAILABLE), not deterministic
+        failures like an OOM-sized speculative shape."""
+        msg = str(e)
+        return any(t in msg for t in ("500", "INTERNAL", "UNAVAILABLE"))
+
     def _worker(self) -> None:
         while True:
-            fut, fn, avals = self._q.get()
-            if not fut.set_running_or_notify_cancel():
+            item = self._q.get()
+            fut, fn, avals, heavy = item
+            if heavy and not self._heavy_sem.acquire(blocking=False):
+                # No heavy slot free: requeue and stay available for light
+                # jobs — heavy work must never park the whole pool.
+                self._q.put(item)
+                time.sleep(0.25)
                 continue
             try:
-                fut.set_result(fn.lower(*avals).compile())
-            except BaseException as e:  # noqa: BLE001 - report via future
-                fut.set_exception(e)
+                if not fut.set_running_or_notify_cancel():
+                    continue
+                try:
+                    fut.set_result(fn.lower(*avals).compile())
+                except Exception as e:  # noqa: BLE001 - maybe retry once
+                    if not self._transient(e):
+                        fut.set_exception(e)
+                        continue
+                    # Give the relay a breather and retry before giving up
+                    # (the caller then falls back to an inline compile).
+                    try:
+                        time.sleep(8.0)
+                        fut.set_result(fn.lower(*avals).compile())
+                    except Exception as e2:  # noqa: BLE001
+                        fut.set_exception(e2)
+            finally:
+                if heavy:
+                    self._heavy_sem.release()
 
-    def schedule(self, key: Hashable, fn, avals: tuple) -> None:
+    def schedule(self, key: Hashable, fn, avals: tuple,
+                 heavy: bool = False) -> None:
         """Schedule `fn.lower(*avals).compile()` in the background (idempotent).
 
         fn must be a jax.jit-wrapped callable; avals are
-        jax.ShapeDtypeStruct leaves matching the call signature.
+        jax.ShapeDtypeStruct leaves matching the call signature. heavy=True
+        routes the job through the small heavy-compile semaphore (see
+        _heavy_slots).
         """
         with self._lock:
             if key in self._futures:
@@ -91,7 +139,7 @@ class Precompiler:
             self._ensure_threads()
             fut = Future()
             self._futures[key] = fut
-            self._q.put((fut, fn, avals))
+            self._q.put((fut, fn, avals, heavy))
 
     def get(self, key: Hashable, block: bool = True):
         """The compiled executable for `key`, or None if never scheduled.
