@@ -181,14 +181,28 @@ def _report(result, devices: int, elapsed: float, args, logger) -> None:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     # Capacity flags are CLI spellings of the env knobs the engines read at
-    # construction; set them before any solver is built.
+    # construction; set them before any solver is built, and restore on
+    # exit so programmatic main() calls don't leak config to the next one.
+    saved_env = {}
     for flag, env in (
         (args.backward_block, "GAMESMAN_BACKWARD_BLOCK"),
         (args.window_block, "GAMESMAN_WINDOW_BLOCK"),
         (args.device_store_mb, "GAMESMAN_DEVICE_STORE_MB"),
     ):
         if flag is not None:
+            saved_env[env] = os.environ.get(env)
             os.environ[env] = str(flag)
+    try:
+        return _main(args)
+    finally:
+        for env, old in saved_env.items():
+            if old is None:
+                os.environ.pop(env, None)
+            else:
+                os.environ[env] = old
+
+
+def _main(args) -> int:
     from gamesmanmpi_tpu.utils.platform import apply_platform_env
 
     # Honor GAMESMAN_PLATFORM=cpu|tpu|axon (and GAMESMAN_FAKE_DEVICES) before

@@ -89,10 +89,13 @@ class Precompiler:
     @staticmethod
     def _transient(e: Exception) -> bool:
         """Errors worth one retry: the relay compile service failing under
-        load (HTTP 500 / INTERNAL / UNAVAILABLE), not deterministic
-        failures like an OOM-sized speculative shape."""
+        load (HTTP 5xx / INTERNAL / UNAVAILABLE), not deterministic
+        failures like an OOM-sized speculative shape (whose messages can
+        embed arbitrary numbers — match structured markers only)."""
         msg = str(e)
-        return any(t in msg for t in ("500", "INTERNAL", "UNAVAILABLE"))
+        return any(
+            t in msg for t in ("HTTP 5", "INTERNAL", "UNAVAILABLE")
+        )
 
     def _worker(self) -> None:
         while True:
@@ -120,6 +123,10 @@ class Precompiler:
                         fut.set_result(fn.lower(*avals).compile())
                     except Exception as e2:  # noqa: BLE001
                         fut.set_exception(e2)
+                except BaseException as e:  # noqa: BLE001 - report via future
+                    # Never let the worker die with the future unresolved —
+                    # a blocked get() would hang a solve forever.
+                    fut.set_exception(e)
             finally:
                 if heavy:
                     self._heavy_sem.release()

@@ -1,0 +1,126 @@
+"""solve/precompile.py: background compile scheduling semantics.
+
+These run with stub "jit functions" (no real XLA compiles), so they cover
+the scheduler's contract — idempotence, eviction, heavy-slot routing,
+transient-only retry — on the CPU test mesh where the engine keeps the
+precompiler off.
+"""
+
+import threading
+import time
+
+import pytest
+
+from gamesmanmpi_tpu.solve.precompile import Precompiler
+
+
+class _StubLowered:
+    def __init__(self, result, fail=None):
+        self._result = result
+        self._fail = fail
+
+    def compile(self):
+        if self._fail is not None:
+            raise self._fail
+        return self._result
+
+
+class _StubFn:
+    """Stands in for a jax.jit function: lower(*avals).compile()."""
+
+    def __init__(self, result="exe", fail_first=None, delay=0.0,
+                 fail_always=None):
+        self.result = result
+        self.fail_first = fail_first
+        self.fail_always = fail_always
+        self.delay = delay
+        self.calls = 0
+        self.lock = threading.Lock()
+
+    def lower(self, *avals):
+        with self.lock:
+            self.calls += 1
+            calls = self.calls
+        if self.delay:
+            time.sleep(self.delay)
+        if self.fail_always is not None:
+            return _StubLowered(None, fail=self.fail_always)
+        if self.fail_first is not None and calls == 1:
+            return _StubLowered(None, fail=self.fail_first)
+        return _StubLowered(self.result)
+
+
+def test_schedule_is_idempotent_and_get_evicts():
+    pre = Precompiler()
+    fn = _StubFn(result="exe1")
+    pre.schedule("k", fn, ())
+    pre.schedule("k", fn, ())  # duplicate: must not enqueue twice
+    assert pre.get("k", block=True) == "exe1"
+    assert fn.calls == 1
+    # Consumed futures are evicted: the caller's kernel cache owns the
+    # executable now, and a re-schedule is possible.
+    assert not pre.scheduled("k")
+    assert pre.get("k") is None
+
+
+def test_unscheduled_key_returns_none():
+    pre = Precompiler()
+    assert pre.get("missing") is None
+    assert not pre.scheduled("missing")
+
+
+def test_transient_failure_retries_once(monkeypatch):
+    # Patch the backoff so the test doesn't sleep 8 s.
+    import gamesmanmpi_tpu.solve.precompile as pc
+
+    monkeypatch.setattr(pc.time, "sleep", lambda s: None)
+    pre = Precompiler()
+    fn = _StubFn(result="exe", fail_first=RuntimeError("HTTP 500: boom"))
+    pre.schedule("k", fn, ())
+    assert pre.get("k", block=True) == "exe"
+    assert fn.calls == 2  # failed once, retried once
+
+
+def test_deterministic_failure_does_not_retry(monkeypatch):
+    import gamesmanmpi_tpu.solve.precompile as pc
+
+    monkeypatch.setattr(pc.time, "sleep", lambda s: None)
+    pre = Precompiler()
+    fn = _StubFn(fail_always=ValueError("bad shape"))
+    pre.schedule("k", fn, ())
+    # Failure is swallowed (caller falls back to inline jit) and evicted
+    # so a later retry is possible.
+    assert pre.get("k", block=True) is None
+    assert fn.calls == 1
+    assert not pre.scheduled("k")
+
+
+def test_heavy_jobs_do_not_starve_light_jobs(monkeypatch):
+    """With every heavy slot busy, queued heavy work must be requeued so
+    light compiles keep flowing through the pool."""
+    import gamesmanmpi_tpu.solve.precompile as pc
+
+    monkeypatch.setenv("GAMESMAN_COMPILE_WORKERS", "2")
+    monkeypatch.setenv("GAMESMAN_HEAVY_COMPILES", "1")
+    pre = Precompiler()
+    slow_heavy = _StubFn(result="h1", delay=1.0)
+    pre.schedule("h1", slow_heavy, (), heavy=True)
+    pre.schedule("h2", _StubFn(result="h2", delay=1.0), (), heavy=True)
+    pre.schedule("light", _StubFn(result="l"), ())
+    t0 = time.time()
+    assert pre.get("light", block=True) == "l"
+    # The light job must complete while h1 still holds the only heavy
+    # slot (h2 requeued) — i.e. well under the 2 s of serialized heavy
+    # work.
+    assert time.time() - t0 < 1.0
+    assert pre.get("h1", block=True) == "h1"
+    assert pre.get("h2", block=True) == "h2"
+
+
+def test_sds_shape_dtype():
+    import numpy as np
+
+    from gamesmanmpi_tpu.solve.precompile import sds
+
+    s = sds((4,), np.uint32)
+    assert s.shape == (4,) and s.dtype == np.uint32
