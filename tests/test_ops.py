@@ -159,3 +159,60 @@ def test_route_by_owner_overflow_drops_and_counts():
     assert counts.max() > 8  # the overflow the host must detect
     send = np.asarray(send)
     assert (send != SENTINEL).sum() == 16  # buffer capped at S*cap
+
+
+def test_expand_provenance_contract():
+    """expand_provenance must agree with expand_core on (uniq, count), its
+    prim with game.primitive, and uidx must map every real child slot to
+    that child's index in the uniq prefix (-1 exactly on invalid slots) —
+    the invariant the gather-only backward pass rests on."""
+    import jax
+
+    from gamesmanmpi_tpu.games import get_game
+    from gamesmanmpi_tpu.solve.engine import (
+        canonical_children,
+        expand_core,
+        expand_provenance,
+        undecided_mask,
+    )
+
+    for spec in ("tictactoe", "connect4:w=4,h=4", "chomp:w=3,h=3"):
+        game = get_game(spec)
+        # A frontier with real states, duplicates of children guaranteed
+        # (siblings share children via transpositions), and sentinel pads.
+        rng = np.random.default_rng(7)
+        init = game.initial_state()
+        kids, _ = jax.jit(lambda s: game.expand(s))(
+            jnp.asarray([init], dtype=game.state_dtype)
+        )
+        pool = np.unique(np.asarray(kids).reshape(-1))
+        pool = pool[pool != game.sentinel]
+        states = np.full(64, game.sentinel, dtype=game.state_dtype)
+        states[: pool.shape[0]] = pool
+        states_j = jnp.asarray(states)
+
+        uniq_c, count_c = jax.jit(lambda s: expand_core(game, s))(states_j)
+        uniq_p, count_p, uidx, prim = jax.jit(
+            lambda s: expand_provenance(game, s)
+        )(states_j)
+        assert int(count_c) == int(count_p)
+        assert (np.asarray(uniq_c) == np.asarray(uniq_p)).all()
+        assert (
+            np.asarray(prim) == np.asarray(jax.jit(game.primitive)(states_j))
+        ).all()
+
+        children, mask = jax.jit(
+            lambda s: canonical_children(game, s, undecided_mask(game, s))
+        )(states_j)
+        flat = np.asarray(children).reshape(-1)
+        m = np.asarray(mask).reshape(-1)
+        ui = np.asarray(uidx)
+        uq = np.asarray(uniq_p)
+        n = int(count_p)
+        for slot in range(flat.shape[0]):
+            if flat[slot] == game.sentinel:
+                assert ui[slot] == -1
+            else:
+                assert m[slot]
+                assert 0 <= ui[slot] < n
+                assert uq[ui[slot]] == flat[slot]

@@ -119,6 +119,26 @@ def main():
            lambda s: jnp.cumsum((s & 1).astype(jnp.int32)), keys)
     timeit(f"cumsum int64 [{N>>20}M]", lambda s: jnp.cumsum(s & 1), keys)
 
+    # 2b. pair sort (provenance forward) vs packed-u64 single sort — the
+    # open question for the next forward optimization: lax.sort with a
+    # carried operand vs packing (key<<32 | origin) into one u64.
+    origin = jnp.arange(N, dtype=jnp.int32)
+
+    def pair_sort(k, o):
+        return jax.lax.sort((k, o), num_keys=1, is_stable=False)
+
+    def packed_sort(k, o):
+        packed = (k.astype(jnp.uint64) << jnp.uint64(32)) | (
+            o.astype(jnp.uint64)
+        )
+        s = jnp.sort(packed)
+        return (s >> jnp.uint64(32)).astype(jnp.uint32), (
+            s & jnp.uint64(0xFFFF_FFFF)
+        ).astype(jnp.int32)
+
+    timeit(f"pair sort (u32,i32) [{N>>20}M]", pair_sort, keys, origin, n=3)
+    timeit(f"packed u64 sort     [{N>>20}M]", packed_sort, keys, origin, n=3)
+
     # 3. lookup variants
     timeit(f"searchsorted scan  [{N>>20}M in {M>>20}M]",
            lambda k, t: jnp.searchsorted(t, k).astype(jnp.uint32), keys, table,
