@@ -24,6 +24,28 @@ from gamesmanmpi_tpu.core.codec import (
 )
 
 
+def _savez(path, **arrays) -> None:
+    """Compressed below ~64 MB, raw above.
+
+    Small-game checkpoints compress well and stay tidy; at big-run scale
+    the payload is high-entropy packed bitboards where zlib costs
+    ~50 MB/s/core for single-digit-percent savings — raw npz writes at
+    disk speed. Override with GAMESMAN_CKPT_COMPRESS=0/1.
+    """
+    import os
+
+    total = sum(a.nbytes for a in arrays.values())
+    flag = os.environ.get("GAMESMAN_CKPT_COMPRESS", "auto")
+    if flag == "auto":
+        compress = total < (64 << 20)
+    else:
+        compress = flag not in ("0", "off", "false")
+    if compress:
+        np.savez_compressed(path, **arrays)
+    else:
+        np.savez(path, **arrays)
+
+
 class LevelCheckpointer:
     """Saves solved levels as they complete; loads them for resume."""
 
@@ -58,7 +80,7 @@ class LevelCheckpointer:
         cells = np.asarray(
             pack_cells(jnp.asarray(table.values), jnp.asarray(table.remoteness))
         )
-        np.savez_compressed(
+        _savez(
             self._level_path(level), states=table.states, cells=cells
         )
         manifest = self.load_manifest()
@@ -118,7 +140,7 @@ class LevelCheckpointer:
         return self.dir / f"level_{level:04d}.shard_{shard:04d}.npz"
 
     def save_level_shard(self, level: int, shard: int, states, cells) -> None:
-        np.savez_compressed(
+        _savez(
             self._shard_level_path(level, shard), states=states, cells=cells
         )
 
@@ -141,7 +163,7 @@ class LevelCheckpointer:
         arrays = {
             f"level_{k:04d}": np.asarray(v) for k, v in pools.items()
         }
-        np.savez_compressed(
+        _savez(
             self.dir / f"frontiers.shard_{shard:04d}.npz", **arrays
         )
 
@@ -175,7 +197,7 @@ class LevelCheckpointer:
         arrays = {
             f"level_{k:04d}": np.asarray(v) for k, v in pools.items()
         }
-        np.savez_compressed(self.dir / "frontiers.npz", **arrays)
+        _savez(self.dir / "frontiers.npz", **arrays)
         manifest = self.load_manifest()
         manifest["frontiers"] = True
         self.manifest_path.write_text(json.dumps(manifest))
@@ -214,7 +236,7 @@ def save_table_npz(path: str, table: dict) -> None:
     rems = jnp.asarray(
         np.array([table[int(s)][1] for s in states], dtype=np.int32)
     )
-    np.savez_compressed(
+    _savez(
         path, states=states, cells=np.asarray(pack_cells(values, rems))
     )
 
@@ -228,4 +250,4 @@ def save_result_npz(path: str, result) -> None:
         )
         arrays[f"states_{level:04d}"] = table.states
         arrays[f"cells_{level:04d}"] = cells
-    np.savez_compressed(path, **arrays)
+    _savez(path, **arrays)
