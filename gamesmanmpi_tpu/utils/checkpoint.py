@@ -12,6 +12,7 @@ format is exactly the HBM table layout.
 from __future__ import annotations
 
 import json
+import os
 import pathlib
 
 import jax.numpy as jnp
