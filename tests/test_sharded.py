@@ -136,3 +136,58 @@ def test_sharded_window_streaming_composes_with_blocked_backward():
     result = solver.solve()
     assert solver.window_stream_blocks > 0
     assert full_table(result) == full_table(single)
+
+
+def test_multihost_host_spill_snapshot_refused(monkeypatch):
+    """Under multi-host execution a host-spilled level cannot be attributed
+    to per-shard writers; the frontier snapshot must refuse loudly instead
+    of writing racy files."""
+    import numpy as np
+
+    from gamesmanmpi_tpu.parallel.sharded import _SLevel, SolverError
+    from gamesmanmpi_tpu.parallel import sharded as sh
+
+    solver = ShardedSolver(get_game("nim:heaps=2-3"), num_shards=2)
+    rec = _SLevel(
+        np.array([1, 0], dtype=np.int64),
+        None,
+        [np.array([3], dtype=np.uint32), np.empty(0, dtype=np.uint32)],
+    )
+    monkeypatch.setattr(sh.jax, "process_count", lambda: 2)
+    with pytest.raises(SolverError, match="multi-host"):
+        solver._shard_rows(rec, 0)
+
+
+def test_multihost_manifest_seal_gated_to_process_zero(monkeypatch, tmp_path):
+    """Non-zero processes write their shard files but must not seal the
+    manifest; the barrier must run before sealing either way."""
+    import numpy as np
+    import jax
+
+    from gamesmanmpi_tpu.parallel.sharded import _SLevel, _pad_shards
+    from gamesmanmpi_tpu.parallel import sharded as sh
+    from gamesmanmpi_tpu.utils import LevelCheckpointer
+
+    solver = ShardedSolver(
+        get_game("nim:heaps=2-3"), num_shards=2,
+        checkpointer=LevelCheckpointer(str(tmp_path / "d")),
+    )
+    shards = [np.array([3], dtype=np.uint32), np.empty(0, dtype=np.uint32)]
+    rec = _SLevel(
+        np.array([1, 0], dtype=np.int64),
+        jax.device_put(_pad_shards(shards, 256), solver._sharding),
+        None,
+    )
+    barriers = []
+    monkeypatch.setattr(
+        type(solver), "_sync_processes",
+        staticmethod(lambda tag: barriers.append(tag)),
+    )
+    monkeypatch.setattr(sh.jax, "process_index", lambda: 1)
+    solver._checkpoint_frontier_shards({0: rec})
+    assert barriers  # barrier ran before the (skipped) seal
+    assert solver.checkpointer.load_manifest().get("frontier_shards") is None
+
+    monkeypatch.setattr(sh.jax, "process_index", lambda: 0)
+    solver._checkpoint_frontier_shards({0: rec})
+    assert solver.checkpointer.load_manifest().get("frontier_shards") == 2
