@@ -80,6 +80,15 @@ def build_parser() -> argparse.ArgumentParser:
         "persist full tables level-by-level instead)",
     )
     p.add_argument(
+        "--engine",
+        choices=("auto", "classic", "dense"),
+        default="auto",
+        help="solver engine: 'classic' = level-BFS discovery (all games); "
+        "'dense' = class-partitioned perfect-indexing engine (Connect-4 "
+        "family, single device, sym=0 — no sorts, 1 byte/position); "
+        "'auto' picks dense when eligible",
+    )
+    p.add_argument(
         "--query",
         action="append",
         default=None,
@@ -246,6 +255,15 @@ def _main(args) -> int:
         checkpointer = LevelCheckpointer(args.checkpoint_dir)
 
     if pathlib.Path(args.game).is_file():
+        if args.engine == "dense":
+            # The validation below never runs on the compat path; without
+            # this, --engine dense would be silently ignored here.
+            print(
+                "error: --engine dense applies to the built-in Connect-4 "
+                "family, not compat game modules",
+                file=sys.stderr,
+            )
+            return 2
         # Reference-style plugin module: runs unmodified (compat path).
         from gamesmanmpi_tpu.compat import load_game_module, solve_module
 
@@ -347,7 +365,30 @@ def _main(args) -> int:
                 file=sys.stderr,
             )
             return 2
-    if args.devices > 1:
+    from gamesmanmpi_tpu.games.connect4 import Connect4
+
+    dense_eligible = (
+        isinstance(game, Connect4) and not game.sym and args.devices == 1
+        and not args.checkpoint_dir and not args.paranoid
+        and not args.table_out
+    )
+    if args.engine == "dense" and not dense_eligible:
+        print(
+            "error: --engine dense needs a Connect-4-family game with "
+            "sym=0, --devices 1, and no --checkpoint-dir/--paranoid/"
+            "--table-out (those live in the classic engine)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.engine != "classic" and dense_eligible:
+        from gamesmanmpi_tpu.solve.dense import DenseSolver
+
+        solver = DenseSolver(
+            game,
+            store_tables=not args.no_tables,
+            logger=logger,
+        )
+    elif args.devices > 1:
         from gamesmanmpi_tpu.parallel import ShardedSolver
 
         solver = ShardedSolver(

@@ -1,0 +1,847 @@
+"""Dense class-partitioned retrograde engine for the Connect-4 family.
+
+The level-BFS engine (solve/engine.py) discovers reachable positions by
+expand + sort-unique and joins parents to children through the dedup sort's
+provenance. Its warm profile on the v5e is sort-bound forward and
+gather-bound backward (docs/ARCHITECTURE.md "Where the time went"). This
+module removes the sorts — and the forward pass, and the stored states —
+entirely, for games with Connect-4's "cells fill one column at a time"
+structure, by indexing positions *perfectly* instead of discovering them:
+
+- A **class** is a column-height profile (h_0..h_{w-1}); its positions are
+  the ways to color the sum(h)=L filled cells with the two players' stones.
+  Turn parity fixes player 1's stone count n1 = ceil(L/2), so EVERY class
+  at level L has exactly C(L, n1) positions — a level is one rectangular
+  [num_profiles, C(L, n1)] array. This is the Pentago solver's "sections"
+  idea (PAPERS.md: arXiv 1404.0743 partitions by per-quadrant stone
+  counts) applied to columns.
+- Within a class, a position's index is the **combinadic rank** of its
+  player-1 cell set (colex: rank = sum_i C(s_i, i) over set positions
+  s_1<...<s_n). rank/unrank are short static loops over the board's cells —
+  pure VPU work, no memory traffic.
+- The solve is ONE backward sweep over levels; no forward discovery exists
+  because the classes and their sizes are closed-form. Per level: unrank →
+  primitive test (bitboard fold) → per-move child rank → gather the child's
+  packed (value, remoteness) byte → negamax/remoteness combine
+  (ops/combine.py, same rules as every other engine here).
+- Tables store ONE byte per position (2-bit value + 6-bit remoteness;
+  remoteness <= w*h = 42 < 64) and no states at all — vs 13 B/pos in the
+  BFS engine. States are recomputed from ranks when needed.
+
+The price is solving a *superset*: every colorable cell assignment, not
+just reachable positions. Measured blowups (encodable / reachable):
+5x4 1.42x, 6x4 1.68x, 5x5 2.47x, 6x5 ~2.2x — cheap against eliminating
+the sort pipeline. The near-full levels of 6x6/7x6 blow up 10-16x, so the
+giant boards stay on the sharded BFS engine (parallel/sharded.py); this
+engine's domain is the single-chip boards (BASELINE.md configs #3 ladder),
+where it also makes 6x5 fit one chip (~1.3 GB peak level vs ~12 GB with
+stored uint64 states).
+
+Garbage positions (the unreachable part of the superset) can never
+contaminate real values: a reachable non-primitive position has no line
+for either player, hence all its children are positions a real game could
+contain, hence the combine only ever reads real cells. Positions where the
+player to move already has a line are marked terminal without expansion,
+so they cost a primitive test, not a gather fan-out.
+
+**Counting** is separate from solving. The benchmark metric and the parity
+suite count *reachable* positions (= Tromp's published "legal" counts,
+which the BFS engine's discovery matches). Reachability is NOT locally
+decidable from a position's stones alone — a no-line position with correct
+stone parity can still be unreachable because the within-column color
+stacks must admit an alternating global move order — so the exact count
+comes from a dense **reachability sweep**: forward over levels,
+reach(child) = OR over columns [top stone is the mover's color AND the
+unmoved parent is reachable AND the parent was not terminal]. The sweep
+reuses the rank machinery with "unmove" tables and costs about as much as
+the backward solve; it runs once per board per process and is cached, so
+warm benchmark runs measure the solve alone.
+
+Reference parity: same Game-module semantics as the reference solver
+(SURVEY.md §2.1 — value algebra §2.1.2, remoteness §2.1.3), same outputs
+(root value + remoteness, per-position queries). The reference's
+src/process.py discovers positions dynamically; perfect indexing is the
+TPU-native replacement, trading a bounded superset for static shapes and
+zero sort/shuffle traffic.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from gamesmanmpi_tpu.core.values import LOSE, TIE
+from gamesmanmpi_tpu.games.connect4 import Connect4
+from gamesmanmpi_tpu.ops.combine import combine_children
+from gamesmanmpi_tpu.solve.engine import get_kernel
+
+
+def _profiles_for_level(width: int, height: int, level: int) -> np.ndarray:
+    """All column-height profiles summing to `level`, lexicographic.
+
+    Returns [P, width] int8. Lexicographic order is the class-row order
+    everywhere (tables, move maps, checkpoints).
+    """
+    out = []
+
+    def rec(prefix, remaining, cols_left):
+        if cols_left == 0:
+            if remaining == 0:
+                out.append(prefix)
+            return
+        # Feasibility pruning keeps this linear in the output size.
+        if remaining > cols_left * height:
+            return
+        for v in range(min(height, remaining) + 1):
+            rec(prefix + [v], remaining - v, cols_left - 1)
+
+    rec([], level, width)
+    return np.array(out, dtype=np.int8).reshape(len(out), width)
+
+
+def n1_of_level(level: int) -> int:
+    """Player-1 stones after `level` plies (player 1 moves first)."""
+    return (level + 1) // 2
+
+
+class DenseTables:
+    """Host-side class machinery for one board: profiles, cell indexing,
+    move maps, binomials. Everything here is numpy; device constants are
+    uploaded per level by the solver."""
+
+    def __init__(self, width: int, height: int, connect: int = 4):
+        self.width, self.height, self.connect = width, height, connect
+        self.ncells = width * height
+        self.h1 = height + 1
+        # Board bitboard layout matches games/connect4.py: cell (c, r) at
+        # bit c*(h+1)+r, guard slots (r == h) always zero here. Reusing the
+        # layout keeps the win fold identical and the spare bit per column
+        # stops cross-column wraps in the stride-1 (vertical) direction.
+        if self.h1 * width > 63:
+            raise ValueError("board too large for uint64 bitboards")
+        self.bits_dtype = np.uint64 if self.h1 * width > 31 else np.uint32
+
+        # Global cell slots: j = c*height + r, (c asc, r asc). A class's
+        # cells, walked in ascending j, get consecutive within-class
+        # indices — the combinadic positions.
+        self.bitpos = np.array(
+            [c * self.h1 + r for c in range(width) for r in range(height)],
+            dtype=np.int32,
+        )
+
+        # binom[k][i] = C(k, i); k = within-class cell index (0..ncells-1),
+        # i = stone ordinal (0..n1max+1). uint64 covers C(42, 21).
+        n1max = n1_of_level(self.ncells)
+        self.n1_width = n1max + 2
+        self.binom = np.zeros((self.ncells + 1, self.n1_width), np.uint64)
+        for k in range(self.ncells + 1):
+            for i in range(self.n1_width):
+                self.binom[k, i] = math.comb(k, i) if i <= k else 0
+
+        self.profiles: list[np.ndarray] = []
+        self.row_of: list[dict] = []
+        self.class_size: list[int] = []
+        for L in range(self.ncells + 1):
+            p = _profiles_for_level(width, height, L)
+            self.profiles.append(p)
+            self.row_of.append(
+                {tuple(int(v) for v in row): i for i, row in enumerate(p)}
+            )
+            self.class_size.append(math.comb(L, n1_of_level(L)))
+
+        self._level_consts: dict[int, dict] = {}
+        self._cellidx: dict[int, np.ndarray] = {}
+
+    # -- per-level constants ------------------------------------------------
+
+    def cellidx_rows(self, level: int) -> np.ndarray:
+        """[P, ncells] int16: within-class index of global slot j, -1 if the
+        cell is above the column height (absent)."""
+        if level in self._cellidx:
+            return self._cellidx[level]
+        prof = self.profiles[level].astype(np.int32)  # [P, w]
+        w, h = self.width, self.height
+        base = np.concatenate(
+            [np.zeros((prof.shape[0], 1), np.int32),
+             np.cumsum(prof, axis=1)[:, :-1]], axis=1
+        )  # [P, w] cells before column c
+        r = np.tile(np.arange(h, dtype=np.int32), w)  # [ncells]
+        c = np.repeat(np.arange(w, dtype=np.int32), h)
+        idx = base[:, c] + r[None, :]  # [P, ncells]
+        absent = r[None, :] >= prof[:, c]
+        out = np.where(absent, np.int16(-1), idx.astype(np.int16))
+        self._cellidx[level] = out
+        return out
+
+    def level_consts(self, level: int) -> dict:
+        """All device-constant arrays for one level's kernels (host numpy)."""
+        if level in self._level_consts:
+            return self._level_consts[level]
+        w, h, h1 = self.width, self.height, self.h1
+        prof = self.profiles[level].astype(np.int64)  # [P, w]
+        P = prof.shape[0]
+        dt = self.bits_dtype
+
+        filled = np.zeros(P, np.uint64)
+        for c in range(w):
+            col = (np.uint64(1) << prof[:, c].astype(np.uint64)) - np.uint64(1)
+            filled |= col << np.uint64(c * h1)
+
+        newbit = np.zeros((P, w), np.uint64)   # cell (c, h_c): the drop target
+        topstone = np.zeros((P, w), np.uint64)  # cell (c, h_c - 1): last drop
+        valid = prof < h
+        for c in range(w):
+            hc = prof[:, c]
+            newbit[:, c] = np.where(
+                valid[:, c], np.uint64(1) << (hc + c * h1).astype(np.uint64), 0
+            )
+            topstone[:, c] = np.where(
+                hc > 0,
+                np.uint64(1) << np.maximum(hc - 1 + c * h1, 0).astype(np.uint64),
+                0,
+            )
+
+        move_row = np.full((P, w), -1, np.int32)
+        if level < self.ncells:
+            nxt = self.row_of[level + 1]
+            for c in range(w):
+                for p in range(P):
+                    if valid[p, c]:
+                        key = list(prof[p])
+                        key[c] += 1
+                        move_row[p, c] = nxt[tuple(int(v) for v in key)]
+
+        # Unmove: the parent one ply earlier, per column (for the
+        # reachability sweep). parent_row[p, c] = -1 when column c is empty.
+        parent_row = np.full((P, w), -1, np.int32)
+        if level > 0:
+            prv = self.row_of[level - 1]
+            for c in range(w):
+                for p in range(P):
+                    if prof[p, c] > 0:
+                        key = list(prof[p])
+                        key[c] -= 1
+                        parent_row[p, c] = prv[tuple(int(v) for v in key)]
+
+        cellidx = self.cellidx_rows(level)
+        child_cellidx = np.full((P, w, self.ncells), -1, np.int16)
+        if level < self.ncells:
+            rows = self.cellidx_rows(level + 1)  # [P', ncells]
+            for c in range(w):
+                ok = move_row[:, c] >= 0
+                child_cellidx[ok, c, :] = rows[move_row[ok, c]]
+        parent_cellidx = np.full((P, w, self.ncells), -1, np.int16)
+        if level > 0:
+            rows = self.cellidx_rows(level - 1)
+            for c in range(w):
+                ok = parent_row[:, c] >= 0
+                parent_cellidx[ok, c, :] = rows[parent_row[ok, c]]
+
+        consts = {
+            "filled": filled.astype(dt),
+            "newbit": newbit.astype(dt),
+            "topstone": topstone.astype(dt),
+            "valid": valid,
+            "move_row": move_row,
+            "parent_row": parent_row,
+            "cellidx": cellidx,
+            "child_cellidx": child_cellidx,
+            "parent_cellidx": parent_cellidx,
+        }
+        self._level_consts[level] = consts
+        return consts
+
+    # -- host (numpy / python-int) rank machinery ---------------------------
+
+    def rank_np(self, level: int, row: int, p1_bits: int) -> int:
+        """Combinadic rank of a position's player-1 cell set (host scalar)."""
+        cellidx = self.cellidx_rows(level)[row]
+        rank, seen = 0, 0
+        for j in range(self.ncells):
+            k = int(cellidx[j])
+            if k < 0:
+                continue
+            if (p1_bits >> int(self.bitpos[j])) & 1:
+                seen += 1
+                rank += math.comb(k, seen)
+        return rank
+
+    def unrank_np(self, level: int, row: int, rank: int) -> int:
+        """Inverse of rank_np: player-1 bitboard (host scalar)."""
+        cellidx = self.cellidx_rows(level)[row]
+        order = [(int(cellidx[j]), int(self.bitpos[j]))
+                 for j in range(self.ncells) if cellidx[j] >= 0]
+        order.sort(reverse=True)  # descending within-class index
+        bits, i = 0, n1_of_level(level)
+        for k, bp in order:
+            if i > 0 and math.comb(k, i) <= rank:
+                rank -= math.comb(k, i)
+                bits |= 1 << bp
+                i -= 1
+        return bits
+
+    def locate(self, state: int) -> tuple[int, int, int]:
+        """Guard-encoded state (games/connect4.py) -> (level, row, rank)."""
+        w, h1 = self.width, self.h1
+        heights = []
+        current = 0
+        for c in range(w):
+            col = (state >> (c * h1)) & ((1 << h1) - 1)
+            hc = col.bit_length() - 1
+            if hc < 0:
+                raise ValueError(f"column {c} has no guard bit: {state:#x}")
+            heights.append(hc)
+            current |= (col ^ (1 << hc)) << (c * h1)
+        level = sum(heights)
+        row = self.row_of[level].get(tuple(heights))
+        if row is None:
+            raise ValueError(f"impossible height profile {heights}")
+        filled = 0
+        for c in range(w):
+            filled |= ((1 << heights[c]) - 1) << (c * h1)
+        # The guard encoding stores the CURRENT player's stones; player 1 is
+        # the current player at even levels.
+        p1 = current if level % 2 == 0 else (filled ^ current)
+        return level, row, self.rank_np(level, row, p1)
+
+    def _connected_np(self, stones: int) -> bool:
+        """Host twin of the device win fold, on a python-int bitboard."""
+        h = self.height
+        for d in (1, h, h + 1, h + 2):
+            x = stones
+            for i in range(1, self.connect):
+                x &= stones >> (i * d)
+            if x:
+                return True
+        return False
+
+    def current_player_has_line(self, level: int, row: int,
+                                rank: int) -> bool:
+        """True for the garbage class: the player to move already won."""
+        p1 = self.unrank_np(level, row, rank)
+        prof = self.profiles[level][row]
+        filled = 0
+        for c in range(self.width):
+            filled |= ((1 << int(prof[c])) - 1) << (c * self.h1)
+        current = p1 if level % 2 == 0 else (filled ^ p1)
+        return self._connected_np(current)
+
+
+# ---------------------------------------------------------------------------
+# Device kernels
+
+
+def _connected_fold(stones, h: int, connect: int, dt):
+    """Any `connect`-in-a-row in a guard-layout bitboard (no guard bits set).
+
+    Same four directions as games/connect4.py: vertical 1, diag-down h,
+    horizontal h+1, diag-up h+2.
+    """
+    won = jnp.zeros(stones.shape, bool)
+    for d in (1, h, h + 1, h + 2):
+        x = stones
+        for i in range(1, connect):
+            x = x & (stones >> dt(i * d))
+        won = won | (x != 0)
+    return won
+
+
+def _binom_lookup(brow, i, use_onehot: bool):
+    """C(k, i) where brow[...] = binom[k] ([..., K] rank-dtype) and i is a
+    per-element ordinal in [0, K). Two lowerings: take_along_axis (a small
+    batched gather) or a one-hot select tree (pure VPU, K-1 selects)."""
+    if not use_onehot:
+        return jnp.take_along_axis(brow, i, axis=-1)
+    out = jnp.zeros(i.shape, brow.dtype)
+    for k in range(brow.shape[-1]):
+        out = jnp.where(i == k, brow[..., k : k + 1], out)
+    return out
+
+
+def _unrank_bits(ranks, n1, binom_cell, bitpos, dt, rank_dtype, use_onehot):
+    """[P, cb] combinadic ranks -> player-1 bitboards, via a descending walk
+    over the global cells. binom_cell[j] = binom rows of each class's
+    within-class index for global cell j ([ncells, P, K]; all-zero row marks
+    an absent cell — real cells have C(k,0)=1).
+
+    fori_loop, not an unrolled Python loop: ncells * (1 + max_moves) cell
+    steps per level step unrolled was ~100 gather blocks of HLO, taking
+    2.5-11 s to COMPILE per level on CPU (measured); the rolled form
+    compiles in well under a second and the per-iteration work is a handful
+    of fused elementwise ops on [P, cb]."""
+    ncells = binom_cell.shape[0]
+    P = binom_cell.shape[1]
+    cb = ranks.shape[1]
+    masks = jnp.asarray([1 << int(b) for b in bitpos], dt)
+
+    def body(t, carry):
+        bits, rem, r = carry
+        j = ncells - 1 - t
+        brow = jax.lax.dynamic_index_in_dim(
+            binom_cell, j, 0, keepdims=False
+        )  # [P, K]
+        exists = brow[:, 0:1] != 0
+        cki = _binom_lookup(brow[:, None, :], rem[..., None],
+                            use_onehot)[..., 0]  # [P, cb] C(k_j, rem)
+        # C(k, rem) == 0 (k < rem) means every remaining cell MUST be a
+        # stone — 0 <= r always holds, so `take` fires as required.
+        take = exists & (rem > 0) & (cki <= r)
+        r = jnp.where(take, r - cki, r)
+        rem = jnp.where(take, rem - 1, rem)
+        bits = jnp.where(take, bits | masks[j], bits)
+        return bits, rem, r
+
+    bits = jnp.zeros((P, cb), dt)
+    rem = jnp.full((P, cb), n1, jnp.int32)
+    r = ranks + jnp.zeros((P, 1), rank_dtype)
+    bits, _, _ = jax.lax.fori_loop(0, ncells, body, (bits, rem, r))
+    return bits
+
+
+def _rank_bits(bits, binom_cell_c, bitpos, dt, rank_dtype, use_onehot):
+    """[P, cb] stone bitboards -> combinadic ranks under the cell indexing
+    given by binom_cell_c ([ncells, P, K], the TARGET class per row)."""
+    ncells = binom_cell_c.shape[0]
+    P, cb = bits.shape
+    masks = jnp.asarray([1 << int(b) for b in bitpos],
+                        bits.dtype)
+
+    def body(j, carry):
+        acc, seen = carry
+        brow = jax.lax.dynamic_index_in_dim(
+            binom_cell_c, j, 0, keepdims=False
+        )  # [P, K]
+        exists = brow[:, 0:1] != 0
+        bset = (bits & masks[j]) != 0
+        take = exists & bset
+        seen_n = jnp.where(take, seen + 1, seen)
+        ck = _binom_lookup(brow[:, None, :], seen_n[..., None],
+                           use_onehot)[..., 0]
+        acc = jnp.where(take, acc + ck, acc)
+        return acc, seen_n
+
+    acc = jnp.zeros((P, cb), rank_dtype)
+    seen = jnp.zeros((P, cb), jnp.int32)
+    acc, _ = jax.lax.fori_loop(0, ncells, body, (acc, seen))
+    return acc
+
+
+def build_dense_step(tables: DenseTables, level: int, cblock: int,
+                     rank_dtype, flat_dtype, use_onehot: bool):
+    """Build the backward step for one level at one block width.
+
+    Returned fn:
+      (rank0 i32, child_cells [flat] u8 (dummy at the top level),
+       binom_cell [ncells, P, K], filled [P], newbit [P, w],
+       valid [P, w] bool, move_row [P, w] i32,
+       child_binom_cell [ncells, P, w, K])
+      -> cells [P, cblock] u8
+
+    All shape-static; one compiled program per (level-shape, block width).
+    """
+    w, h, connect = tables.width, tables.height, tables.connect
+    ncells = tables.ncells
+    dt = jnp.uint64 if tables.bits_dtype == np.uint64 else jnp.uint32
+    n1 = n1_of_level(level)
+    Cc = tables.class_size[level + 1] if level < ncells else 1
+    is_top = level == ncells
+    p1_moves = level % 2 == 0   # the player moving OUT of this level
+    mover_is_p1 = level % 2 == 1  # the player who made the ply INTO it
+    bitpos = [int(b) for b in tables.bitpos]
+
+    def step(rank0, child_cells, binom_cell, filled, newbit,
+             valid, move_row, child_binom_cell):
+        P = filled.shape[0]
+        ranks = (rank0.astype(rank_dtype)
+                 + jax.lax.iota(rank_dtype, cblock)[None, :])  # [1, cb]
+
+        p1 = _unrank_bits(ranks, n1, binom_cell, bitpos, dt, rank_dtype,
+                          use_onehot)
+        p2 = filled[:, None] ^ p1
+        mover = p1 if mover_is_p1 else p2
+        current = p2 if mover_is_p1 else p1
+
+        mover_line = _connected_fold(mover, h, connect, dt)
+        current_line = _connected_fold(current, h, connect, dt)
+
+        # mover_line: the player to move already lost. current_line without
+        # mover_line: unreachable garbage — terminal-ize it so it never
+        # fans out gathers (value is arbitrary; nothing real reads it).
+        # Full board without lines: TIE.
+        if is_top:
+            return jnp.where(
+                mover_line | current_line, jnp.uint8(LOSE), jnp.uint8(TIE)
+            )  # remoteness 0 everywhere at the top level
+        prim_mask = mover_line | current_line
+
+        child_vals = []
+        child_rems = []
+        masks = []
+        for c in range(w):
+            cbits = (p1 | newbit[:, c : c + 1]) if p1_moves else p1
+            crank = _rank_bits(cbits, child_binom_cell[:, :, c], bitpos, dt,
+                               rank_dtype, use_onehot)
+            flat = (move_row[:, c : c + 1].astype(flat_dtype)
+                    * flat_dtype(Cc) + crank.astype(flat_dtype))
+            ok = valid[:, c : c + 1] & jnp.ones((1, cblock), bool)
+            cell = child_cells[jnp.clip(flat, 0, child_cells.shape[0] - 1)]
+            child_vals.append(cell & jnp.uint8(3))
+            child_rems.append((cell >> jnp.uint8(2)).astype(jnp.int32))
+            masks.append(ok)
+
+        cv = jnp.stack(child_vals, axis=-1).reshape(P * cblock, w)
+        cr = jnp.stack(child_rems, axis=-1).reshape(P * cblock, w)
+        mk = (jnp.stack(masks, axis=-1)
+              & ~prim_mask[..., None]).reshape(P * cblock, w)
+        values, rem_out = combine_children(cv, cr, mk)
+        values = values.reshape(P, cblock)
+        rem_out = rem_out.reshape(P, cblock)
+
+        values = jnp.where(prim_mask, jnp.uint8(LOSE), values)
+        rem_out = jnp.where(prim_mask, 0, rem_out)
+        return values | (jnp.clip(rem_out, 0, 63).astype(jnp.uint8)
+                         << jnp.uint8(2))
+
+    # Not jitted here: engine.get_kernel / schedule_kernel jit the builder's
+    # return value themselves.
+    return step
+
+
+def build_reach_step(tables: DenseTables, level: int, cblock: int,
+                     rank_dtype, flat_dtype, use_onehot: bool):
+    """Build the reachability-sweep step for one level (level >= 1).
+
+    reach(y) = OR over columns c of y's class: the top stone of column c
+    belongs to the player who made ply `level` AND the position with that
+    stone removed is reachable AND was not terminal (its own last mover had
+    no line). Level counting is the exact Tromp-legal/reachable count the
+    BFS engine discovers — validated against it in the parity tests.
+
+    Returned fn:
+      (rank0 i32, parent_reach [flat] u8,
+       binom_cell [ncells, P, K], filled [P], topstone [P, w],
+       parent_row [P, w] i32, parent_binom_cell [ncells, P, w, K])
+      -> (reach [P, cblock] u8, count i64)
+    """
+    w, h, connect = tables.width, tables.height, tables.connect
+    ncells = tables.ncells
+    dt = jnp.uint64 if tables.bits_dtype == np.uint64 else jnp.uint32
+    n1 = n1_of_level(level)
+    C = tables.class_size[level]
+    Cp = tables.class_size[level - 1]
+    mover_is_p1 = level % 2 == 1           # who made ply `level`
+    parent_mover_is_p1 = (level - 1) % 2 == 1  # who made the ply before
+    bitpos = [int(b) for b in tables.bitpos]
+
+    def step(rank0, parent_reach, binom_cell, filled, topstone,
+             parent_row, parent_binom_cell):
+        P = filled.shape[0]
+        ranks = (rank0.astype(rank_dtype)
+                 + jax.lax.iota(rank_dtype, cblock)[None, :])
+        in_range = ranks < rank_dtype(C)
+
+        p1 = _unrank_bits(ranks, n1, binom_cell, bitpos, dt, rank_dtype,
+                          use_onehot)
+
+        reach = jnp.zeros((P, cblock), bool)
+        for c in range(w):
+            ts = topstone[:, c : c + 1]  # [P, 1]; 0 for empty columns
+            stone_is_p1 = (p1 & ts) != 0
+            color_ok = (ts != 0) & (
+                stone_is_p1 if mover_is_p1 else
+                ((ts != 0) & ~stone_is_p1)
+            )
+            parent_p1 = (p1 ^ ts) if mover_is_p1 else p1
+            parent_filled = filled[:, None] ^ ts
+            parent_mover = (parent_p1 if parent_mover_is_p1
+                            else parent_filled ^ parent_p1)
+            parent_live = ~_connected_fold(parent_mover, h, connect, dt)
+            prank = _rank_bits(parent_p1, parent_binom_cell[:, :, c],
+                               bitpos, dt, rank_dtype, use_onehot)
+            flat = (parent_row[:, c : c + 1].astype(flat_dtype)
+                    * flat_dtype(Cp) + prank.astype(flat_dtype))
+            pr = parent_reach[
+                jnp.clip(flat, 0, parent_reach.shape[0] - 1)
+            ] != 0
+            reach = reach | (color_ok & parent_live & pr
+                             & (parent_row[:, c : c + 1] >= 0))
+        count = jnp.sum((reach & in_range).astype(jnp.int64))
+        return reach.astype(jnp.uint8), count
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+
+
+class DenseSolveResult:
+    """Duck-typed SolveResult for the dense engine (CLI/bench compatible)."""
+
+    def __init__(self, game: Connect4, tables: DenseTables, value: int,
+                 remoteness: int, cells: Optional[Dict[int, np.ndarray]],
+                 stats: dict):
+        self.game = game
+        self._tables = tables
+        self.value = int(value)
+        self.remoteness = int(remoteness)
+        self.cells = cells  # level -> [P, C] u8, or None in no-tables mode
+        self.stats = stats
+
+    @property
+    def num_positions(self) -> int:
+        return self.stats["positions"]
+
+    def lookup(self, state) -> tuple[int, int]:
+        """(value, remoteness) of any guard-encoded position, O(1).
+
+        Scope differs from the BFS engine's lookup: dense tables answer for
+        every VALID board configuration (the encodable superset), not just
+        game-reachable positions — the negamax value of a no-line
+        configuration is well-defined whether or not alternating play can
+        produce it. The one class whose stored cells are fabricated —
+        positions where the player to move already completed a line (the
+        solver terminal-izes them without expansion) — raises KeyError.
+        """
+        if self.cells is None:
+            raise KeyError("solved in no-tables mode; re-run with tables")
+        level, row, rank = self._tables.locate(int(state))
+        if self._tables.current_player_has_line(level, row, rank):
+            raise KeyError(
+                f"state {int(state):#x} is not a position (the player to "
+                "move already has a line); its table cell is a placeholder"
+            )
+        cell = int(self.cells[level][row, rank])
+        return cell & 3, cell >> 2
+
+
+# Reachable-position counts are a property of the board, not the solve;
+# one sweep per process per board and every later solve reuses the result
+# (the benchmark's warm repeats must measure the solve, not the count).
+_REACH_COUNTS: Dict[tuple, Dict[int, int]] = {}
+
+
+class DenseSolver:
+    """Single-chip dense solver for Connect4 games (sym=False).
+
+    Usage mirrors solve.Solver: DenseSolver(game).solve() -> result with
+    .value/.remoteness/.num_positions/.stats/.lookup.
+
+    count_positions: "auto" runs the reachability sweep once per board per
+    process (exact reachable count, validated against the BFS engine);
+    False skips it and reports positions=0 unless already cached.
+    """
+
+    def __init__(self, game: Connect4, store_tables: bool = True,
+                 block_elems: Optional[int] = None, logger=None,
+                 count_positions="auto"):
+        if not isinstance(game, Connect4):
+            raise TypeError("DenseSolver requires a Connect4-family game")
+        if game.sym:
+            raise ValueError(
+                "DenseSolver solves the full space; use sym=False "
+                "(symmetry only reduces memory, which dense tables "
+                "already cut to 1 byte/position)"
+            )
+        self.game = game
+        self.store_tables = store_tables
+        self.logger = logger
+        self.count_positions = count_positions
+        self.tables = DenseTables(game.width, game.height, game.connect)
+        self.block_elems = block_elems or int(
+            os.environ.get("GAMESMAN_DENSE_BLOCK", str(64 * 1024 * 1024))
+        )
+        self.use_onehot = os.environ.get(
+            "GAMESMAN_DENSE_BINOM", "take"
+        ) == "onehot"
+        nc = self.tables.ncells
+        max_class = max(self.tables.class_size)
+        self._rank_dtype = (jnp.uint32 if max_class < (1 << 31)
+                            else jnp.uint64)
+        max_flat = max(
+            self.tables.class_size[L] * len(self.tables.profiles[L])
+            for L in range(nc + 1)
+        )
+        self._flat_dtype = jnp.int32 if max_flat < (1 << 31) else jnp.int64
+
+    @property
+    def _board_key(self):
+        g = self.game
+        return (g.width, g.height, g.connect)
+
+    def _kernel(self, kind: str, level: int, cblock: int, builder):
+        key = (
+            kind, level, cblock, self.use_onehot,
+            str(self._rank_dtype), str(self._flat_dtype),
+        )
+        t, rd, fd, oh = (self.tables, self._rank_dtype, self._flat_dtype,
+                         self.use_onehot)
+        return get_kernel(
+            self.game, kind, key,
+            lambda g: builder(t, level, cblock, rd, fd, oh),
+        )
+
+    def _cblock(self, level: int) -> tuple[int, int]:
+        P = len(self.tables.profiles[level])
+        C = self.tables.class_size[level]
+        cblock = max(min(C, max(self.block_elems // max(P, 1), 1)), 1)
+        return cblock, -(-C // cblock)
+
+    def _upload_consts(self, level: int, for_reach: bool):
+        """Per-level device constants, including per-step binom rows."""
+        t = self.tables
+        consts = t.level_consts(level)
+        rk = np.uint32 if self._rank_dtype == jnp.uint32 else np.uint64
+
+        def binom_of(cellidx):  # [..., ncells] -> [ncells, ..., K]
+            bc = np.where(
+                (cellidx >= 0)[..., None],
+                t.binom[np.clip(cellidx, 0, None)],
+                0,
+            ).astype(rk)
+            return np.ascontiguousarray(np.moveaxis(bc, -2, 0))
+
+        out = dict(
+            binom_cell=jnp.asarray(
+                binom_of(consts["cellidx"].astype(np.int32))
+            ),
+            filled=jnp.asarray(consts["filled"]),
+        )
+        if for_reach:
+            out.update(
+                topstone=jnp.asarray(consts["topstone"]),
+                parent_row=jnp.asarray(consts["parent_row"]),
+                parent_binom_cell=jnp.asarray(
+                    binom_of(consts["parent_cellidx"].astype(np.int32))
+                ),
+            )
+        else:
+            out.update(
+                newbit=jnp.asarray(consts["newbit"]),
+                valid=jnp.asarray(consts["valid"]),
+                move_row=jnp.asarray(consts["move_row"]),
+                child_binom_cell=jnp.asarray(
+                    binom_of(consts["child_cellidx"].astype(np.int32))
+                ),
+            )
+        return out
+
+    # -- reachability sweep -------------------------------------------------
+
+    def reachable_counts(self) -> Dict[int, int]:
+        """Exact per-level reachable-position counts (cached per process)."""
+        cached = _REACH_COUNTS.get(self._board_key)
+        if cached is not None:
+            return cached
+        t = self.tables
+        nc = t.ncells
+        reach_flat = jnp.ones((1,), jnp.uint8)  # level 0: the root
+        counts_dev: Dict[int, jnp.ndarray] = {}
+        for L in range(1, nc + 1):
+            cblock, nblk = self._cblock(L)
+            step = self._kernel("dense_reach", L, cblock, build_reach_step)
+            consts = self._upload_consts(L, for_reach=True)
+            blocks = []
+            cnt = None
+            for b in range(nblk):
+                r_b, c_b = step(
+                    jnp.int32(b * cblock), reach_flat,
+                    consts["binom_cell"], consts["filled"],
+                    consts["topstone"], consts["parent_row"],
+                    consts["parent_binom_cell"],
+                )
+                blocks.append(r_b)
+                cnt = c_b if cnt is None else cnt + c_b
+            level_reach = (
+                blocks[0] if nblk == 1 else jnp.concatenate(blocks, axis=1)
+            )
+            C = t.class_size[L]
+            if nblk * cblock != C:
+                level_reach = level_reach[:, :C]
+            reach_flat = level_reach.reshape(-1)
+            counts_dev[L] = cnt
+        counts = {0: 1}
+        counts.update({L: int(v) for L, v in counts_dev.items()})
+        _REACH_COUNTS[self._board_key] = counts
+        return counts
+
+    # -- the solve ----------------------------------------------------------
+
+    def solve(self) -> DenseSolveResult:
+        g, t = self.game, self.tables
+        nc = t.ncells
+        t0 = time.perf_counter()
+        encodable_total = 0
+        saved: Optional[Dict[int, np.ndarray]] = (
+            {} if self.store_tables else None
+        )
+        child_flat = jnp.zeros((1,), jnp.uint8)  # dummy for the top level
+        for L in range(nc, -1, -1):
+            P = len(t.profiles[L])
+            C = t.class_size[L]
+            encodable_total += P * C
+            cblock, nblk = self._cblock(L)
+            step = self._kernel("dense_step", L, cblock, build_dense_step)
+            consts = self._upload_consts(L, for_reach=False)
+            blocks = []
+            for b in range(nblk):
+                blocks.append(step(
+                    jnp.int32(b * cblock), child_flat,
+                    consts["binom_cell"], consts["filled"],
+                    consts["newbit"], consts["valid"],
+                    consts["move_row"], consts["child_binom_cell"],
+                ))
+            level_cells = (
+                blocks[0] if nblk == 1 else jnp.concatenate(blocks, axis=1)
+            )
+            if nblk * cblock != C:
+                level_cells = level_cells[:, :C]
+            child_flat = level_cells.reshape(-1)
+            if self.logger is not None:
+                self.logger.log({
+                    "phase": "dense_backward", "level": L, "classes": P,
+                    "class_size": C,
+                })
+            if saved is not None:
+                saved[L] = np.asarray(level_cells).reshape(P, C)
+
+        root_cell = int(jnp.reshape(child_flat, (-1,))[0])
+        value, remoteness = root_cell & 3, root_cell >> 2
+        solve_secs = time.perf_counter() - t0
+
+        counted = _REACH_COUNTS.get(self._board_key)
+        count_secs = 0.0
+        if counted is None and self.count_positions != False:  # noqa: E712
+            tc = time.perf_counter()
+            counted = self.reachable_counts()
+            count_secs = time.perf_counter() - tc
+        positions = sum(counted.values()) if counted else 0
+
+        stats = {
+            "game": g.name,
+            "engine": "dense",
+            "positions": positions,
+            "encodable_positions": encodable_total,
+            "levels": nc + 1,
+            "secs_forward": 0.0,  # there is no forward pass
+            "secs_backward": solve_secs,
+            "secs_total": solve_secs,
+            "secs_count_reachable": count_secs,  # excluded from secs_total:
+            # a per-board constant, computed once per process, not part of
+            # the solve (docs/ARCHITECTURE.md "Dense engine (Connect-4
+            # family)").
+            "positions_per_sec": positions / max(solve_secs, 1e-9),
+            "bytes_sorted": 0,
+            "bytes_gathered": encodable_total * g.max_moves,  # u8 cells
+        }
+        if counted:
+            stats["reachable_per_level"] = counted
+        if self.logger is not None:
+            self.logger.log({"phase": "done", **stats})
+        return DenseSolveResult(g, t, value, remoteness, saved, stats)
