@@ -231,12 +231,16 @@ def inner() -> int:
     print(f"bench device: {dev.platform} ({dev})", file=sys.stderr)
 
     # Engine selection: the dense class-partitioned engine (solve/dense.py)
-    # is the fast path for non-symmetric Connect-4 boards; BENCH_ENGINE=
-    # classic pins the level-BFS engine for comparison runs.
+    # is the fast path for non-symmetric Connect-4 boards on the
+    # accelerator; on the CPU fallback its VPU-shaped rank loops lose to
+    # the classic engine, so auto resolves by platform. BENCH_ENGINE=
+    # classic|dense pins one for comparison runs.
     bench_engine = os.environ.get("BENCH_ENGINE", "auto")
+    if bench_engine == "auto":
+        bench_engine = "classic" if dev.platform == "cpu" else "dense"
 
     def make_solver(game):
-        if bench_engine != "classic" and isinstance(game, Connect4) \
+        if bench_engine == "dense" and isinstance(game, Connect4) \
                 and not game.sym:
             from gamesmanmpi_tpu.solve.dense import DenseSolver
 

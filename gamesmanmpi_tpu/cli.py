@@ -380,6 +380,14 @@ def _main(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.engine == "auto" and dense_eligible:
+        # Same platform policy as bench.py: the dense engine's rank loops
+        # are shaped for the TPU's vector units; on CPU the classic engine
+        # measured faster. An explicit --engine dense still forces it.
+        import jax
+
+        if jax.devices()[0].platform == "cpu":
+            dense_eligible = False
     if args.engine != "classic" and dense_eligible:
         from gamesmanmpi_tpu.solve.dense import DenseSolver
 

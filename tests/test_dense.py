@@ -80,6 +80,44 @@ def test_dense_parity_4x4():
             )
 
 
+def test_device_rank_unrank_match_host_u64_board():
+    # 4x7 needs (7+1)*4 = 32 > 31 state bits -> the uint64 kernel path the
+    # 6x5 board ladder uses. A full u64 solve is too big for CI, but the
+    # rank/unrank kernels themselves are one call.
+    import jax
+    import jax.numpy as jnp
+
+    from gamesmanmpi_tpu.solve.dense import _rank_bits, _unrank_bits
+
+    t = DenseTables(4, 7)
+    assert t.bits_dtype == np.uint64
+    L = 9
+    P = len(t.profiles[L])
+    C = t.class_size[L]
+    rng = np.random.default_rng(3)
+    cb = 32
+    ranks = rng.integers(0, C, size=(P, cb), dtype=np.uint32)
+    cellidx = np.ascontiguousarray(
+        t.cellidx_rows(L).astype(np.int32).T
+    )  # [ncells, P]
+    binom = t.binom.astype(np.uint32)
+
+    bits = jax.jit(lambda r: _unrank_bits(
+        r, n1_of_level(L), jnp.asarray(binom), jnp.asarray(cellidx),
+        [int(b) for b in t.bitpos], jnp.uint64, jnp.uint32, False,
+    ))(jnp.asarray(ranks))
+    back = jax.jit(lambda b: _rank_bits(
+        b, jnp.asarray(binom), jnp.asarray(cellidx),
+        [int(b2) for b2 in t.bitpos], jnp.uint64, jnp.uint32, False,
+    ))(bits)
+    bits_np = np.asarray(bits)
+    back_np = np.asarray(back)
+    for p in range(P):
+        for i in range(cb):
+            assert int(bits_np[p, i]) == t.unrank_np(L, p, int(ranks[p, i]))
+            assert int(back_np[p, i]) == int(ranks[p, i])
+
+
 def test_dense_rejects_sym_and_non_connect4():
     with pytest.raises(ValueError):
         DenseSolver(get_game("connect4:w=4,h=4,sym=1"))
@@ -94,6 +132,18 @@ def test_dense_no_tables_mode():
     assert (rd.value, rd.remoteness) == (3, 9)  # TIE, remoteness 9
     with pytest.raises(KeyError):
         rd.lookup(int(g.initial_state()))
+
+
+def test_dense_blocked_levels_match_unblocked():
+    # Tiny block_elems forces nblk > 1 on every non-trivial level,
+    # exercising the block concat + tail-slice path end to end.
+    g = get_game("connect4:w=3,h=3,connect=3")
+    whole = DenseSolver(g).solve()
+    blocked = DenseSolver(g, block_elems=64).solve()
+    assert (blocked.value, blocked.remoteness) == (whole.value,
+                                                  whole.remoteness)
+    for L, cells in whole.cells.items():
+        np.testing.assert_array_equal(blocked.cells[L], cells)
 
 
 def test_dense_lookup_refuses_garbage_positions():
