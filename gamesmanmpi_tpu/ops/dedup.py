@@ -10,6 +10,10 @@ static-shape O(n log n) pattern XLA maps well (SURVEY.md §7 "Dedup at scale").
 import jax.numpy as jnp
 
 from gamesmanmpi_tpu.core.bitops import sentinel_for
+# sort1 dispatches to XLA's sort network, or to the merge ladder under
+# GAMESMAN_SORT=merge. The flag is read at trace time — set it before the
+# process builds any kernels; the kernel cache does not key on it.
+from gamesmanmpi_tpu.ops.mergesort import sort1 as _sort
 
 
 def sort_unique(states):
@@ -28,9 +32,9 @@ def sort_unique(states):
     kernel on the happy path.
     """
     sentinel = sentinel_for(states.dtype)
-    s = jnp.sort(states)
+    s = _sort(states)
     first = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
     keep = first & (s != sentinel)
-    out = jnp.sort(jnp.where(keep, s, sentinel))
+    out = _sort(jnp.where(keep, s, sentinel))
     count = jnp.sum(keep).astype(jnp.int32)
     return out, count

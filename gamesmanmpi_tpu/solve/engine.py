@@ -72,6 +72,7 @@ from gamesmanmpi_tpu.core.values import UNDECIDED
 from gamesmanmpi_tpu.games.base import TensorGame
 from gamesmanmpi_tpu.ops.combine import combine_children
 from gamesmanmpi_tpu.ops.dedup import sort_unique
+from gamesmanmpi_tpu.ops.mergesort import sort1, sort_with_payload
 from gamesmanmpi_tpu.ops.lookup import lookup_window
 from gamesmanmpi_tpu.ops.padding import MIN_BUCKET, bucket_size, pad_to, pad_to_bucket
 from gamesmanmpi_tpu.solve.precompile import global_precompiler, sds
@@ -259,15 +260,17 @@ def expand_provenance(game: TensorGame, states):
     children, _ = canonical_children(game, states, active)
     flat = children.reshape(-1)
     origin = jax.lax.iota(jnp.int32, flat.shape[0])
-    s, o = jax.lax.sort((flat, origin), num_keys=1, is_stable=False)
+    # Sorts dispatch through ops.mergesort: XLA's network by default, the
+    # elementwise merge ladder under GAMESMAN_SORT=merge.
+    s, o = sort_with_payload(flat, origin)
     first = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
     keep = first & (s != game.sentinel)
     # Every slot in a duplicate run shares the survivor's unique-index
     # (cumsum over run-first markers is constant within the run).
     uid = jnp.cumsum(keep.astype(jnp.int32)) - 1
     uid = jnp.where(s != game.sentinel, uid, -1)
-    _, uidx = jax.lax.sort((o, uid), num_keys=1, is_stable=False)
-    uniq = jnp.sort(jnp.where(keep, s, game.sentinel))
+    _, uidx = sort_with_payload(o, uid)
+    uniq = sort1(jnp.where(keep, s, game.sentinel))
     count = jnp.sum(keep).astype(jnp.int32)
     return uniq, count, uidx, prim
 
