@@ -167,6 +167,17 @@ class DenseTables:
 
     # -- per-level constants ------------------------------------------------
 
+    def col_base(self, level: int) -> np.ndarray:
+        """[P, w] int32: within-class index of each column's FIRST cell
+        (= cells in lower-numbered columns). The one definition of the
+        class cell ordering — cellidx_rows and snapk both derive from it.
+        """
+        prof = self.profiles[level].astype(np.int32)
+        return np.concatenate(
+            [np.zeros((prof.shape[0], 1), np.int32),
+             np.cumsum(prof, axis=1)[:, :-1]], axis=1
+        )
+
     def cellidx_rows(self, level: int) -> np.ndarray:
         """[P, ncells] int16: within-class index of global slot j, -1 if the
         cell is above the column height (absent)."""
@@ -174,10 +185,7 @@ class DenseTables:
             return self._cellidx[level]
         prof = self.profiles[level].astype(np.int32)  # [P, w]
         w, h = self.width, self.height
-        base = np.concatenate(
-            [np.zeros((prof.shape[0], 1), np.int32),
-             np.cumsum(prof, axis=1)[:, :-1]], axis=1
-        )  # [P, w] cells before column c
+        base = self.col_base(level)  # [P, w] cells before column c
         r = np.tile(np.arange(h, dtype=np.int32), w)  # [ncells]
         c = np.repeat(np.arange(w, dtype=np.int32), h)
         idx = base[:, c] + r[None, :]  # [P, ncells]
@@ -236,6 +244,20 @@ class DenseTables:
                         key[c] -= 1
                         parent_row[p, c] = prv[tuple(int(v) for v in key)]
 
+        # Fused-rank snapshot slots: snapk[p, j] = the within-CHILD-class
+        # index the new cell of column j//h would get (= parent cells
+        # before that slot), at the one slot per column where r == h_c;
+        # -1 elsewhere. See _rank_all_moves_fused.
+        base = self.col_base(level).astype(np.int64)
+        snapk = np.full((P, self.ncells), -1, np.int32)
+        for c in range(w):
+            hc = prof[:, c]
+            rows = np.arange(P)
+            ok = hc < h
+            snapk[rows[ok], (c * h + hc[ok]).astype(np.int64)] = (
+                base[ok, c] + hc[ok]
+            )
+
         cellidx = self.cellidx_rows(level)
         child_cellidx = np.full((P, w, self.ncells), -1, np.int16)
         if level < self.ncells:
@@ -260,6 +282,7 @@ class DenseTables:
             "cellidx": cellidx,
             "child_cellidx": child_cellidx,
             "parent_cellidx": parent_cellidx,
+            "snapk": snapk,
         }
         self._level_consts[level] = consts
         return consts
@@ -439,16 +462,93 @@ def _rank_bits(bits, binom, cellidx_c, bitpos, dt, rank_dtype, use_onehot):
     return acc
 
 
+def _rank_all_moves_fused(bits, binom, cellidx, snapk, bitpos, rank_dtype,
+                          use_onehot, p1_moves: bool, w: int, h: int):
+    """All w child ranks in ONE walk over the parent's cells.
+
+    The per-move walk in _rank_bits re-reads every cell w times. But the
+    child class for move c differs from the parent only by inserting one
+    cell at within-child index t_c, so (colex combinadics):
+
+      p2 move:  child_rank(c) = A(t_c) + [S1 - S1(t_c)]
+      p1 move:  child_rank(c) = A(t_c) + C(t_c, seen(t_c)+1)
+                                + [S2 - S2(t_c)]
+
+    where A(t)   = sum of C(k, i) over set cells with parent index < t
+          S1(t)  = same prefix of C(k+1, i)     (cells shift up past t)
+          S2(t)  = same prefix of C(k+1, i+1)   (ordinals also shift: the
+                                                 new stone sits below)
+          seen(t)= set cells before t.
+
+    One walk maintains (A, S_shift, seen) and snapshots A - S_shift
+    (+ the new-stone term) at each column's insertion slot (snapk) —
+    2-3 binom lookups per cell instead of w, the dominant VPU cost of the
+    backward step under the one-hot lowering. Returns cranks [w, P, cb].
+    """
+    ncells, P = cellidx.shape
+    cb = bits.shape[1]
+    masks = jnp.asarray([1 << int(b) for b in bitpos], bits.dtype)
+    shift_ord = 1 if p1_moves else 0
+    kmax = binom.shape[0] - 1
+
+    def body(j, carry):
+        acc_par, acc_sh, seen, snaps = carry
+        kj = jax.lax.dynamic_index_in_dim(cellidx, j, 0, keepdims=False)
+        skj = jax.lax.dynamic_index_in_dim(snapk, j, 0, keepdims=False)
+        exists = (kj >= 0)[:, None]
+        bset = (bits & masks[j]) != 0
+        take = exists & bset
+        seen_n = jnp.where(take, seen + 1, seen)
+        browk = binom[jnp.clip(kj, 0, kmax)]
+        browk1 = binom[jnp.clip(kj + 1, 0, kmax)]
+        cpar = _binom_lookup(browk[:, None, :], seen_n[..., None],
+                             use_onehot)[..., 0]
+        csh = _binom_lookup(browk1[:, None, :],
+                            (seen_n + shift_ord)[..., None],
+                            use_onehot)[..., 0]
+        acc_par = jnp.where(take, acc_par + cpar, acc_par)
+        acc_sh = jnp.where(take, acc_sh + csh, acc_sh)
+        # Snapshot for the move of this step's column. The insertion slot
+        # is ABSENT in the parent (it sits above the column height), so
+        # take is False on snap rows and pre/post-step accumulators agree.
+        is_snap = (skj >= 0)[None, :, None]  # [1, P, 1]
+        snap_val = acc_par - acc_sh
+        if p1_moves:
+            brows = binom[jnp.clip(skj, 0, kmax)]
+            snap_val = snap_val + _binom_lookup(
+                brows[:, None, :], (seen_n + 1)[..., None], use_onehot
+            )[..., 0]
+        col = j // h
+        c_onehot = (jax.lax.iota(jnp.int32, w) == col)[:, None, None]
+        snaps = jnp.where(c_onehot & is_snap, snap_val[None], snaps)
+        return acc_par, acc_sh, seen_n, snaps
+
+    acc_par = jnp.zeros((P, cb), rank_dtype)
+    acc_sh = jnp.zeros((P, cb), rank_dtype)
+    seen = jnp.zeros((P, cb), jnp.int32)
+    snaps = jnp.zeros((w, P, cb), rank_dtype)
+    acc_par, acc_sh, seen, snaps = jax.lax.fori_loop(
+        0, ncells, body, (acc_par, acc_sh, seen, snaps)
+    )
+    return snaps + acc_sh[None]
+
+
 def build_dense_step(tables: DenseTables, level: int, cblock: int,
-                     rank_dtype, flat_dtype, use_onehot: bool):
+                     rank_dtype, flat_dtype, use_onehot: bool,
+                     fused_rank: bool = False):
     """Build the backward step for one level at one block width.
 
     Returned fn:
       (rank0 i32, child_cells [flat] u8 (dummy at the top level),
        binom [ncells+1, K], cellidx [ncells, P] i32, filled [P],
        newbit [P, w], valid [P, w] bool, move_row [P, w] i32,
-       child_cellidx [ncells, P, w] i32)
+       child_cellidx [ncells, P, w] i32, snapk [ncells, P] i32)
       -> cells [P, cblock] u8
+
+    fused_rank picks the single-walk child ranking
+    (_rank_all_moves_fused) over the per-move walks; results are
+    identical (tests pin it) — it is a lowering choice, keyed into the
+    kernel cache.
 
     All shape-static; one compiled program per (level-shape, block width).
     """
@@ -463,7 +563,7 @@ def build_dense_step(tables: DenseTables, level: int, cblock: int,
     bitpos = [int(b) for b in tables.bitpos]
 
     def step(rank0, child_cells, binom, cellidx, filled, newbit,
-             valid, move_row, child_cellidx):
+             valid, move_row, child_cellidx, snapk):
         P = filled.shape[0]
         ranks = (rank0.astype(rank_dtype)
                  + jax.lax.iota(rank_dtype, cblock)[None, :])  # [1, cb]
@@ -487,13 +587,21 @@ def build_dense_step(tables: DenseTables, level: int, cblock: int,
             )  # remoteness 0 everywhere at the top level
         prim_mask = mover_line | current_line
 
+        if fused_rank:
+            cranks = _rank_all_moves_fused(
+                p1, binom, cellidx, snapk, bitpos, rank_dtype, use_onehot,
+                p1_moves, w, h,
+            )
         child_vals = []
         child_rems = []
         masks = []
         for c in range(w):
-            cbits = (p1 | newbit[:, c : c + 1]) if p1_moves else p1
-            crank = _rank_bits(cbits, binom, child_cellidx[:, :, c], bitpos,
-                               dt, rank_dtype, use_onehot)
+            if fused_rank:
+                crank = cranks[c]
+            else:
+                cbits = (p1 | newbit[:, c : c + 1]) if p1_moves else p1
+                crank = _rank_bits(cbits, binom, child_cellidx[:, :, c],
+                                   bitpos, dt, rank_dtype, use_onehot)
             flat = (move_row[:, c : c + 1].astype(flat_dtype)
                     * flat_dtype(Cc) + crank.astype(flat_dtype))
             ok = valid[:, c : c + 1] & jnp.ones((1, cblock), bool)
@@ -521,8 +629,13 @@ def build_dense_step(tables: DenseTables, level: int, cblock: int,
 
 
 def build_reach_step(tables: DenseTables, level: int, cblock: int,
-                     rank_dtype, flat_dtype, use_onehot: bool):
+                     rank_dtype, flat_dtype, use_onehot: bool,
+                     fused_rank: bool = False):
     """Build the reachability-sweep step for one level (level >= 1).
+
+    fused_rank is accepted for builder-signature uniformity and ignored:
+    the sweep's one-rank-per-column walk has no per-move fan-out to fuse
+    (each column ranks a DIFFERENT parent bit pattern).
 
     reach(y) = OR over columns c of y's class: the top stone of column c
     belongs to the player who made ply `level` AND the position with that
@@ -675,9 +788,22 @@ class DenseSolver:
         self.block_elems = block_elems or int(
             os.environ.get("GAMESMAN_DENSE_BLOCK", str(64 * 1024 * 1024))
         )
+        # Binom lookup lowering: the one-hot select tree is bounded VPU
+        # work (K-1 selects, K <= 23); take_along_axis emits a gather,
+        # and XLA's TPU gathers measured ~11 ns/element (tools/microbench)
+        # — at (1 + max_moves) * ncells lookups per position that would
+        # dominate the whole solve. Default to the predictable lowering;
+        # GAMESMAN_DENSE_BINOM=take re-enables the gather for measurement.
         self.use_onehot = os.environ.get(
-            "GAMESMAN_DENSE_BINOM", "take"
-        ) == "onehot"
+            "GAMESMAN_DENSE_BINOM", "onehot"
+        ) != "take"
+        # Child-ranking lowering: "fused" = one walk for all moves
+        # (_rank_all_moves_fused), "simple" = per-move walks. Identical
+        # results (tests pin it); default simple until the chip measures
+        # both.
+        self.use_fused = os.environ.get(
+            "GAMESMAN_DENSE_RANK", "simple"
+        ) == "fused"
         nc = self.tables.ncells
         max_class = max(self.tables.class_size)
         self._rank_dtype = (jnp.uint32 if max_class < (1 << 31)
@@ -694,11 +820,12 @@ class DenseSolver:
         return (g.width, g.height, g.connect)
 
     def _kernel(self, kind: str, level: int, cblock: int, builder):
-        t, rd, fd, oh = (self.tables, self._rank_dtype, self._flat_dtype,
-                         self.use_onehot)
+        t, rd, fd, oh, fr = (self.tables, self._rank_dtype,
+                             self._flat_dtype, self.use_onehot,
+                             self.use_fused)
         return get_kernel(
             self.game, kind, self._kernel_key(kind, level, cblock),
-            lambda g: builder(t, level, cblock, rd, fd, oh),
+            lambda g: builder(t, level, cblock, rd, fd, oh, fused_rank=fr),
         )
 
     def _cblock(self, level: int) -> tuple[int, int]:
@@ -738,12 +865,17 @@ class DenseSolver:
             sds((P, w), dt),              # newbit
             sds((P, w), np.bool_),        # valid
             sds((P, w), np.int32),        # move_row
-            sds((t.ncells, P, w), np.int32),
+            sds((t.ncells, P, w), np.int32),  # child_cellidx
+            sds((t.ncells, P), np.int32),     # snapk
         )
 
     def _kernel_key(self, kind: str, level: int, cblock: int):
+        # use_fused only changes dense_step lowering; keying it into the
+        # reach kernels would recompile byte-identical programs on a flag
+        # flip (seconds each over the relay).
+        fused = self.use_fused if kind == "dense_step" else False
         return (
-            kind, level, cblock, self.use_onehot,
+            kind, level, cblock, self.use_onehot, fused,
             str(self._rank_dtype), str(self._flat_dtype),
         )
 
@@ -762,11 +894,13 @@ class DenseSolver:
         def sched(kind, level, builder, for_reach):
             cblock, _ = self._cblock(level)
             key = self._kernel_key(kind, level, cblock)
-            rd, fd, oh = self._rank_dtype, self._flat_dtype, self.use_onehot
+            rd, fd, oh, fr = (self._rank_dtype, self._flat_dtype,
+                              self.use_onehot, self.use_fused)
             P = len(t.profiles[level])
             schedule_kernel(
                 self.game, kind, key,
-                lambda g: builder(t, level, cblock, rd, fd, oh),
+                lambda g: builder(t, level, cblock, rd, fd, oh,
+                                  fused_rank=fr),
                 self._avals(level, cblock, for_reach),
                 heavy=P * cblock * 8 > (512 << 20),
             )
@@ -829,6 +963,7 @@ class DenseSolver:
                 child_cellidx=jnp.asarray(
                     steps_first(consts["child_cellidx"])
                 ),
+                snapk=jnp.asarray(steps_first(consts["snapk"])),
             )
         t._dev_consts[ck] = out
         return out
@@ -899,6 +1034,7 @@ class DenseSolver:
                     consts["binom"], consts["cellidx"], consts["filled"],
                     consts["newbit"], consts["valid"],
                     consts["move_row"], consts["child_cellidx"],
+                    consts["snapk"],
                 ))
             level_cells = (
                 blocks[0] if nblk == 1 else jnp.concatenate(blocks, axis=1)

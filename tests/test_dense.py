@@ -134,6 +134,30 @@ def test_dense_no_tables_mode():
         rd.lookup(int(g.initial_state()))
 
 
+def test_dense_fused_rank_matches_simple(monkeypatch):
+    # GAMESMAN_DENSE_RANK=fused is a pure lowering change (one walk for
+    # all moves instead of per-move walks): every table cell must match.
+    g = get_game("connect4:w=3,h=3,connect=3")
+    simple = DenseSolver(g).solve()
+    monkeypatch.setenv("GAMESMAN_DENSE_RANK", "fused")
+    fused = DenseSolver(g).solve()
+    assert (fused.value, fused.remoteness) == (simple.value,
+                                              simple.remoteness)
+    for L, cells in simple.cells.items():
+        np.testing.assert_array_equal(fused.cells[L], cells)
+    # And on a rectangular 5-column board (p1/p2 parity + wider fan-out),
+    # level tables again identical.
+    g2 = get_game("connect4:w=5,h=2")
+    f2 = DenseSolver(g2).solve()
+    monkeypatch.delenv("GAMESMAN_DENSE_RANK")
+    s2 = DenseSolver(g2).solve()
+    assert (f2.value, f2.remoteness, f2.num_positions) == (
+        s2.value, s2.remoteness, s2.num_positions
+    )
+    for L, cells in s2.cells.items():
+        np.testing.assert_array_equal(f2.cells[L], cells)
+
+
 def test_dense_blocked_levels_match_unblocked():
     # Tiny block_elems forces nblk > 1 on every non-trivial level,
     # exercising the block concat + tail-slice path end to end.
