@@ -788,6 +788,15 @@ class DenseSolver:
         self.block_elems = block_elems or int(
             os.environ.get("GAMESMAN_DENSE_BLOCK", str(64 * 1024 * 1024))
         )
+        # Async run-ahead control: the level loop enqueues without syncing
+        # (the relay charges ~65 ms per host sync), so on big boards the
+        # host can enqueue every level's buffers before any kernel
+        # retires — the classic engine OOM'd exactly this way in round 2.
+        # Levels bigger than this many cells drain with a 1-byte fetch.
+        self.sync_cells = int(
+            os.environ.get("GAMESMAN_DENSE_SYNC_CELLS",
+                           str(256 * 1024 * 1024))
+        )
         # Binom lookup lowering: the one-hot select tree is bounded VPU
         # work (K-1 selects, K <= 23); take_along_axis emits a gather,
         # and XLA's TPU gathers measured ~11 ns/element (tools/microbench)
@@ -979,6 +988,7 @@ class DenseSolver:
         nc = t.ncells
         self.schedule_compiles(reach_first=True)
         reach_flat = jnp.ones((1,), jnp.uint8)  # level 0: the root
+        undrained = 0  # cells enqueued since the last drain (see __init__)
         counts_dev: Dict[int, jnp.ndarray] = {}
         for L in range(1, nc + 1):
             cblock, nblk = self._cblock(L)
@@ -1002,6 +1012,10 @@ class DenseSolver:
             if nblk * cblock != C:
                 level_reach = level_reach[:, :C]
             reach_flat = level_reach.reshape(-1)
+            undrained += len(t.profiles[L]) * C
+            if undrained > self.sync_cells:
+                np.asarray(reach_flat[:1])  # drain run-ahead (see __init__)
+                undrained = 0
             counts_dev[L] = cnt
         counts = {0: 1}
         counts.update({L: int(v) for L, v in counts_dev.items()})
@@ -1020,6 +1034,7 @@ class DenseSolver:
             {} if self.store_tables else None
         )
         child_flat = jnp.zeros((1,), jnp.uint8)  # dummy for the top level
+        undrained = 0  # cells enqueued since the last drain (see __init__)
         for L in range(nc, -1, -1):
             P = len(t.profiles[L])
             C = t.class_size[L]
@@ -1042,6 +1057,10 @@ class DenseSolver:
             if nblk * cblock != C:
                 level_cells = level_cells[:, :C]
             child_flat = level_cells.reshape(-1)
+            undrained += P * C
+            if undrained > self.sync_cells:
+                np.asarray(child_flat[:1])  # drain run-ahead (see __init__)
+                undrained = 0
             if self.logger is not None:
                 self.logger.log({
                     "phase": "dense_backward", "level": L, "classes": P,
