@@ -231,6 +231,15 @@ class DenseTables:
                         key = list(prof[p])
                         key[c] += 1
                         move_row[p, c] = nxt[tuple(int(v) for v in key)]
+        # move_row[:, c] is STRICTLY increasing over valid rows: profiles
+        # are lexicographic and adding e_c to two profiles preserves their
+        # lex order. With ranks monotone per row, the flat child index
+        # vector is globally non-decreasing once invalid rows are filled
+        # with the previous valid row's LAST slot — which lets the gather
+        # carry XLA's indices_are_sorted hint (GAMESMAN_DENSE_GATHER).
+        move_fill = np.maximum.accumulate(
+            np.where(valid, move_row, -1), axis=0
+        ).astype(np.int32)
 
         # Unmove: the parent one ply earlier, per column (for the
         # reachability sweep). parent_row[p, c] = -1 when column c is empty.
@@ -278,6 +287,7 @@ class DenseTables:
             "topstone": topstone.astype(dt),
             "valid": valid,
             "move_row": move_row,
+            "move_fill": move_fill,
             "parent_row": parent_row,
             "cellidx": cellidx,
             "child_cellidx": child_cellidx,
@@ -535,15 +545,21 @@ def _rank_all_moves_fused(bits, binom, cellidx, snapk, bitpos, rank_dtype,
 
 def build_dense_step(tables: DenseTables, level: int, cblock: int,
                      rank_dtype, flat_dtype, use_onehot: bool,
-                     fused_rank: bool = False):
+                     fused_rank: bool = False,
+                     sorted_gather: bool = False):
     """Build the backward step for one level at one block width.
 
     Returned fn:
       (rank0 i32, child_cells [flat] u8 (dummy at the top level),
        binom [ncells+1, K], cellidx [ncells, P] i32, filled [P],
        newbit [P, w], valid [P, w] bool, move_row [P, w] i32,
-       child_cellidx [ncells, P, w] i32, snapk [ncells, P] i32)
+       move_fill [P, w] i32, child_cellidx [ncells, P, w] i32,
+       snapk [ncells, P] i32)
       -> cells [P, cblock] u8
+
+    sorted_gather replaces invalid rows' flat indices with a monotone fill
+    and gathers with indices_are_sorted=True (see level_consts move_fill)
+    — a lowering hint; results are identical either way.
 
     fused_rank picks the single-walk child ranking
     (_rank_all_moves_fused) over the per-move walks; results are
@@ -556,6 +572,7 @@ def build_dense_step(tables: DenseTables, level: int, cblock: int,
     ncells = tables.ncells
     dt = jnp.uint64 if tables.bits_dtype == np.uint64 else jnp.uint32
     n1 = n1_of_level(level)
+    C = tables.class_size[level]
     Cc = tables.class_size[level + 1] if level < ncells else 1
     is_top = level == ncells
     p1_moves = level % 2 == 0   # the player moving OUT of this level
@@ -563,7 +580,7 @@ def build_dense_step(tables: DenseTables, level: int, cblock: int,
     bitpos = [int(b) for b in tables.bitpos]
 
     def step(rank0, child_cells, binom, cellidx, filled, newbit,
-             valid, move_row, child_cellidx, snapk):
+             valid, move_row, move_fill, child_cellidx, snapk):
         P = filled.shape[0]
         ranks = (rank0.astype(rank_dtype)
                  + jax.lax.iota(rank_dtype, cblock)[None, :])  # [1, cb]
@@ -605,7 +622,31 @@ def build_dense_step(tables: DenseTables, level: int, cblock: int,
             flat = (move_row[:, c : c + 1].astype(flat_dtype)
                     * flat_dtype(Cc) + crank.astype(flat_dtype))
             ok = valid[:, c : c + 1] & jnp.ones((1, cblock), bool)
-            cell = child_cells[jnp.clip(flat, 0, child_cells.shape[0] - 1)]
+            if sorted_gather:
+                # Invalid rows and pad lanes (rank >= C in the last block,
+                # whose unranked bits are garbage) get a monotone fill —
+                # invalid rows the previous valid row's LAST slot (or 0
+                # before any valid row), pad lanes their own row's last
+                # slot — keeping the flat vector globally non-decreasing
+                # so the gather may stream instead of scattering reads.
+                in_range = ranks < rank_dtype(C)  # [1, cb]
+                fillr = jnp.where(
+                    valid[:, c : c + 1],
+                    move_row[:, c : c + 1],
+                    move_fill[:, c : c + 1],
+                ).astype(flat_dtype)
+                fill = jnp.where(
+                    fillr < 0, flat_dtype(0),
+                    fillr * flat_dtype(Cc) + flat_dtype(Cc - 1),
+                )
+                flat = jnp.where(ok & in_range, flat, fill)
+                cell = child_cells.at[flat.reshape(-1)].get(
+                    indices_are_sorted=True, mode="clip"
+                ).reshape(flat.shape)
+            else:
+                cell = child_cells[
+                    jnp.clip(flat, 0, child_cells.shape[0] - 1)
+                ]
             child_vals.append(cell & jnp.uint8(3))
             child_rems.append((cell >> jnp.uint8(2)).astype(jnp.int32))
             masks.append(ok)
@@ -630,12 +671,12 @@ def build_dense_step(tables: DenseTables, level: int, cblock: int,
 
 def build_reach_step(tables: DenseTables, level: int, cblock: int,
                      rank_dtype, flat_dtype, use_onehot: bool,
-                     fused_rank: bool = False):
+                     fused_rank: bool = False, sorted_gather: bool = False):
     """Build the reachability-sweep step for one level (level >= 1).
 
-    fused_rank is accepted for builder-signature uniformity and ignored:
-    the sweep's one-rank-per-column walk has no per-move fan-out to fuse
-    (each column ranks a DIFFERENT parent bit pattern).
+    fused_rank/sorted_gather are accepted for builder-signature uniformity
+    and ignored: the sweep's one-rank-per-column walk has no per-move
+    fan-out to fuse (each column ranks a DIFFERENT parent bit pattern).
 
     reach(y) = OR over columns c of y's class: the top stone of column c
     belongs to the player who made ply `level` AND the position with that
@@ -813,6 +854,12 @@ class DenseSolver:
         self.use_fused = os.environ.get(
             "GAMESMAN_DENSE_RANK", "simple"
         ) == "fused"
+        # Gather lowering: "sorted" fills invalid/pad lanes monotonically
+        # and passes indices_are_sorted to XLA. Identical results (tests
+        # pin it); default plain until the chip measures both.
+        self.use_sorted_gather = os.environ.get(
+            "GAMESMAN_DENSE_GATHER", "plain"
+        ) == "sorted"
         nc = self.tables.ncells
         max_class = max(self.tables.class_size)
         self._rank_dtype = (jnp.uint32 if max_class < (1 << 31)
@@ -829,12 +876,13 @@ class DenseSolver:
         return (g.width, g.height, g.connect)
 
     def _kernel(self, kind: str, level: int, cblock: int, builder):
-        t, rd, fd, oh, fr = (self.tables, self._rank_dtype,
-                             self._flat_dtype, self.use_onehot,
-                             self.use_fused)
+        t, rd, fd, oh, fr, sg = (self.tables, self._rank_dtype,
+                                 self._flat_dtype, self.use_onehot,
+                                 self.use_fused, self.use_sorted_gather)
         return get_kernel(
             self.game, kind, self._kernel_key(kind, level, cblock),
-            lambda g: builder(t, level, cblock, rd, fd, oh, fused_rank=fr),
+            lambda g: builder(t, level, cblock, rd, fd, oh, fused_rank=fr,
+                              sorted_gather=sg),
         )
 
     def _cblock(self, level: int) -> tuple[int, int]:
@@ -874,17 +922,19 @@ class DenseSolver:
             sds((P, w), dt),              # newbit
             sds((P, w), np.bool_),        # valid
             sds((P, w), np.int32),        # move_row
+            sds((P, w), np.int32),        # move_fill
             sds((t.ncells, P, w), np.int32),  # child_cellidx
             sds((t.ncells, P), np.int32),     # snapk
         )
 
     def _kernel_key(self, kind: str, level: int, cblock: int):
-        # use_fused only changes dense_step lowering; keying it into the
-        # reach kernels would recompile byte-identical programs on a flag
-        # flip (seconds each over the relay).
+        # use_fused/use_sorted_gather only change dense_step lowering;
+        # keying them into the reach kernels would recompile byte-identical
+        # programs on a flag flip (seconds each over the relay).
         fused = self.use_fused if kind == "dense_step" else False
+        sg = self.use_sorted_gather if kind == "dense_step" else False
         return (
-            kind, level, cblock, self.use_onehot, fused,
+            kind, level, cblock, self.use_onehot, fused, sg,
             str(self._rank_dtype), str(self._flat_dtype),
         )
 
@@ -903,13 +953,14 @@ class DenseSolver:
         def sched(kind, level, builder, for_reach):
             cblock, _ = self._cblock(level)
             key = self._kernel_key(kind, level, cblock)
-            rd, fd, oh, fr = (self._rank_dtype, self._flat_dtype,
-                              self.use_onehot, self.use_fused)
+            rd, fd, oh, fr, sg = (self._rank_dtype, self._flat_dtype,
+                                  self.use_onehot, self.use_fused,
+                                  self.use_sorted_gather)
             P = len(t.profiles[level])
             schedule_kernel(
                 self.game, kind, key,
                 lambda g: builder(t, level, cblock, rd, fd, oh,
-                                  fused_rank=fr),
+                                  fused_rank=fr, sorted_gather=sg),
                 self._avals(level, cblock, for_reach),
                 heavy=P * cblock * 8 > (512 << 20),
             )
@@ -969,6 +1020,7 @@ class DenseSolver:
                 newbit=jnp.asarray(consts["newbit"]),
                 valid=jnp.asarray(consts["valid"]),
                 move_row=jnp.asarray(consts["move_row"]),
+                move_fill=jnp.asarray(consts["move_fill"]),
                 child_cellidx=jnp.asarray(
                     steps_first(consts["child_cellidx"])
                 ),
@@ -1048,8 +1100,8 @@ class DenseSolver:
                     jnp.int32(b * cblock), child_flat,
                     consts["binom"], consts["cellidx"], consts["filled"],
                     consts["newbit"], consts["valid"],
-                    consts["move_row"], consts["child_cellidx"],
-                    consts["snapk"],
+                    consts["move_row"], consts["move_fill"],
+                    consts["child_cellidx"], consts["snapk"],
                 ))
             level_cells = (
                 blocks[0] if nblk == 1 else jnp.concatenate(blocks, axis=1)

@@ -158,6 +158,26 @@ def test_dense_fused_rank_matches_simple(monkeypatch):
         np.testing.assert_array_equal(f2.cells[L], cells)
 
 
+def test_dense_sorted_gather_matches_plain(monkeypatch):
+    # GAMESMAN_DENSE_GATHER=sorted is a lowering hint (monotone fill for
+    # invalid rows + pad lanes, indices_are_sorted gather): every cell of
+    # every level table must match the plain gather, including with
+    # blocking forced (pad lanes in every tail block).
+    g = get_game("connect4:w=5,h=2")
+    plain = DenseSolver(g).solve()
+    monkeypatch.setenv("GAMESMAN_DENSE_GATHER", "sorted")
+    srt = DenseSolver(g).solve()
+    blocked = DenseSolver(g, block_elems=64).solve()
+    # Both lowering flags together compose into a distinct program — the
+    # combination a chip measurement run would plausibly enable.
+    monkeypatch.setenv("GAMESMAN_DENSE_RANK", "fused")
+    both = DenseSolver(g).solve()
+    for L, cells in plain.cells.items():
+        np.testing.assert_array_equal(srt.cells[L], cells)
+        np.testing.assert_array_equal(blocked.cells[L], cells)
+        np.testing.assert_array_equal(both.cells[L], cells)
+
+
 def test_dense_blocked_levels_match_unblocked():
     # Tiny block_elems forces nblk > 1 on every non-trivial level,
     # exercising the block concat + tail-slice path end to end.
