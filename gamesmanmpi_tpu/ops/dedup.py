@@ -11,12 +11,12 @@ import jax.numpy as jnp
 
 from gamesmanmpi_tpu.core.bitops import sentinel_for
 # sort1 dispatches to XLA's sort network, or to the merge ladder under
-# GAMESMAN_SORT=merge. The flag is read at trace time — set it before the
-# process builds any kernels; the kernel cache does not key on it.
+# GAMESMAN_SORT=merge (resolved at build time by kernel builders — see
+# sort1's docstring; engine.get_kernel keys its cache on the flag).
 from gamesmanmpi_tpu.ops.mergesort import sort1 as _sort
 
 
-def sort_unique(states):
+def sort_unique(states, merge: bool | None = None):
     """Sort states, drop duplicates/sentinels, compact to the front.
 
     Input: [N] uint32/uint64 (may contain SENTINEL padding of the same dtype).
@@ -32,9 +32,9 @@ def sort_unique(states):
     kernel on the happy path.
     """
     sentinel = sentinel_for(states.dtype)
-    s = _sort(states)
+    s = _sort(states, merge)
     first = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
     keep = first & (s != sentinel)
-    out = _sort(jnp.where(keep, s, sentinel))
+    out = _sort(jnp.where(keep, s, sentinel), merge)
     count = jnp.sum(keep).astype(jnp.int32)
     return out, count

@@ -38,6 +38,21 @@ def use_merge_sort() -> bool:
     return os.environ.get("GAMESMAN_SORT", "xla") == "merge"
 
 
+def backend_key():
+    """Cache-key element describing the resolved sort backend.
+
+    Includes the row width when the merge backend is active: it is read at
+    trace time too (see _row_width), so two row settings are two different
+    programs. (A GAMESMAN_SORT_ROW flip between scheduling a background
+    compile and its worker tracing can still race — flip row widths only
+    at process start or with inline-jitted kernels, as tools/microbench2
+    does.)
+    """
+    if not use_merge_sort():
+        return "xla"
+    return ("merge", os.environ.get("GAMESMAN_SORT_ROW", "2048"))
+
+
 def _pay_max(dtype):
     """Largest value of an integer payload dtype (pad marker)."""
     return np.iinfo(np.dtype(dtype)).max
@@ -102,20 +117,27 @@ def _merge_rows(a, b, *payloads_ab):
     return (z, *ps)
 
 
-def sort1(x):
-    """Flag-dispatched key sort (see use_merge_sort)."""
-    if use_merge_sort():
+def sort1(x, merge: bool | None = None):
+    """Flag-dispatched key sort.
+
+    merge=None reads the env flag AT TRACE TIME — fine for direct/eager
+    callers. Kernel builders must instead resolve use_merge_sort() at
+    BUILD time and pass it explicitly: background precompile workers trace
+    later, and an ambient read there could disagree with the cache key
+    sampled when the kernel was scheduled.
+    """
+    if use_merge_sort() if merge is None else merge:
         return merge_sort(x)
     return jnp.sort(x)
 
 
-def sort_with_payload(keys, payload):
-    """Flag-dispatched (keys, payload) sort by keys.
+def sort_with_payload(keys, payload, merge: bool | None = None):
+    """Flag-dispatched (keys, payload) sort by keys (see sort1 re: merge).
 
     Integer payload only; with the merge backend, signed non-negative keys
     are viewed as unsigned (order-preserving) so sentinel padding works.
     """
-    if not use_merge_sort():
+    if not (use_merge_sort() if merge is None else merge):
         import jax
 
         return jax.lax.sort((keys, payload), num_keys=1, is_stable=False)

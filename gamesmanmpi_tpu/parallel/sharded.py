@@ -75,6 +75,7 @@ from gamesmanmpi_tpu.core.values import UNDECIDED
 from gamesmanmpi_tpu.games.base import TensorGame
 from gamesmanmpi_tpu.ops.combine import combine_children
 from gamesmanmpi_tpu.ops.dedup import sort_unique
+from gamesmanmpi_tpu.ops.mergesort import use_merge_sort
 from gamesmanmpi_tpu.ops.lookup import lookup_sorted, lookup_window
 from gamesmanmpi_tpu.ops.padding import bucket_size
 from gamesmanmpi_tpu.parallel.mesh import AXIS, make_mesh
@@ -149,7 +150,8 @@ def _route_by_owner(flat, S: int, cap_out: int, sentinel):
     return send, counts, s_owner, pos, order
 
 
-def _sharded_forward_step(game: TensorGame, S: int, route_cap: int, local):
+def _sharded_forward_step(game: TensorGame, S: int, route_cap: int, local,
+                          merge: bool | None = None):
     """Per-shard forward body: expand -> owner-bucket -> all_to_all -> dedup.
 
     local: [1, cap] this shard's frontier slice (shard_map gives the leading
@@ -169,7 +171,7 @@ def _sharded_forward_step(game: TensorGame, S: int, route_cap: int, local):
     send, counts, _, _, _ = _route_by_owner(flat, S, route_cap, sentinel)
     routed = jax.lax.all_to_all(send, AXIS, split_axis=0, concat_axis=0,
                                 tiled=True)
-    uniq, count = sort_unique(routed.reshape(-1))
+    uniq, count = sort_unique(routed.reshape(-1), merge)
     all_counts = jax.lax.all_gather(count, AXIS)  # [S] replicated
     all_sends = jax.lax.all_gather(counts, AXIS)  # [S, S] replicated
     return uniq[None], all_counts, all_sends
@@ -454,8 +456,10 @@ class ShardedSolver:
         mesh, S = self.mesh, self.S
 
         def build(game):
+            mb = use_merge_sort()  # resolved at cache-key time
+
             def per_shard(local):
-                return _sharded_forward_step(game, S, route_cap, local)
+                return _sharded_forward_step(game, S, route_cap, local, mb)
 
             return jax.shard_map(
                 per_shard,
@@ -629,13 +633,15 @@ class ShardedSolver:
         mesh = self.mesh
 
         def build(game):
+            mb = use_merge_sort()  # resolved at cache-key time
+
             def per_shard(pool, kids, target):
                 p, c = pool[0], kids[0]
                 lv = jnp.where(
                     c != game.sentinel, game.level_of(c), -1
                 )
                 sel = jnp.where(lv == target[0], c, game.sentinel)
-                uniq, count = sort_unique(jnp.concatenate([p, sel]))
+                uniq, count = sort_unique(jnp.concatenate([p, sel]), mb)
                 return uniq[None], jax.lax.all_gather(count, AXIS)
 
             return jax.shard_map(

@@ -72,7 +72,12 @@ from gamesmanmpi_tpu.core.values import UNDECIDED
 from gamesmanmpi_tpu.games.base import TensorGame
 from gamesmanmpi_tpu.ops.combine import combine_children
 from gamesmanmpi_tpu.ops.dedup import sort_unique
-from gamesmanmpi_tpu.ops.mergesort import sort1, sort_with_payload
+from gamesmanmpi_tpu.ops.mergesort import (
+    backend_key,
+    sort1,
+    sort_with_payload,
+    use_merge_sort,
+)
 from gamesmanmpi_tpu.ops.lookup import lookup_window
 from gamesmanmpi_tpu.ops.padding import MIN_BUCKET, bucket_size, pad_to, pad_to_bucket
 from gamesmanmpi_tpu.solve.precompile import global_precompiler, sds
@@ -139,7 +144,11 @@ def get_kernel(game: TensorGame, kind: str, shape_key, builder):
     # can't be compared) carry their own cache dict, so their kernels are
     # garbage-collected with the game instead of pinning it process-wide.
     cache = getattr(game, "_private_kernel_cache", _KERNELS)
-    key = (game.cache_key, kind, shape_key)
+    # The sort backend (GAMESMAN_SORT / GAMESMAN_SORT_ROW) is resolved at
+    # build time by the kernel builders; keying it here keeps a
+    # mid-process flag flip from reusing kernels traced under the other
+    # backend (and lets tests exercise both for real).
+    key = (game.cache_key, kind, shape_key, backend_key())
     fn = cache.get(key)
     if fn is None:
         # A background compile scheduled for this key wins over inline jit:
@@ -169,7 +178,7 @@ def schedule_kernel(game: TensorGame, kind: str, shape_key, builder, avals,
         # process-wide precompiler would pin the instance via its future.
         return
     cache = _KERNELS
-    key = (game.cache_key, kind, shape_key)
+    key = (game.cache_key, kind, shape_key, backend_key())
     if key in cache:
         return
     pre = global_precompiler()
@@ -234,13 +243,16 @@ def canonical_children(game: TensorGame, states, active):
     return children, mask
 
 
-def expand_core(game: TensorGame, states):
-    """Shared expand+mask+dedup: [B] -> (uniq [B*M] sorted, count)."""
+def expand_core(game: TensorGame, states, merge: bool | None = None):
+    """Shared expand+mask+dedup: [B] -> (uniq [B*M] sorted, count).
+
+    merge: sort-backend flag, resolved at BUILD time by kernel builders
+    (None = read the env at trace time; see ops.mergesort.sort1)."""
     children, _ = canonical_children(game, states, undecided_mask(game, states))
-    return sort_unique(children.reshape(-1))
+    return sort_unique(children.reshape(-1), merge)
 
 
-def expand_provenance(game: TensorGame, states):
+def expand_provenance(game: TensorGame, states, merge: bool | None = None):
     """Forward expand that also keeps the dedup sort's provenance.
 
     Returns (uniq [B*M], count, uidx [B*M] int32, prim [B] uint8):
@@ -262,15 +274,15 @@ def expand_provenance(game: TensorGame, states):
     origin = jax.lax.iota(jnp.int32, flat.shape[0])
     # Sorts dispatch through ops.mergesort: XLA's network by default, the
     # elementwise merge ladder under GAMESMAN_SORT=merge.
-    s, o = sort_with_payload(flat, origin)
+    s, o = sort_with_payload(flat, origin, merge)
     first = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
     keep = first & (s != game.sentinel)
     # Every slot in a duplicate run shares the survivor's unique-index
     # (cumsum over run-first markers is constant within the run).
     uid = jnp.cumsum(keep.astype(jnp.int32)) - 1
     uid = jnp.where(s != game.sentinel, uid, -1)
-    _, uidx = sort_with_payload(o, uid)
-    uniq = sort1(jnp.where(keep, s, game.sentinel))
+    _, uidx = sort_with_payload(o, uid, merge)
+    uniq = sort1(jnp.where(keep, s, game.sentinel), merge)
     count = jnp.sum(keep).astype(jnp.int32)
     return uniq, count, uidx, prim
 
@@ -305,9 +317,9 @@ def resolve_provenance(n, prim, uidx, wvals, wrem, max_moves: int):
     return values, remoteness, misses
 
 
-def expand_with_levels(game: TensorGame, states):
+def expand_with_levels(game: TensorGame, states, merge: bool | None = None):
     """Generic-path forward: expand_core + each child's topological level."""
-    uniq, count = expand_core(game, states)
+    uniq, count = expand_core(game, states, merge)
     levels = jnp.where(uniq != game.sentinel, game.level_of(uniq), -1)
     return uniq, levels, count
 
@@ -463,7 +475,12 @@ class Solver:
 
     @staticmethod
     def _fwdp_builder(game):
-        return lambda states: expand_provenance(game, states)
+        # Builders run at cache-key time (inside get_kernel/
+        # schedule_kernel), so resolving the sort backend HERE keeps the
+        # traced program consistent with the key even when a background
+        # worker traces it later.
+        mb = use_merge_sort()
+        return lambda states: expand_provenance(game, states, mb)
 
     @staticmethod
     def _bwd_builder(game):
@@ -493,10 +510,11 @@ class Solver:
         return get_kernel(self.game, "bwdp", (cap, wcap), self._bwdp_builder)
 
     def _fwd_generic(self, cap: int):
-        return get_kernel(
-            self.game, "fwdg", cap,
-            lambda game: lambda states: expand_with_levels(game, states),
-        )
+        def build(game):
+            mb = use_merge_sort()  # resolved at cache-key time
+            return lambda states: expand_with_levels(game, states, mb)
+
+        return get_kernel(self.game, "fwdg", cap, build)
 
     def _bwd(self, cap: int, wcaps: tuple):
         """Backward: states[cap] + window levels -> (values, rem, misses).
