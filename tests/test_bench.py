@@ -1,0 +1,68 @@
+"""bench.py contract tests (subprocess): the driver consumes its stdout.
+
+The benchmark must ALWAYS print exactly one JSON line on stdout with the
+fields the driver records (metric/value/device/engine/fallback_cpu), on
+the happy path and through the failure ladders (dense engine failure ->
+classic demotion on the same platform). These run the real script on the
+tiny 3x3 connect-3 board, CPU-pinned.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_BENCH = os.path.join(_REPO, "bench.py")
+
+
+def _run_bench(extra_env, timeout=600):
+    env = dict(os.environ)
+    # Isolate from the suite's 8-device faking: conftest put the device-
+    # count flag into XLA_FLAGS, which the child would inherit; a real
+    # bench invocation runs single-device.
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    env.update(
+        GAMESMAN_PLATFORM="cpu",
+        BENCH_GAME="connect4:w=3,h=3,connect=3",
+        BENCH_SYM="0",
+        BENCH_LADDER="0",
+        BENCH_REPEATS="1",
+    )
+    env.update(extra_env)
+    proc = subprocess.run(
+        [sys.executable, _BENCH], capture_output=True, text=True,
+        timeout=timeout, env=env, cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line: {lines}"
+    return json.loads(lines[0]), proc.stderr
+
+
+@pytest.mark.slow
+def test_bench_dense_happy_path():
+    record, _ = _run_bench({"BENCH_ENGINE": "dense"})
+    assert record["engine"] == "dense"
+    assert record["positions"] == 694  # exact reachable count
+    assert record["device"] == "cpu"
+    assert record["fallback_cpu"] is False  # deliberate CPU pin, not a fallback
+    assert record["value"] > 0
+
+
+@pytest.mark.slow
+def test_bench_demotes_to_classic_when_dense_breaks():
+    # A malformed dense-only knob breaks DenseSolver's constructor; the
+    # bench must demote to the classic engine on the same platform and
+    # still publish a valid record.
+    record, stderr = _run_bench(
+        {"BENCH_ENGINE": "dense", "GAMESMAN_DENSE_BLOCK": "not-a-number"}
+    )
+    assert record["engine"] == "classic"
+    assert record["positions"] == 694
+    assert "demoting to the classic engine" in stderr
