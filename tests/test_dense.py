@@ -71,6 +71,11 @@ def test_dense_parity_4x4():
     assert (rd.value, rd.remoteness) == (rc.value, rc.remoteness)
     # 161,029 is Tromp's published 4x4 legal-position count.
     assert rd.num_positions == rc.num_positions == 161029
+    # Per-LEVEL reachable counts must match BFS discovery exactly, not
+    # just the total (a compensating over/undercount pair would pass the
+    # sum).
+    for L, n in rd.stats["reachable_per_level"].items():
+        assert n == rc.levels[L].states.shape[0], (L, n)
     rng = np.random.default_rng(7)
     for _, tab in rc.levels.items():
         n = tab.states.shape[0]
