@@ -21,6 +21,18 @@ Correctness notes: inputs are padded to a power-of-two length with the
 all-ones sentinel (which sorts last, matching the engines' padding
 convention); merging keys with an i32 payload uses compare-on-key
 exchanges of both arrays.
+
+Known limitation (2026-07-30): with GAMESMAN_SORT=merge set for an entire
+test-suite process, XLA's CPU compiler segfaulted twice, reproducibly,
+while compiling an UNRELATED backend-independent kernel late in the run
+(tests/test_symmetry.py chomp case; the same test passes in isolation and
+the whole suite passes under the default backend). The merge ladder's
+unrolled stage chain produces much larger HLO than lax.sort, and
+compiling many of those earlier in the process appears to leave the CPU
+compiler in a state where a later compile crashes — an upstream stress
+bug, not a correctness issue (every equivalence test passes). Treat
+GAMESMAN_SORT=merge as a per-process experimental flag; the default
+stays "xla" until the chip measurement decides (docs/CHIP_PLAN.md).
 """
 
 from __future__ import annotations

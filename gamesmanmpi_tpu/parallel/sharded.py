@@ -470,7 +470,8 @@ class ShardedSolver:
             )
 
         return get_kernel(
-            self.game, "sfwd", (self._mesh_key, cap, route_cap), build
+            self.game, "sfwd", (self._mesh_key, cap, route_cap), build,
+            sort_backend=True,
         )
 
     def _resize_fn(self, in_cap: int, out_cap: int):
@@ -653,7 +654,8 @@ class ShardedSolver:
             )
 
         return get_kernel(
-            self.game, "smrg", (self._mesh_key, pool_cap, child_cap), build
+            self.game, "smrg", (self._mesh_key, pool_cap, child_cap), build,
+            sort_backend=True
         )
 
     def _level_check_fn(self, cap: int):

@@ -139,16 +139,28 @@ class SolverError(RuntimeError):
 _KERNELS: dict = {}
 
 
-def get_kernel(game: TensorGame, kind: str, shape_key, builder):
+def _cache_key(game: TensorGame, kind: str, shape_key, sort_backend: bool):
+    """Cache key for a kernel. Builders whose programs contain
+    backend-dispatched sorts (dedup / provenance) declare it with
+    sort_backend=True at their get_kernel/schedule_kernel call site — the
+    key then carries the backend (GAMESMAN_SORT / GAMESMAN_SORT_ROW)
+    resolved at build time, so a mid-process flag flip cannot reuse
+    kernels traced under the other backend. Backend-free kinds omit it:
+    keying every kind would recompile byte-identical lookup/combine
+    kernels on a flag flip (the doubled compile load stress-crashed XLA's
+    CPU compiler once in a full-suite run)."""
+    if sort_backend:
+        return (game.cache_key, kind, shape_key, backend_key())
+    return (game.cache_key, kind, shape_key)
+
+
+def get_kernel(game: TensorGame, kind: str, shape_key, builder,
+               sort_backend: bool = False):
     # Games whose identity is per-instance (TensorizedModule: host callbacks
     # can't be compared) carry their own cache dict, so their kernels are
     # garbage-collected with the game instead of pinning it process-wide.
     cache = getattr(game, "_private_kernel_cache", _KERNELS)
-    # The sort backend (GAMESMAN_SORT / GAMESMAN_SORT_ROW) is resolved at
-    # build time by the kernel builders; keying it here keeps a
-    # mid-process flag flip from reusing kernels traced under the other
-    # backend (and lets tests exercise both for real).
-    key = (game.cache_key, kind, shape_key, backend_key())
+    key = _cache_key(game, kind, shape_key, sort_backend)
     fn = cache.get(key)
     if fn is None:
         # A background compile scheduled for this key wins over inline jit:
@@ -164,7 +176,7 @@ def get_kernel(game: TensorGame, kind: str, shape_key, builder):
 
 
 def schedule_kernel(game: TensorGame, kind: str, shape_key, builder, avals,
-                    heavy: bool = False):
+                    heavy: bool = False, sort_backend: bool = False):
     """Queue a background compile of a kernel (idempotent, never blocks).
 
     avals must match the call signature get_kernel's users will invoke the
@@ -178,7 +190,7 @@ def schedule_kernel(game: TensorGame, kind: str, shape_key, builder, avals,
         # process-wide precompiler would pin the instance via its future.
         return
     cache = _KERNELS
-    key = (game.cache_key, kind, shape_key, backend_key())
+    key = _cache_key(game, kind, shape_key, sort_backend)
     if key in cache:
         return
     pre = global_precompiler()
@@ -502,7 +514,8 @@ class Solver:
 
     def _fwdp(self, cap: int):
         """Provenance forward: states[cap] -> (uniq, count, uidx, prim)."""
-        return get_kernel(self.game, "fwdp", cap, self._fwdp_builder)
+        return get_kernel(self.game, "fwdp", cap, self._fwdp_builder,
+                          sort_backend=True)
 
     def _bwdp(self, cap: int, wcap: int):
         """Provenance backward: (n, prim[cap], uidx[cap*M], wvals[wcap],
@@ -514,7 +527,8 @@ class Solver:
             mb = use_merge_sort()  # resolved at cache-key time
             return lambda states: expand_with_levels(game, states, mb)
 
-        return get_kernel(self.game, "fwdg", cap, build)
+        return get_kernel(self.game, "fwdg", cap, build,
+                          sort_backend=True)
 
     def _bwd(self, cap: int, wcaps: tuple):
         """Backward: states[cap] + window levels -> (values, rem, misses).
@@ -568,7 +582,7 @@ class Solver:
         schedule_kernel(
             self.game, "fwdp", cap, self._fwdp_builder,
             (sds((cap,), self.game.state_dtype),),
-            heavy=self._heavy(cap),
+            heavy=self._heavy(cap), sort_backend=True,
         )
 
     def _sched_bwdp(self, cap: int, wcap: int) -> None:

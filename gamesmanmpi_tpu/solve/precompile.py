@@ -73,18 +73,20 @@ class Precompiler:
         self._q: "queue.Queue" = queue.Queue()
         self._futures: Dict[Hashable, Future] = {}
         self._lock = threading.Lock()
-        self._threads_started = False
+        self._threads: list = []
+        self._closed = False
         self._heavy_sem = threading.Semaphore(_heavy_slots())
 
     def _ensure_threads(self) -> None:
-        if self._threads_started:
+        # Caller holds self._lock (schedule does).
+        if self._threads or self._closed:
             return
-        self._threads_started = True
         for i in range(_workers()):
             t = threading.Thread(
                 target=self._worker, name=f"gm-compile-{i}", daemon=True
             )
             t.start()
+            self._threads.append(t)
 
     @staticmethod
     def _transient(e: Exception) -> bool:
@@ -100,7 +102,15 @@ class Precompiler:
     def _worker(self) -> None:
         while True:
             item = self._q.get()
+            if item is None:  # close() sentinel
+                return
             fut, fn, avals, heavy = item
+            if self._closed:
+                # Cancelled by close(); resolve the future so a blocking
+                # get() can never hang on a dead pool (this also covers a
+                # heavy job requeued behind the close sentinels).
+                fut.set_exception(RuntimeError("precompiler closed"))
+                continue
             if heavy and not self._heavy_sem.acquire(blocking=False):
                 # No heavy slot free: requeue and stay available for light
                 # jobs — heavy work must never park the whole pool.
@@ -141,7 +151,7 @@ class Precompiler:
         _heavy_slots).
         """
         with self._lock:
-            if key in self._futures:
+            if key in self._futures or self._closed:
                 return
             self._ensure_threads()
             fut = Future()
@@ -175,6 +185,28 @@ class Precompiler:
     def scheduled(self, key: Hashable) -> bool:
         with self._lock:
             return key in self._futures
+
+    def close(self) -> None:
+        """Stop the worker threads; jobs not yet running are cancelled
+        (their futures resolve with an exception, so blocking get()s
+        return None instead of hanging). The instance stays closed:
+        schedule() becomes a no-op and get() reports the cancellations.
+
+        The process-wide singleton never needs this (daemon threads die
+        with the process); standalone instances — tests construct several —
+        must close, or each leaks its worker pool for the process
+        lifetime (a full-suite run accumulated 30+ idle compile threads
+        this way).
+        """
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            n = len(self._threads)
+        # One sentinel per STARTED thread (the env-derived _workers() can
+        # have changed since the pool started).
+        for _ in range(n):
+            self._q.put(None)
 
 
 _GLOBAL: Precompiler | None = None

@@ -50,8 +50,25 @@ class _StubFn:
         return _StubLowered(self.result)
 
 
-def test_schedule_is_idempotent_and_get_evicts():
-    pre = Precompiler()
+@pytest.fixture
+def make_pre():
+    """Construct Precompilers that are CLOSED at test end — each instance
+    spawns a worker pool, and un-closed test instances leaked 30+ idle
+    threads into the rest of the suite."""
+    made = []
+
+    def factory():
+        pre = Precompiler()
+        made.append(pre)
+        return pre
+
+    yield factory
+    for pre in made:
+        pre.close()
+
+
+def test_schedule_is_idempotent_and_get_evicts(make_pre):
+    pre = make_pre()
     fn = _StubFn(result="exe1")
     pre.schedule("k", fn, ())
     pre.schedule("k", fn, ())  # duplicate: must not enqueue twice
@@ -63,29 +80,29 @@ def test_schedule_is_idempotent_and_get_evicts():
     assert pre.get("k") is None
 
 
-def test_unscheduled_key_returns_none():
-    pre = Precompiler()
+def test_unscheduled_key_returns_none(make_pre):
+    pre = make_pre()
     assert pre.get("missing") is None
     assert not pre.scheduled("missing")
 
 
-def test_transient_failure_retries_once(monkeypatch):
+def test_transient_failure_retries_once(monkeypatch, make_pre):
     # Patch the backoff so the test doesn't sleep 8 s.
     import gamesmanmpi_tpu.solve.precompile as pc
 
     monkeypatch.setattr(pc.time, "sleep", lambda s: None)
-    pre = Precompiler()
+    pre = make_pre()
     fn = _StubFn(result="exe", fail_first=RuntimeError("HTTP 500: boom"))
     pre.schedule("k", fn, ())
     assert pre.get("k", block=True) == "exe"
     assert fn.calls == 2  # failed once, retried once
 
 
-def test_deterministic_failure_does_not_retry(monkeypatch):
+def test_deterministic_failure_does_not_retry(monkeypatch, make_pre):
     import gamesmanmpi_tpu.solve.precompile as pc
 
     monkeypatch.setattr(pc.time, "sleep", lambda s: None)
-    pre = Precompiler()
+    pre = make_pre()
     fn = _StubFn(fail_always=ValueError("bad shape"))
     pre.schedule("k", fn, ())
     # Failure is swallowed (caller falls back to inline jit) and evicted
@@ -95,14 +112,14 @@ def test_deterministic_failure_does_not_retry(monkeypatch):
     assert not pre.scheduled("k")
 
 
-def test_heavy_jobs_do_not_starve_light_jobs(monkeypatch):
+def test_heavy_jobs_do_not_starve_light_jobs(monkeypatch, make_pre):
     """With every heavy slot busy, queued heavy work must be requeued so
     light compiles keep flowing through the pool."""
     import gamesmanmpi_tpu.solve.precompile as pc
 
     monkeypatch.setenv("GAMESMAN_COMPILE_WORKERS", "2")
     monkeypatch.setenv("GAMESMAN_HEAVY_COMPILES", "1")
-    pre = Precompiler()
+    pre = make_pre()
     slow_heavy = _StubFn(result="h1", delay=1.0)
     pre.schedule("h1", slow_heavy, (), heavy=True)
     pre.schedule("h2", _StubFn(result="h2", delay=1.0), (), heavy=True)
