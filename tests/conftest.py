@@ -14,3 +14,11 @@ run before the first backend use.
 from gamesmanmpi_tpu.utils.platform import force_platform
 
 force_platform("cpu", fake_devices=8)
+
+# The dense engine's cross-process reachable-count cache must not satisfy
+# the parity tests from a previous run's file — counts there must come
+# from a real sweep regardless of the invoking shell's env (the file path
+# itself is covered by a dedicated test, which monkeypatches this).
+import os  # noqa: E402
+
+os.environ["GAMESMAN_DENSE_COUNTS_FILE"] = "0"

@@ -208,6 +208,21 @@ def test_dense_lookup_refuses_garbage_positions():
     assert rd.lookup(int(g.initial_state())) == (rd.value, rd.remoteness)
 
 
+def test_dense_counts_file_roundtrip(tmp_path, monkeypatch):
+    from gamesmanmpi_tpu.solve import dense as dmod
+
+    path = tmp_path / "counts.json"
+    monkeypatch.setenv("GAMESMAN_DENSE_COUNTS_FILE", str(path))
+    key = (3, 3, 3)
+    counts = {0: 1, 1: 3, 2: 12}
+    dmod._store_cached_counts(key, counts)
+    assert dmod._load_cached_counts(key) == counts
+    assert dmod._load_cached_counts((9, 9, 4)) is None
+    # Disabled cache reads/writes nothing.
+    monkeypatch.setenv("GAMESMAN_DENSE_COUNTS_FILE", "0")
+    assert dmod._load_cached_counts(key) is None
+
+
 def test_dense_count_cached_across_instances():
     g = get_game("connect4:w=3,h=3,connect=3")
     a = DenseSolver(g).solve()
