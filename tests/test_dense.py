@@ -123,6 +123,22 @@ def test_device_rank_unrank_match_host_u64_board():
             assert int(back_np[p, i]) == int(ranks[p, i])
 
 
+def test_dense_full_parity_tall_board():
+    # Taller-than-wide: every solve-parity board so far had w >= h; this
+    # pins the (column, row) indexing asymmetry end to end.
+    g = get_game("connect4:w=3,h=4,connect=3")
+    rc = Solver(g).solve()
+    rd = DenseSolver(g).solve()
+    assert (rd.value, rd.remoteness) == (rc.value, rc.remoteness)
+    assert rd.num_positions == rc.num_positions
+    checked = 0
+    for _, tab in rc.levels.items():
+        for s, v, rem in zip(tab.states, tab.values, tab.remoteness):
+            assert rd.lookup(int(s)) == (int(v), int(rem))
+            checked += 1
+    assert checked == rc.num_positions
+
+
 def test_dense_rejects_sym_and_non_connect4():
     with pytest.raises(ValueError):
         DenseSolver(get_game("connect4:w=4,h=4,sym=1"))
