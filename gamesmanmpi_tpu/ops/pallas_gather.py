@@ -1,0 +1,106 @@
+"""Pallas monotone-window gather (round-4 scaffold, interpret-tested).
+
+The dense engine's backward step is, per move, one byte-gather with a
+globally NON-DECREASING flat index vector (solve/dense.py sorted-gather
+mode builds exactly that). XLA's TPU gather treats it as random access
+(~11 ns/element measured); a monotone gather can instead stream: each
+block of K indices touches a bounded window of the table, so the kernel
+DMAs two window-aligned table tiles into VMEM and selects locally —
+HBM traffic becomes sequential tile reads instead of per-element
+transactions.
+
+Status: the kernel is written against the documented Pallas/Mosaic API
+and validated in INTERPRET mode (tests/test_pallas_gather.py) — the TPU
+relay was down for the whole build session, so Mosaic has never compiled
+it (docs/CHIP_PLAN.md gates its adoption on that). It is NOT wired into
+any engine; solve/dense.py's flag-gated lowerings are the shipping paths.
+
+Contract: monotone_window_gather(table_u32, idx_i32) == table[idx] for
+non-decreasing idx, EXCEPT for elements whose block spans more than one
+window width — those are miss-flagged (out undefined there) and counted;
+the caller sizes `window` so misses are structurally rare and falls back
+to a plain gather when nmiss > 0. The dense child gathers have expansion
+ratio C(L+1,n1')/C(L,n1) <= 2, so window = 4*block covers them with
+margin.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def monotone_window_gather(table, idx, block: int = 2048,
+                           window: int = 8192, interpret: bool = False):
+    """table [M] uint32, idx [N] int32 non-decreasing ->
+    (out [N] uint32, nmiss scalar int32).
+
+    Misses (a block spanning past its 2-window view) leave garbage in
+    `out` at those positions and are counted (when nonzero, the count may
+    include padding replicas of a missing tail element); callers must
+    treat any nonzero nmiss as "fall back to a plain gather".
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = idx.shape[0]
+    npad = -n % block
+    if npad:
+        idx = jnp.concatenate([idx, jnp.full((npad,), idx[-1], idx.dtype)])
+    nblk = idx.shape[0] // block
+    # Window-aligned base of each block's view, clamped so tile q+1 exists.
+    m = table.shape[0]
+    nwin = max(-(-m // window), 2)
+    tpad = nwin * window - m
+    if tpad:
+        table = jnp.concatenate(
+            [table, jnp.zeros((tpad,), table.dtype)]
+        )
+    starts = idx[:: block]  # [nblk] first index of each block
+    base_win = jnp.clip(starts // window, 0, nwin - 2).astype(jnp.int32)
+    aligned = base_win * window
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # aligned bases (element units + window units)
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((block,), lambda i, al, bw: (i,)),
+            pl.BlockSpec((window,), lambda i, al, bw: (bw[i],)),
+            pl.BlockSpec((window,), lambda i, al, bw: (bw[i] + 1,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block,), lambda i, al, bw: (i,)),
+            # One miss COUNT per block, not a per-element vector: the
+            # kernel is judged on HBM traffic, and a 4N-byte bookkeeping
+            # write would double its output volume.
+            pl.BlockSpec((1,), lambda i, al, bw: (i,)),
+        ],
+    )
+
+    def kernel(al_ref, bw_ref, idx_ref, t0_ref, t1_ref, out_ref, miss_ref):
+        i = pl.program_id(0)
+        idxs = idx_ref[:]
+        base = al_ref[i]
+        off = idxs - base
+        in0 = (off >= 0) & (off < window)
+        in1 = (off >= window) & (off < 2 * window)
+        t0 = t0_ref[:]
+        t1 = t1_ref[:]
+        g0 = jnp.take(t0, jnp.clip(off, 0, window - 1))
+        g1 = jnp.take(t1, jnp.clip(off - window, 0, window - 1))
+        out_ref[:] = jnp.where(in0, g0, g1)
+        miss_ref[0] = jnp.sum((~(in0 | in1)).astype(jnp.int32))
+
+    out, miss = pl.pallas_call(
+        kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct((nblk * block,), table.dtype),
+            jax.ShapeDtypeStruct((nblk,), jnp.int32),
+        ],
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(aligned, base_win, idx, table, table)
+    # Padding lanes replicate idx[-1]; they miss iff the real tail element
+    # misses, so nmiss stays 0 exactly when every real element hit (the
+    # contract callers check). When nonzero it may count tail replicas.
+    return out[:n], jnp.sum(miss)
