@@ -42,6 +42,25 @@ def force_platform(platform: str, fake_devices: int | None = None) -> None:
         clear_backends()
 
 
+def force_cpu_if_requested(fake_devices: int | None = None) -> bool:
+    """Honor a JAX_PLATFORMS env var that asks for the CPU backend.
+
+    In plugin-pinned containers the env var alone is ineffective (the
+    startup config wins) and the first backend touch can HANG at plugin
+    init — so driver entry points that may run while the accelerator
+    relay is down must translate the env request into force_platform
+    BEFORE any jax array operation. Returns True when it forced CPU.
+    """
+    requested = [
+        p.strip().lower()
+        for p in os.environ.get("JAX_PLATFORMS", "").split(",")
+    ]
+    if "cpu" not in requested:
+        return False
+    force_platform("cpu", fake_devices=fake_devices)
+    return True
+
+
 def apply_platform_env(default_fake_devices: int | None = None) -> None:
     """Honor GAMESMAN_PLATFORM (and GAMESMAN_FAKE_DEVICES) if set."""
     platform = os.environ.get("GAMESMAN_PLATFORM")
