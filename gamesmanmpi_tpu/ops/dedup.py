@@ -10,31 +10,71 @@ static-shape O(n log n) pattern XLA maps well (SURVEY.md §7 "Dedup at scale").
 import jax.numpy as jnp
 
 from gamesmanmpi_tpu.core.bitops import sentinel_for
+from gamesmanmpi_tpu.utils.platform import platform_auto_flag
 # sort1 dispatches to XLA's sort network, or to the merge ladder under
 # GAMESMAN_SORT=merge (resolved at build time by kernel builders — see
 # sort1's docstring; engine.get_kernel keys its cache on the flag).
 from gamesmanmpi_tpu.ops.mergesort import sort1 as _sort
 
 
-def sort_unique(states, merge: bool | None = None):
+def compact_method() -> str:
+    """Compaction lowering for the dedup's keep-mask, resolved at
+    builder/cache-key time for the executing platform. 'resort' (re-sort
+    with sentinels sinking to the tail) on accelerators: cumsum+scatter is
+    1.7x SLOWER on the v5e (tools/microbench.py: 393 ms vs 231 ms at 32M
+    uint32) because XLA serializes arbitrary-index scatters while its TPU
+    sort is a fast vectorized network. On CPU the scatter is O(N) and
+    beats the re-sort (~1.4x at 4M uint64). GAMESMAN_COMPACT=
+    resort|scatter overrides (unknown values raise)."""
+    return platform_auto_flag(
+        "GAMESMAN_COMPACT", accel="resort", cpu="scatter",
+        choices=("resort", "scatter"),
+    )
+
+
+def compaction_sort_bytes(itemsize: int) -> int:
+    """Sort-operand bytes per element the compaction adds — the one place
+    the traffic model knows 'resort' is a sort and 'scatter' is not
+    (callers sum this into bytes_sorted roofline denominators)."""
+    return itemsize if compact_method() == "resort" else 0
+
+
+def compact_sorted(s, keep, merge: bool | None = None,
+                   compact: str | None = None):
+    """Compact kept entries of a SORTED array to the front (sorted order
+    preserved), sentinel tail. keep must be False on sentinel entries.
+    compact: lowering; kernel builders resolve via compact_method() at
+    builder time and pass it down (see lookup_sorted's method param for
+    why). None = resolve at trace time."""
+    sentinel = sentinel_for(s.dtype)
+    if compact is None:
+        compact = compact_method()
+    if compact == "scatter":
+        n = s.shape[0]
+        idx = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        # Dropped (out-of-bounds) writes for non-kept entries; kept ones
+        # land at their run index. No slot is written twice.
+        return jnp.full_like(s, sentinel).at[
+            jnp.where(keep, idx, n)
+        ].set(s, mode="drop")
+    return _sort(jnp.where(keep, s, sentinel), merge)
+
+
+def sort_unique(states, merge: bool | None = None,
+                compact: str | None = None):
     """Sort states, drop duplicates/sentinels, compact to the front.
 
     Input: [N] uint32/uint64 (may contain SENTINEL padding of the same dtype).
     Returns (sorted_unique [N] with all uniques first then SENTINEL tail,
              count of unique non-sentinel entries, int32).
 
-    Sort, mark duplicate-run followers as SENTINEL, then re-sort: sentinels
-    (all-ones) sink to the tail, compacting survivors to the front in sorted
-    order. The obvious O(N) alternative — cumsum + scatter compaction — is
-    1.7x SLOWER on TPU v5e (tools/microbench.py: 393 ms vs 231 ms at 32M
-    uint32): XLA lowers arbitrary-index scatters to a serialized path, while
-    its TPU sort is a fast vectorized network. Mark+resort keeps the whole
-    kernel on the happy path.
+    Sort, mark duplicate-run followers as SENTINEL, then compact (re-sort
+    on accelerators, cumsum+scatter on CPU — see compact_method).
     """
     sentinel = sentinel_for(states.dtype)
     s = _sort(states, merge)
     first = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
     keep = first & (s != sentinel)
-    out = _sort(jnp.where(keep, s, sentinel), merge)
+    out = compact_sorted(s, keep, merge, compact)
     count = jnp.sum(keep).astype(jnp.int32)
     return out, count

@@ -19,21 +19,44 @@ took the r02 solve off the 8x-slower-than-CPU floor.
 import jax.numpy as jnp
 
 from gamesmanmpi_tpu.core.bitops import sentinel_for
+from gamesmanmpi_tpu.utils.platform import platform_auto_flag
 from gamesmanmpi_tpu.core.codec import pack_cells, unpack_cells
 from gamesmanmpi_tpu.core.values import UNDECIDED
 
 
-def lookup_sorted(keys, table_states, table_values, table_remoteness):
+def search_method() -> str:
+    """searchsorted lowering, resolved at trace time for the platform that
+    will execute: 'sort' (sort-merge join) on accelerators — binary search
+    costs log2(N) DEPENDENT gathers/key, 7x slower at 32M keys on the v5e
+    (module docstring) — but on CPU the dependent gathers are cheap and the
+    merge's full re-sort is what dominates (the r03 backward ran 20 s vs
+    r01's ~2 s on the same 5x4 board because of it). GAMESMAN_SEARCH=
+    sort|scan overrides for A/B."""
+    return platform_auto_flag(
+        "GAMESMAN_SEARCH", accel="sort", cpu="scan",
+        choices=("sort", "scan"),
+    )
+
+
+def lookup_sorted(keys, table_states, table_values, table_remoteness,
+                  method: str | None = None):
     """Look keys up in one sorted solved level.
 
     keys: [K] unsigned (SENTINEL entries allowed; they miss).
     table_states: [N] sorted, same dtype as keys, SENTINEL tail.
+    method: searchsorted lowering; kernel BUILDERS resolve it via
+    search_method() when the builder runs (the moment the cache key is
+    computed) and pass it down, so a flag flip between scheduling a
+    background compile and its tracing cannot produce a program that
+    disagrees with its key. None = resolve at trace time (non-cached uses).
     Returns (values [K] uint8 — UNDECIDED on miss, remoteness [K] int32,
     hit [K] bool).
     """
+    if method is None:
+        method = search_method()
     sentinel = sentinel_for(keys.dtype)
     n = table_states.shape[0]
-    idx = jnp.searchsorted(table_states, keys, method="sort")
+    idx = jnp.searchsorted(table_states, keys, method=method)
     idx = jnp.clip(idx, 0, n - 1).astype(jnp.int32)
     cells = pack_cells(table_values, table_remoteness)
     if keys.dtype == jnp.uint32:
@@ -56,19 +79,20 @@ def lookup_sorted(keys, table_states, table_values, table_remoteness):
     return values, remoteness, hit
 
 
-def lookup_window(keys, window):
+def lookup_window(keys, window, method: str | None = None):
     """Look keys up across a window of solved levels.
 
     window: sequence of (states, values, remoteness) triples (each as in
     lookup_sorted). Each key hits at most one level (a state's level is a
-    function of the state). Returns (values, remoteness, hit) like lookup_sorted.
+    function of the state). method: see lookup_sorted. Returns
+    (values, remoteness, hit) like lookup_sorted.
     """
     shape = keys.shape
     values = jnp.full(shape, UNDECIDED, dtype=jnp.uint8)
     remoteness = jnp.zeros(shape, dtype=jnp.int32)
     hit = jnp.zeros(shape, dtype=bool)
     for ts, tv, tr in window:
-        v, r, h = lookup_sorted(keys, ts, tv, tr)
+        v, r, h = lookup_sorted(keys, ts, tv, tr, method)
         values = jnp.where(h, v, values)
         remoteness = jnp.where(h, r, remoteness)
         hit = hit | h

@@ -74,9 +74,17 @@ from gamesmanmpi_tpu.core.hashing import owner_shard, owner_shard_np
 from gamesmanmpi_tpu.core.values import UNDECIDED
 from gamesmanmpi_tpu.games.base import TensorGame
 from gamesmanmpi_tpu.ops.combine import combine_children
-from gamesmanmpi_tpu.ops.dedup import sort_unique
-from gamesmanmpi_tpu.ops.mergesort import use_merge_sort
-from gamesmanmpi_tpu.ops.lookup import lookup_sorted, lookup_window
+from gamesmanmpi_tpu.ops.dedup import (
+    compact_method,
+    compaction_sort_bytes,
+    sort_unique,
+)
+from gamesmanmpi_tpu.ops.mergesort import backend_key, use_merge_sort
+from gamesmanmpi_tpu.ops.lookup import (
+    lookup_sorted,
+    lookup_window,
+    search_method,
+)
 from gamesmanmpi_tpu.ops.padding import bucket_size
 from gamesmanmpi_tpu.parallel.mesh import AXIS, make_mesh
 from gamesmanmpi_tpu.solve.engine import (
@@ -151,7 +159,8 @@ def _route_by_owner(flat, S: int, cap_out: int, sentinel):
 
 
 def _sharded_forward_step(game: TensorGame, S: int, route_cap: int, local,
-                          merge: bool | None = None):
+                          merge: bool | None = None,
+                          compact: str | None = None):
     """Per-shard forward body: expand -> owner-bucket -> all_to_all -> dedup.
 
     local: [1, cap] this shard's frontier slice (shard_map gives the leading
@@ -171,7 +180,7 @@ def _sharded_forward_step(game: TensorGame, S: int, route_cap: int, local,
     send, counts, _, _, _ = _route_by_owner(flat, S, route_cap, sentinel)
     routed = jax.lax.all_to_all(send, AXIS, split_axis=0, concat_axis=0,
                                 tiled=True)
-    uniq, count = sort_unique(routed.reshape(-1), merge)
+    uniq, count = sort_unique(routed.reshape(-1), merge, compact)
     all_counts = jax.lax.all_gather(count, AXIS)  # [S] replicated
     all_sends = jax.lax.all_gather(counts, AXIS)  # [S, S] replicated
     return uniq[None], all_counts, all_sends
@@ -240,7 +249,7 @@ def _reply_core(game: TensorGame, S: int, qcap: int, local, reply, s_owner,
 
 
 def _sharded_backward_step(game: TensorGame, S: int, qcap: int, local,
-                           window_flat):
+                           window_flat, method: str | None = None):
     """Per-shard backward body: owner-routed child-value reduction.
 
     The SEND_BACK/RESOLVE analog (SURVEY.md §3.3, §5.8): child queries are
@@ -269,7 +278,7 @@ def _sharded_backward_step(game: TensorGame, S: int, qcap: int, local,
         queries, qcounts, s_owner, pos, order = _route_core(
             game, S, qcap, local
         )
-        vals, rems, _ = lookup_window(queries.reshape(-1), window)
+        vals, rems, _ = lookup_window(queries.reshape(-1), window, method)
         reply = pack_cells(vals, rems).reshape(S, qcap)
         reply = jax.lax.all_to_all(reply, AXIS, split_axis=0, concat_axis=0,
                                    tiled=True)
@@ -307,7 +316,8 @@ def _sharded_route_step(game: TensorGame, S: int, qcap: int, local):
     )
 
 
-def _sharded_lookup_acc_step(queries, acc, wstates, wvals, wrem):
+def _sharded_lookup_acc_step(queries, acc, wstates, wvals, wrem,
+                             method: str | None = None):
     """Streamed backward, phase 2 (once per window block): local lookup.
 
     Looks this shard's routed queries up in ONE block of its window slice
@@ -317,7 +327,7 @@ def _sharded_lookup_acc_step(queries, acc, wstates, wvals, wrem):
     select. No collectives — pure local compute.
     """
     q = queries[0].reshape(-1)
-    v, r, h = lookup_sorted(q, wstates[0], wvals[0], wrem[0])
+    v, r, h = lookup_sorted(q, wstates[0], wvals[0], wrem[0], method)
     cell = pack_cells(v, r)
     out = jnp.where(h, cell, acc[0].reshape(-1))
     return out.reshape(acc[0].shape)[None]
@@ -456,10 +466,12 @@ class ShardedSolver:
         mesh, S = self.mesh, self.S
 
         def build(game):
-            mb = use_merge_sort()  # resolved at cache-key time
+            # resolved at cache-key time
+            mb, cm = use_merge_sort(), compact_method()
 
             def per_shard(local):
-                return _sharded_forward_step(game, S, route_cap, local, mb)
+                return _sharded_forward_step(game, S, route_cap, local, mb,
+                                             cm)
 
             return jax.shard_map(
                 per_shard,
@@ -471,7 +483,7 @@ class ShardedSolver:
 
         return get_kernel(
             self.game, "sfwd", (self._mesh_key, cap, route_cap), build,
-            sort_backend=True,
+            lowering=(backend_key(), compact_method()),
         )
 
     def _resize_fn(self, in_cap: int, out_cap: int):
@@ -511,9 +523,11 @@ class ShardedSolver:
         n_windows = len(window_caps)
 
         def build(game):
+            sm = search_method()  # resolved at cache-key time
+
             def per_shard(local, *window_flat):
                 return _sharded_backward_step(game, S, qcap, local,
-                                              window_flat)
+                                              window_flat, sm)
 
             return jax.shard_map(
                 per_shard,
@@ -528,6 +542,7 @@ class ShardedSolver:
             "sbwd",
             (self._mesh_key, cap, tuple(window_caps), qcap),
             build,
+            lowering=(search_method(),),  # lookup_window's search lowering
         )
 
     def _route_fn(self, cap: int, qcap: int):
@@ -555,15 +570,22 @@ class ShardedSolver:
         mesh = self.mesh
 
         def build(game):
+            sm = search_method()  # resolved at cache-key time
+
+            def step(queries, acc, wstates, wvals, wrem):
+                return _sharded_lookup_acc_step(queries, acc, wstates,
+                                                wvals, wrem, sm)
+
             return jax.shard_map(
-                _sharded_lookup_acc_step,
+                step,
                 mesh=mesh,
                 in_specs=(P(AXIS),) * 5,
                 out_specs=P(AXIS),
             )
 
         return get_kernel(
-            self.game, "sla", (self._mesh_key, qcap, wcap), build
+            self.game, "sla", (self._mesh_key, qcap, wcap), build,
+            lowering=(search_method(),),
         )
 
     def _reply_fn(self, cap: int, qcap: int):
@@ -634,7 +656,8 @@ class ShardedSolver:
         mesh = self.mesh
 
         def build(game):
-            mb = use_merge_sort()  # resolved at cache-key time
+            # resolved at cache-key time
+            mb, cm = use_merge_sort(), compact_method()
 
             def per_shard(pool, kids, target):
                 p, c = pool[0], kids[0]
@@ -642,7 +665,9 @@ class ShardedSolver:
                     c != game.sentinel, game.level_of(c), -1
                 )
                 sel = jnp.where(lv == target[0], c, game.sentinel)
-                uniq, count = sort_unique(jnp.concatenate([p, sel]), mb)
+                uniq, count = sort_unique(
+                    jnp.concatenate([p, sel]), mb, cm
+                )
                 return uniq[None], jax.lax.all_gather(count, AXIS)
 
             return jax.shard_map(
@@ -655,7 +680,7 @@ class ShardedSolver:
 
         return get_kernel(
             self.game, "smrg", (self._mesh_key, pool_cap, child_cap), build,
-            sort_backend=True
+            lowering=(backend_key(), compact_method()),
         )
 
     def _level_check_fn(self, cap: int):
@@ -750,8 +775,9 @@ class ShardedSolver:
                 self.spill_retries += 1
                 route_cap = bucket_size(max_sent)
             item = np.dtype(g.state_dtype).itemsize
+            compaction = compaction_sort_bytes(item)
             self.bytes_routed += S * S * route_cap * item
-            self.bytes_sorted += S * S * route_cap * item
+            self.bytes_sorted += S * S * route_cap * (item + compaction)
             counts = np.asarray(count).reshape(-1).astype(np.int64)
             total = int(counts.sum())
             if total == 0:
@@ -844,8 +870,9 @@ class ShardedSolver:
                 self.spill_retries += 1
                 route_cap = bucket_size(max_sent)
             item = np.dtype(g.state_dtype).itemsize
+            compaction = compaction_sort_bytes(item)
             self.bytes_routed += S * S * route_cap * item
-            self.bytes_sorted += S * S * route_cap * item
+            self.bytes_sorted += S * S * route_cap * (item + compaction)
             ccounts = np.asarray(count).reshape(-1)
             total = int(ccounts.sum())
             if total > 0:
@@ -885,7 +912,9 @@ class ShardedSolver:
                     merged, mcount = self._merge_fn(pool.shape[1], ccap)(
                         pool, children, np.full(1, L, np.int32)
                     )
-                    self.bytes_sorted += S * (pool.shape[1] + ccap) * item
+                    self.bytes_sorted += (
+                        S * (pool.shape[1] + ccap) * (item + compaction)
+                    )
                     mcounts = np.asarray(mcount).reshape(-1).astype(np.int64)
                     mcap = bucket_size(int(mcounts.max()), self.min_bucket)
                     pools[L] = (
@@ -928,11 +957,16 @@ class ShardedSolver:
             item = np.dtype(self.game.state_dtype).itemsize
             # Queries out (state bytes) + packed cells back.
             self.bytes_routed += S * S * qcap * (item + 4)
-            # Sort-merge join operands + fused payload gather w/ indices.
-            self.bytes_sorted += (
-                S * (S * qcap + sum(window_caps)) * (item + 4)
-            )
-            self.bytes_gathered += S * S * qcap * 12
+            if search_method() == "sort":
+                # Sort-merge join operands + fused payload gather w/ idx.
+                self.bytes_sorted += (
+                    S * (S * qcap + sum(window_caps)) * (item + 4)
+                )
+                self.bytes_gathered += S * S * qcap * 12
+            else:
+                # Binary search: no join sort, one payload gather per query
+                # (log2 traversal reads not modeled).
+                self.bytes_gathered += S * S * qcap * 8
         return values, rem, misses
 
     def _run_backward_step_streamed(self, stacked, cap: int, windows):
@@ -970,8 +1004,11 @@ class ShardedSolver:
                        wr.block(off, wb))
                 acc = self._lookup_acc_fn(qcap, wb)(queries, acc, *blk)
                 self.window_stream_blocks += 1
-                self.bytes_sorted += S * (S * qcap + wb) * (item + 4)
-                self.bytes_gathered += S * S * qcap * 12
+                if search_method() == "sort":
+                    self.bytes_sorted += S * (S * qcap + wb) * (item + 4)
+                    self.bytes_gathered += S * S * qcap * 12
+                else:
+                    self.bytes_gathered += S * S * qcap * 8
         return self._reply_fn(cap, qcap)(stacked, acc, s_owner, pos, order)
 
     def _blocked_loop(self, stacked, step):

@@ -83,6 +83,7 @@ from gamesmanmpi_tpu.games.connect4 import Connect4
 from gamesmanmpi_tpu.ops.combine import combine_children
 from gamesmanmpi_tpu.solve.engine import get_kernel, schedule_kernel
 from gamesmanmpi_tpu.solve.precompile import sds
+from gamesmanmpi_tpu.utils.platform import backend_epoch
 
 
 def _profiles_for_level(width: int, height: int, level: int) -> np.ndarray:
@@ -162,9 +163,20 @@ class DenseTables:
         self._cellidx: dict[int, np.ndarray] = {}
         # Device-side caches (filled by DenseSolver._upload_consts; shared
         # across solver instances of the same board so warm repeats skip
-        # re-upload as well as re-derivation).
+        # re-upload as well as re-derivation). Invalidated when
+        # force_platform clears backends (the arrays' devices die with
+        # them) — see drop_stale_device_caches.
         self._dev_consts: dict = {}
         self._dev_binom = None
+        self._dev_epoch = backend_epoch()
+
+    def drop_stale_device_caches(self) -> None:
+        """Drop device arrays uploaded before a backend clear."""
+        epoch = backend_epoch()
+        if epoch != self._dev_epoch:
+            self._dev_consts = {}
+            self._dev_binom = None
+            self._dev_epoch = epoch
 
     # -- per-level constants ------------------------------------------------
 
@@ -1035,6 +1047,7 @@ class DenseSolver:
 
     def _binom_dev(self):
         """The [ncells+1, K] binomial table on device (uploaded once)."""
+        self.tables.drop_stale_device_caches()
         if self.tables._dev_binom is None:
             rk = np.uint32 if self._rank_dtype == jnp.uint32 else np.uint64
             self.tables._dev_binom = jnp.asarray(
@@ -1050,6 +1063,7 @@ class DenseSolver:
         relay's 30-60 MB/s pipe). Cached on the shared DenseTables so
         repeat solves re-use the device arrays."""
         t = self.tables
+        t.drop_stale_device_caches()
         ck = (level, for_reach)
         if ck in t._dev_consts:
             return t._dev_consts[ck]

@@ -71,16 +71,21 @@ from gamesmanmpi_tpu.core.codec import pack_cells, unpack_cells
 from gamesmanmpi_tpu.core.values import UNDECIDED
 from gamesmanmpi_tpu.games.base import TensorGame
 from gamesmanmpi_tpu.ops.combine import combine_children
-from gamesmanmpi_tpu.ops.dedup import sort_unique
+from gamesmanmpi_tpu.ops.dedup import (
+    compact_method,
+    compact_sorted,
+    compaction_sort_bytes,
+    sort_unique,
+)
 from gamesmanmpi_tpu.ops.mergesort import (
     backend_key,
-    sort1,
     sort_with_payload,
     use_merge_sort,
 )
-from gamesmanmpi_tpu.ops.lookup import lookup_window
+from gamesmanmpi_tpu.ops.lookup import lookup_window, search_method
 from gamesmanmpi_tpu.ops.padding import MIN_BUCKET, bucket_size, pad_to, pad_to_bucket
 from gamesmanmpi_tpu.solve.precompile import global_precompiler, sds
+from gamesmanmpi_tpu.utils.platform import backend_epoch, platform_auto_bool
 
 
 class LevelTable(NamedTuple):
@@ -139,28 +144,62 @@ class SolverError(RuntimeError):
 _KERNELS: dict = {}
 
 
-def _cache_key(game: TensorGame, kind: str, shape_key, sort_backend: bool):
-    """Cache key for a kernel. Builders whose programs contain
-    backend-dispatched sorts (dedup / provenance) declare it with
-    sort_backend=True at their get_kernel/schedule_kernel call site — the
-    key then carries the backend (GAMESMAN_SORT / GAMESMAN_SORT_ROW)
-    resolved at build time, so a mid-process flag flip cannot reuse
-    kernels traced under the other backend. Backend-free kinds omit it:
-    keying every kind would recompile byte-identical lookup/combine
-    kernels on a flag flip (the doubled compile load stress-crashed XLA's
-    CPU compiler once in a full-suite run)."""
-    if sort_backend:
-        return (game.cache_key, kind, shape_key, backend_key())
-    return (game.cache_key, kind, shape_key)
+def _cache_key(game: TensorGame, kind: str, shape_key, lowering):
+    """Cache key for a kernel. Builders whose programs embed a
+    flag/platform-resolved lowering — the sort backend (GAMESMAN_SORT
+    [_ROW]), the searchsorted method (GAMESMAN_SEARCH), the dedup
+    compaction (GAMESMAN_COMPACT) — pass the RESOLVED choices as the
+    `lowering` tuple at their get_kernel/schedule_kernel call site. The
+    builder itself captures the same values when it runs (immediately, at
+    key time — schedule_kernel calls builder(game) before handing the
+    traceable to the pool), so a mid-process flag flip can neither reuse a
+    kernel traced under the other lowering nor produce a program that
+    disagrees with its key. Each kind carries only the knobs its program
+    actually contains — keying every kind on every knob would recompile
+    byte-identical kernels on a flag flip (the doubled compile load
+    stress-crashed XLA's CPU compiler once in a full-suite run).
+
+    Every key also carries the backend EPOCH (utils/platform.py): when
+    force_platform genuinely clears backends, executables closed over the
+    old device objects (sharded kernels close over a Mesh) must not be
+    reused — they fail with "incompatible devices for jitted computation"."""
+    if lowering:
+        return (game.cache_key, kind, shape_key, tuple(lowering),
+                backend_epoch())
+    return (game.cache_key, kind, shape_key, backend_epoch())
+
+
+# Epoch whose kernels _KERNELS currently holds. Keys carry the epoch, so
+# stale entries are unreachable after a genuine backend clear — but without
+# a sweep they would leak (executables + closed-over Mesh/device objects)
+# once per clear in long-lived processes. Per-game private caches are not
+# swept: they die with their game instance.
+_KERNELS_EPOCH = 0
+
+
+def _sweep_stale_kernels() -> None:
+    global _KERNELS_EPOCH
+    epoch = backend_epoch()
+    if epoch != _KERNELS_EPOCH:
+        _KERNELS.clear()
+        # Scheduled background compiles under old-epoch keys can never be
+        # fetched either (every _cache_key ends with the epoch) — purge
+        # them too, or their futures pin executables/Mesh objects and
+        # queued ones burn ~15 s worker compiles for unreachable results.
+        global_precompiler().purge(
+            lambda k: isinstance(k, tuple) and bool(k) and k[-1] != epoch
+        )
+        _KERNELS_EPOCH = epoch
 
 
 def get_kernel(game: TensorGame, kind: str, shape_key, builder,
-               sort_backend: bool = False):
+               lowering=()):
     # Games whose identity is per-instance (TensorizedModule: host callbacks
     # can't be compared) carry their own cache dict, so their kernels are
     # garbage-collected with the game instead of pinning it process-wide.
+    _sweep_stale_kernels()
     cache = getattr(game, "_private_kernel_cache", _KERNELS)
-    key = _cache_key(game, kind, shape_key, sort_backend)
+    key = _cache_key(game, kind, shape_key, lowering)
     fn = cache.get(key)
     if fn is None:
         # A background compile scheduled for this key wins over inline jit:
@@ -176,21 +215,24 @@ def get_kernel(game: TensorGame, kind: str, shape_key, builder,
 
 
 def schedule_kernel(game: TensorGame, kind: str, shape_key, builder, avals,
-                    heavy: bool = False, sort_backend: bool = False):
+                    heavy: bool = False, lowering=()):
     """Queue a background compile of a kernel (idempotent, never blocks).
 
     avals must match the call signature get_kernel's users will invoke the
     kernel with — the compiled executable is shared through the same cache
     key. heavy marks big-working-set programs that must not compile at
-    full pool concurrency (see precompile._heavy_slots).
+    full pool concurrency (see precompile._heavy_slots). builder(game) runs
+    HERE (only tracing is deferred to the pool), so builder-captured
+    lowering knobs are resolved at the same moment as the key.
     """
     if getattr(game, "_private_kernel_cache", None) is not None:
         # Per-instance-cached games (compat host-callback modules): their
         # kernels must die with the instance; routing them through the
         # process-wide precompiler would pin the instance via its future.
         return
+    _sweep_stale_kernels()
     cache = _KERNELS
-    key = _cache_key(game, kind, shape_key, sort_backend)
+    key = _cache_key(game, kind, shape_key, lowering)
     if key in cache:
         return
     pre = global_precompiler()
@@ -255,16 +297,19 @@ def canonical_children(game: TensorGame, states, active):
     return children, mask
 
 
-def expand_core(game: TensorGame, states, merge: bool | None = None):
+def expand_core(game: TensorGame, states, merge: bool | None = None,
+                compact: str | None = None):
     """Shared expand+mask+dedup: [B] -> (uniq [B*M] sorted, count).
 
-    merge: sort-backend flag, resolved at BUILD time by kernel builders
-    (None = read the env at trace time; see ops.mergesort.sort1)."""
+    merge/compact: sort-backend flag and compaction lowering, resolved at
+    BUILD time by kernel builders (None = read the env/platform at trace
+    time; see ops.mergesort.sort1, ops.dedup.compact_method)."""
     children, _ = canonical_children(game, states, undecided_mask(game, states))
-    return sort_unique(children.reshape(-1), merge)
+    return sort_unique(children.reshape(-1), merge, compact)
 
 
-def expand_provenance(game: TensorGame, states, merge: bool | None = None):
+def expand_provenance(game: TensorGame, states, merge: bool | None = None,
+                      compact: str | None = None):
     """Forward expand that also keeps the dedup sort's provenance.
 
     Returns (uniq [B*M], count, uidx [B*M] int32, prim [B] uint8):
@@ -294,7 +339,7 @@ def expand_provenance(game: TensorGame, states, merge: bool | None = None):
     uid = jnp.cumsum(keep.astype(jnp.int32)) - 1
     uid = jnp.where(s != game.sentinel, uid, -1)
     _, uidx = sort_with_payload(o, uid, merge)
-    uniq = sort1(jnp.where(keep, s, game.sentinel), merge)
+    uniq = compact_sorted(s, keep, merge, compact)
     count = jnp.sum(keep).astype(jnp.int32)
     return uniq, count, uidx, prim
 
@@ -329,23 +374,26 @@ def resolve_provenance(n, prim, uidx, wvals, wrem, max_moves: int):
     return values, remoteness, misses
 
 
-def expand_with_levels(game: TensorGame, states, merge: bool | None = None):
+def expand_with_levels(game: TensorGame, states, merge: bool | None = None,
+                       compact: str | None = None):
     """Generic-path forward: expand_core + each child's topological level."""
-    uniq, count = expand_core(game, states, merge)
+    uniq, count = expand_core(game, states, merge, compact)
     levels = jnp.where(uniq != game.sentinel, game.level_of(uniq), -1)
     return uniq, levels, count
 
 
-def resolve_level(game: TensorGame, states, window):
+def resolve_level(game: TensorGame, states, window,
+                  method: str | None = None):
     """[B] states + solved deeper levels -> (values, remoteness, misses).
 
     Children are canonicalized to match the canonical solved tables.
+    method: searchsorted lowering (see ops.lookup.lookup_sorted).
     """
     valid = states != game.sentinel
     prim = game.primitive(states)
     undecided = valid & (prim == UNDECIDED)
     children, mask = canonical_children(game, states, undecided)
-    child_vals, child_rem, hit = lookup_window(children, window)
+    child_vals, child_rem, hit = lookup_window(children, window, method)
     values, remoteness = combine_children(child_vals, child_rem, mask)
     values = jnp.where(undecided, values, jnp.where(valid, prim, UNDECIDED))
     remoteness = jnp.where(undecided, remoteness, 0)
@@ -472,6 +520,20 @@ class Solver:
         else:
             self.precompile = flag not in ("0", "off", "false")
         self._cap_ceiling = self._cap_limit() if self.precompile else 0
+        # Provenance forward (expand_provenance: two pair sorts + a re-sort)
+        # trades forward sort work for a gather-only backward — a clear win
+        # on the TPU, where sorts hide behind the relay's dispatch latency
+        # and the backward's sort-merge join was the dominant cost. On CPU
+        # the same trade REGRESSED the solve ~5x (BENCH_r01 813k vs
+        # BENCH_r03 150k pos/s on 5x4): forward sort work tripled while the
+        # backward it saves was already cheap. Keyed on the platform that
+        # will execute, not on an env var benches could forget
+        # (GAMESMAN_PROVENANCE=0/1 remains as a test/experiment override).
+        # RESOLVED AT SOLVE TIME, like every other platform-auto knob: a
+        # force_platform between construction and solve() must re-resolve
+        # (speculate/search/compact all would; this must not lag behind on
+        # the stale platform).
+        self.use_provenance: bool | None = None
 
     # ---------------------------------------------------------------- kernels
 
@@ -488,20 +550,22 @@ class Solver:
     @staticmethod
     def _fwdp_builder(game):
         # Builders run at cache-key time (inside get_kernel/
-        # schedule_kernel), so resolving the sort backend HERE keeps the
+        # schedule_kernel), so resolving the lowering knobs HERE keeps the
         # traced program consistent with the key even when a background
         # worker traces it later.
-        mb = use_merge_sort()
-        return lambda states: expand_provenance(game, states, mb)
+        mb, cm = use_merge_sort(), compact_method()
+        return lambda states: expand_provenance(game, states, mb, cm)
 
     @staticmethod
     def _bwd_builder(game):
+        sm = search_method()  # resolved at cache-key time
+
         def f(states, *window_flat):
             window = tuple(
                 (window_flat[i], window_flat[i + 1], window_flat[i + 2])
                 for i in range(0, len(window_flat), 3)
             )
-            return resolve_level(game, states, window)
+            return resolve_level(game, states, window, sm)
 
         return f
 
@@ -512,10 +576,26 @@ class Solver:
             n, prim, uidx, wvals, wrem, M
         )
 
+    @staticmethod
+    def _fwdf_builder(game):
+        mb, cm = use_merge_sort(), compact_method()
+        return lambda states: expand_core(game, states, mb, cm)
+
+    @staticmethod
+    def _fwd_lowering():
+        """Knobs the forward kernels embed: sorts + dedup compaction."""
+        return (backend_key(), compact_method())
+
     def _fwdp(self, cap: int):
         """Provenance forward: states[cap] -> (uniq, count, uidx, prim)."""
         return get_kernel(self.game, "fwdp", cap, self._fwdp_builder,
-                          sort_backend=True)
+                          lowering=self._fwd_lowering())
+
+    def _fwdf(self, cap: int):
+        """Plain fast forward (one dedup sort, no provenance): states[cap]
+        -> (uniq, count). The CPU default — see use_provenance."""
+        return get_kernel(self.game, "fwdf", cap, self._fwdf_builder,
+                          lowering=self._fwd_lowering())
 
     def _bwdp(self, cap: int, wcap: int):
         """Provenance backward: (n, prim[cap], uidx[cap*M], wvals[wcap],
@@ -524,11 +604,12 @@ class Solver:
 
     def _fwd_generic(self, cap: int):
         def build(game):
-            mb = use_merge_sort()  # resolved at cache-key time
-            return lambda states: expand_with_levels(game, states, mb)
+            # resolved at cache-key time
+            mb, cm = use_merge_sort(), compact_method()
+            return lambda states: expand_with_levels(game, states, mb, cm)
 
         return get_kernel(self.game, "fwdg", cap, build,
-                          sort_backend=True)
+                          lowering=self._fwd_lowering())
 
     def _bwd(self, cap: int, wcaps: tuple):
         """Backward: states[cap] + window levels -> (values, rem, misses).
@@ -538,7 +619,8 @@ class Solver:
         window level padded to the common capacity, see _backward_fast).
         """
         return get_kernel(
-            self.game, "bwd", (cap, tuple(wcaps)), self._bwd_builder
+            self.game, "bwd", (cap, tuple(wcaps)), self._bwd_builder,
+            lowering=(search_method(),),  # lookup_window's search lowering
         )
 
     # ---------------------------------------------- background compile plan
@@ -567,6 +649,7 @@ class Solver:
         schedule_kernel(
             self.game, "bwd", (cap, tuple(wcaps)), self._bwd_builder, avals,
             heavy=self._heavy(max((cap,) + tuple(wcaps))),
+            lowering=(search_method(),),
         )
 
     def _heavy(self, cap: int) -> bool:
@@ -582,8 +665,31 @@ class Solver:
         schedule_kernel(
             self.game, "fwdp", cap, self._fwdp_builder,
             (sds((cap,), self.game.state_dtype),),
-            heavy=self._heavy(cap), sort_backend=True,
+            heavy=self._heavy(cap), lowering=self._fwd_lowering(),
         )
+
+    def _sched_fwdf(self, cap: int) -> None:
+        if cap > self._cap_ceiling:
+            return
+        schedule_kernel(
+            self.game, "fwdf", cap, self._fwdf_builder,
+            (sds((cap,), self.game.state_dtype),),
+            heavy=self._heavy(cap), lowering=self._fwd_lowering(),
+        )
+
+    def _sched_fwd_step(self, cap: int) -> None:
+        """Schedule whichever forward kernel this solver will request."""
+        if self.use_provenance:
+            self._sched_fwdp(cap)
+        else:
+            self._sched_fwdf(cap)
+
+    def _sched_bwd_step(self, cap: int, wcap: int) -> None:
+        """Schedule whichever backward kernel this solver will request."""
+        if self.use_provenance:
+            self._sched_bwdp(cap, wcap)
+        else:
+            self._sched_bwd(cap, (wcap,))
 
     def _sched_bwdp(self, cap: int, wcap: int) -> None:
         if cap > self._cap_ceiling:
@@ -612,8 +718,8 @@ class Solver:
         for _ in range(7):
             if cap > self._cap_ceiling:
                 break
-            self._sched_fwdp(cap)
-            self._sched_bwdp(min(cap, self._block_size()), cap)
+            self._sched_fwd_step(cap)
+            self._sched_bwd_step(min(cap, self._block_size()), cap)
             cap *= 2
 
     def _block_size(self) -> int:
@@ -700,10 +806,21 @@ class Solver:
         levels[start_level] = _Level(1, host0, frontier)
         stored_bytes = frontier.nbytes
         k = start_level
-        speculate = os.environ.get("GAMESMAN_SPECULATE", "1") not in (
-            "0", "off", "false",
+        # Speculation hides the ~65 ms relay host-sync; on CPU the sync is
+        # microseconds and a dropped speculative expand is real wasted work.
+        speculate = platform_auto_bool(
+            "GAMESMAN_SPECULATE", accel=True, cpu=False
         )
-        pending = self._fwdp(frontier.shape[0])(frontier)
+
+        def fwd_step(arr):
+            """Dispatch the platform-selected forward kernel; normalize to
+            (uniq, count, uidx|None, prim|None)."""
+            if self.use_provenance:
+                return self._fwdp(arr.shape[0])(arr)
+            u, c = self._fwdf(arr.shape[0])(arr)
+            return u, c, None, None
+
+        pending = fwd_step(frontier)
         while True:
             t0 = time.perf_counter()
             cap = frontier.shape[0]
@@ -711,14 +828,16 @@ class Solver:
             spec = spec_input = None
             if speculate:
                 spec_input = jax.lax.slice(uniq, (0,), (cap,))
-                spec = self._fwdp(cap)(spec_input)
+                spec = fwd_step(spec_input)
             n = int(count)  # the one host sync per level
             rec = levels[k]
-            extra = prim.nbytes + uidx.nbytes
-            if n > 0 and stored_bytes + extra <= self.device_store_bytes:
-                # Keep this level's provenance for the gather-only backward.
-                rec.prim, rec.uidx = prim, uidx
-                stored_bytes += extra
+            if uidx is not None:
+                extra = prim.nbytes + uidx.nbytes
+                if n > 0 and stored_bytes + extra <= self.device_store_bytes:
+                    # Keep this level's provenance for the gather-only
+                    # backward.
+                    rec.prim, rec.uidx = prim, uidx
+                    stored_bytes += extra
             if n == 0:
                 break
             if k + 1 >= g.num_levels:
@@ -738,8 +857,8 @@ class Solver:
                 # Backward kernels block at _block_size() — schedule the key
                 # the backward pass will actually request.
                 for ahead in (next_cap * 2, next_cap * 4):
-                    self._sched_fwdp(ahead)
-                    self._sched_bwdp(min(ahead, self._block_size()), ahead)
+                    self._sched_fwd_step(ahead)
+                    self._sched_bwd_step(min(ahead, self._block_size()), ahead)
             if next_cap == cap and spec is not None:
                 nxt = spec_input
                 pending = spec
@@ -760,7 +879,7 @@ class Solver:
                             ),
                         ]
                     )
-                pending = self._fwdp(next_cap)(nxt)
+                pending = fwd_step(nxt)
             rec = _Level(n, None, nxt)
             if stored_bytes + nxt.nbytes > self.device_store_bytes:
                 # Device-store budget exhausted: keep this level on host only
@@ -772,11 +891,17 @@ class Solver:
                 stored_bytes += nxt.nbytes
             levels[k + 1] = rec
             frontier = nxt
-            # expand_provenance sorts: (child, origin int32) pair +
-            # (origin, uid) int32 pair + the compaction re-sort
-            # = cap*M*(2*itemsize + 12) bytes of sort operands.
             item = np.dtype(g.state_dtype).itemsize
-            level_sort_bytes = cap * g.max_moves * (2 * item + 12)
+            # Only operands of actual sorts count (the traffic denominator
+            # must match the kernel the platform lowered).
+            compaction = compaction_sort_bytes(item)
+            if self.use_provenance:
+                # expand_provenance: (child, origin i32) pair sort +
+                # (origin, uid) i32 pair sort + the compaction.
+                level_sort_bytes = cap * g.max_moves * (item + 12 + compaction)
+            else:
+                # expand_core: one dedup sort + the compaction.
+                level_sort_bytes = cap * g.max_moves * (item + compaction)
             self.bytes_sorted += level_sort_bytes
             if self.logger is not None:
                 self.logger.log(
@@ -812,8 +937,14 @@ class Solver:
         ks = sorted(levels, reverse=True)
         caps = {k: bucket_size(levels[k].n, self.min_bucket) for k in ks}
         common = {}
+        # Common-capacity padding halves backward COMPILE count — the right
+        # trade at ~15 s per remote compile, the wrong one on CPU where
+        # compiles are cheap and the padding is real lookup/combine work on
+        # alternating levels. The provenance resolve requires it regardless
+        # (its blocked kernel assumes states and window share one shape).
+        pad = self.use_provenance or jax.default_backend() != "cpu"
         for k in ks:
-            if k + 1 in caps:
+            if k + 1 in caps and pad:
                 common[k] = max(caps[k], caps[k + 1])
             else:
                 common[k] = caps[k]
@@ -842,7 +973,10 @@ class Solver:
             if k + 1 in levels and rec.uidx is not None:
                 self._sched_bwdp(min(C, block), C)
             else:
-                wcaps = (C,) if k + 1 in levels else ()
+                # Window shape = its own bucket padded to C (no-op pad when
+                # the plan uses exact buckets) — must match the key the
+                # resolve below will request.
+                wcaps = (max(C, caps[k + 1]),) if k + 1 in levels else ()
                 self._sched_bwd(min(C, block), wcaps)
         prev = None  # (states_dev, values_dev, rem_dev) of level k+1, at its C
         for k in ks:
@@ -894,16 +1028,24 @@ class Solver:
                     )
                 else:
                     if prev is not None:
-                        # Sort-merge join operands + fused u64 payload
-                        # gather with its i32 indices.
-                        lvl_sort_bytes = (C * g.max_moves + C) * (item + 4)
-                        lvl_gather_bytes = C * g.max_moves * 12
+                        if search_method() == "sort":
+                            # Sort-merge join operands + fused u64 payload
+                            # gather with its i32 indices.
+                            lvl_sort_bytes = (C * g.max_moves + C) * (item + 4)
+                            lvl_gather_bytes = C * g.max_moves * 12
+                        else:
+                            # Binary search: no join sort; one fused payload
+                            # gather per child (the log2(W) traversal reads
+                            # are not modeled).
+                            lvl_gather_bytes = C * g.max_moves * 8
                     if prev is None:
                         args, wcaps = (), ()
                     else:
                         # Slice the deeper level down to its own bucket, then
-                        # pad to this level's common capacity — window and
-                        # states share one shape (see _backward_plan).
+                        # pad to this level's common capacity when the plan
+                        # uses one (see _backward_plan; exact buckets on
+                        # CPU, so _pad_dev may no-op and the window keeps
+                        # its own shape).
                         wcap = caps[k + 1]
                         ws = jax.lax.slice(prev[0], (0,), (wcap,))
                         wv = jax.lax.slice(prev[1], (0,), (wcap,))
@@ -913,7 +1055,7 @@ class Solver:
                             self._pad_dev(wv, C, np.uint8(UNDECIDED)),
                             self._pad_dev(wr, C, np.int32(0)),
                         )
-                        wcaps = (C,)
+                        wcaps = (args[0].shape[0],)
                     values_dev, rem_dev, misses = self._resolve_blocked(
                         states_dev, wcaps, args
                     )
@@ -985,10 +1127,12 @@ class Solver:
             uniq, levels, count = self._fwd_generic(padded.shape[0])(
                 jnp.asarray(padded)
             )
-            # expand_core's sort + compaction re-sort.
+            # expand_core's dedup sort (+ compaction re-sort when the
+            # platform lowers compaction as a sort).
+            item = np.dtype(g.state_dtype).itemsize
             lvl_sort_bytes = (
-                2 * padded.shape[0] * g.max_moves
-                * np.dtype(g.state_dtype).itemsize
+                padded.shape[0] * g.max_moves
+                * (item + compaction_sort_bytes(item))
             )
             self.bytes_sorted += lvl_sort_bytes
             n = int(count)
@@ -1065,13 +1209,17 @@ class Solver:
                 for L in window_levels:
                     window_flat.extend(padded_cache[L])
                 wcaps = tuple(padded_cache[L][0].shape[0] for L in window_levels)
-                # Per-window-level sort-merge joins + fused payload gathers.
                 item = np.dtype(g.state_dtype).itemsize
                 cm = padded.shape[0] * g.max_moves
-                lvl_sort_bytes = sum(
-                    (cm + w) * (item + 4) for w in wcaps
-                )
-                lvl_gather_bytes = cm * 12 * len(wcaps)
+                if search_method() == "sort":
+                    # Per-window-level sort-merge joins + payload gathers.
+                    lvl_sort_bytes = sum(
+                        (cm + w) * (item + 4) for w in wcaps
+                    )
+                    lvl_gather_bytes = cm * 12 * len(wcaps)
+                else:
+                    # Binary search: payload gathers only.
+                    lvl_gather_bytes = cm * 8 * len(wcaps)
                 self.bytes_sorted += lvl_sort_bytes
                 self.bytes_gathered += lvl_gather_bytes
                 values_dev, rem_dev, misses = self._resolve_blocked(
@@ -1121,6 +1269,11 @@ class Solver:
     def solve(self) -> SolveResult:
         g = self.game
         t0 = time.perf_counter()
+        # Platform-auto knob, resolved here (not in __init__) so a
+        # force_platform between construction and solve() is honored.
+        self.use_provenance = platform_auto_bool(
+            "GAMESMAN_PROVENANCE", accel=True, cpu=False
+        )
         if self.checkpointer is not None:
             self.checkpointer.bind_game(g.name)
         saved = (

@@ -112,6 +112,12 @@ class Precompiler:
                 fut.set_exception(RuntimeError("precompiler closed"))
                 continue
             if heavy and not self._heavy_sem.acquire(blocking=False):
+                if self._closed:
+                    # Never requeue after close: the item could land behind
+                    # the close sentinels with every worker already gone,
+                    # leaving its future unresolved forever.
+                    fut.set_exception(RuntimeError("precompiler closed"))
+                    continue
                 # No heavy slot free: requeue and stay available for light
                 # jobs — heavy work must never park the whole pool.
                 self._q.put(item)
@@ -186,6 +192,21 @@ class Precompiler:
         with self._lock:
             return key in self._futures
 
+    def purge(self, predicate) -> None:
+        """Drop scheduled futures whose key matches `predicate`.
+
+        Used by the engine's stale-epoch sweep: after a genuine backend
+        clear no old-epoch key can ever be fetched again, so keeping the
+        futures would pin executables and their closed-over Mesh/device
+        objects forever. Not-yet-running jobs are cancelled (the worker's
+        set_running_or_notify_cancel skips them — no wasted ~15 s remote
+        compile); in-flight ones finish and are garbage-collected with
+        their future."""
+        with self._lock:
+            stale = [k for k in self._futures if predicate(k)]
+            for k in stale:
+                self._futures.pop(k).cancel()
+
     def close(self) -> None:
         """Stop the worker threads; jobs not yet running are cancelled
         (their futures resolve with an exception, so blocking get()s
@@ -206,6 +227,27 @@ class Precompiler:
         # One sentinel per STARTED thread (the env-derived _workers() can
         # have changed since the pool started).
         for _ in range(n):
+            self._q.put(None)
+        # Drain jobs that were already queued BEHIND the sentinels: every
+        # worker may exit on a sentinel before reaching them, which would
+        # leave their futures unresolved and a blocking get() hung. The
+        # sentinels consumed here are re-put for the workers.
+        sentinels = 0
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                sentinels += 1
+                continue
+            fut = item[0]
+            if not fut.done():
+                try:
+                    fut.set_exception(RuntimeError("precompiler closed"))
+                except Exception:  # pragma: no cover - raced with a worker
+                    pass
+        for _ in range(sentinels):
             self._q.put(None)
 
 

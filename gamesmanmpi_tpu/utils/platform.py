@@ -12,20 +12,38 @@ from __future__ import annotations
 
 import os
 
+# Bumped every time force_platform actually clears initialized backends.
+# Kernel caches (solve/engine.py _cache_key) mix this into their keys:
+# executables closed over pre-clear device/Mesh objects would otherwise be
+# reused after a clear and die with "incompatible devices for jitted
+# computation" (the exact failure the full suite hit when every in-process
+# CLI run re-forced an already-active CPU backend).
+_BACKEND_EPOCH = 0
+
+
+def backend_epoch() -> int:
+    return _BACKEND_EPOCH
+
 
 def force_platform(platform: str, fake_devices: int | None = None) -> None:
     """Select a JAX platform robustly; optionally fake N host devices.
 
     Must run before the first jax array/device operation for the XLA_FLAGS
-    part to take effect; if backends already initialized, they are cleared
-    (pre-existing arrays keep their original backend).
+    part to take effect. No-op (beyond config settles) when the requested
+    platform is already the active backend — clearing live backends orphans
+    every cached executable keyed on their device objects. If a genuine
+    switch is needed and backends are initialized, they are cleared and the
+    backend epoch is bumped (pre-existing arrays keep their original
+    backend; epoch-keyed kernel caches rebuild lazily).
     """
+    flags_changed = False
     if fake_devices is not None and platform == "cpu":
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
                 flags + f" --xla_force_host_platform_device_count={fake_devices}"
             ).strip()
+            flags_changed = True
 
     import jax
 
@@ -36,10 +54,26 @@ def force_platform(platform: str, fake_devices: int | None = None) -> None:
 
     from jax._src import xla_bridge
 
-    if xla_bridge.backends_are_initialized():
-        from jax.extend.backend import clear_backends
+    if not xla_bridge.backends_are_initialized():
+        return
 
-        clear_backends()
+    if not flags_changed:
+        # Already initialized: if the active default backend IS the
+        # requested platform, clearing would only poison kernel caches.
+        # (flags_changed means the device count just changed, so the
+        # existing CPU backend is stale and must be rebuilt regardless.)
+        try:
+            current = jax.default_backend()
+        except Exception:  # pragma: no cover - backend probe never raised
+            current = None
+        if current == platform:
+            return
+
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
+    global _BACKEND_EPOCH
+    _BACKEND_EPOCH += 1
 
 
 def force_cpu_if_requested(fake_devices: int | None = None) -> bool:
@@ -69,3 +103,45 @@ def apply_platform_env(default_fake_devices: int | None = None) -> None:
     fake = os.environ.get("GAMESMAN_FAKE_DEVICES")
     fake_devices = int(fake) if fake else default_fake_devices
     force_platform(platform, fake_devices)
+
+
+def platform_auto_flag(name: str, accel: str, cpu: str,
+                       choices: tuple[str, ...]) -> str:
+    """Resolve an env knob with platform-auto default, strictly.
+
+    Reads os.environ[name]; "auto"/unset resolves to `accel` on
+    accelerators and `cpu` on the CPU backend (decided at call time — the
+    kernel builders call this at cache-key time). Any other value must be
+    in `choices`; unknown values raise instead of silently measuring the
+    auto default — these knobs exist for chip A/B runs, where a typo that
+    falls back to auto records two identical configurations.
+    """
+    raw = os.environ.get(name, "auto")
+    if raw in choices:
+        return raw
+    if raw != "auto":
+        raise ValueError(
+            f"{name}={raw!r}: expected one of {('auto',) + choices}"
+        )
+    import jax
+
+    return accel if jax.default_backend() != "cpu" else cpu
+
+
+def platform_auto_bool(name: str, accel: bool, cpu: bool) -> bool:
+    """Boolean twin of platform_auto_flag ("1"/"on"/"true", "0"/"off"/
+    "false", "auto"/unset; anything else raises)."""
+    on, off = ("1", "on", "true"), ("0", "off", "false")
+    raw = os.environ.get(name, "auto").lower()
+    if raw in on:
+        return True
+    if raw in off:
+        return False
+    if raw != "auto":
+        raise ValueError(
+            f"{name}={raw!r}: expected auto, {'/'.join(on)} or "
+            f"{'/'.join(off)}"
+        )
+    import jax
+
+    return accel if jax.default_backend() != "cpu" else cpu

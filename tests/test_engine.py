@@ -111,3 +111,35 @@ def test_store_tables_false_root_only():
         assert (lean.value, lean.remoteness) == (full.value, full.remoteness)
         assert lean.num_positions == full.num_positions
         assert len(lean.levels) == 1  # root only
+
+
+def test_platform_conditional_paths_parity(monkeypatch):
+    """The platform-auto lowerings (provenance forward + speculation,
+    searchsorted method, dedup compaction) resolve differently on CPU vs
+    accelerator; on the CPU suite the accelerator-default side would
+    otherwise go untested end to end. Force each non-default side and
+    assert full-table parity with the default solve."""
+    from helpers import full_table
+
+    g = "connect4:w=4,h=3"
+    base = Solver(get_game(g), paranoid=True).solve()
+    base_tab = full_table(base)
+
+    forced = {
+        "GAMESMAN_PROVENANCE": "1",   # TPU default: provenance forward
+        "GAMESMAN_SPECULATE": "1",    # TPU default: speculative dispatch
+        "GAMESMAN_SEARCH": "sort",    # TPU default: sort-merge join lookup
+        "GAMESMAN_COMPACT": "resort", # TPU default: re-sort compaction
+    }
+    for var, val in forced.items():
+        monkeypatch.setenv(var, val)
+        r = Solver(get_game(g), paranoid=True).solve()
+        assert (r.value, r.remoteness) == (base.value, base.remoteness), var
+        assert full_table(r) == base_tab, var
+        monkeypatch.delenv(var)
+    # All four at once (the exact accelerator configuration).
+    for var, val in forced.items():
+        monkeypatch.setenv(var, val)
+    r = Solver(get_game(g), paranoid=True).solve()
+    assert (r.value, r.remoteness) == (base.value, base.remoteness)
+    assert full_table(r) == base_tab
