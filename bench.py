@@ -188,11 +188,18 @@ def main() -> int:
         # successful run's exactly — that embeds the game object's name,
         # which needs jax — so carry the raw spec alongside.)
         spec = os.environ.get("BENCH_GAME", "connect4")
+        # Full success-record schema (engine/timings/positions/efficiency
+        # zeroed): consumers that index success keys unconditionally must
+        # not break on exactly the path the always-emit design protects.
         record = {
             "metric": spec.split(":")[0] + "_positions_solved_per_sec_per_chip",
             "spec": spec,
             "value": 0.0, "unit": "positions/sec/chip",
-            "vs_baseline": 0.0, "device": "none",
+            "vs_baseline": 0.0, "device": "none", "engine": "none",
+            "secs_forward": 0.0, "secs_backward": 0.0, "positions": 0,
+            "efficiency": {
+                "bytes_sorted": 0, "bytes_gathered": 0, "operand_gbps": 0.0,
+            },
             "error": f"bench failed; attempted: {', '.join(attempts)}",
         }
     # The parent is authoritative for fallback_cpu: a forced CPU run is a
@@ -325,20 +332,37 @@ def inner() -> int:
     best, stats = run_solves(spec, repeats)
 
     # Roofline framing (SURVEY.md §5.5): analytic operand bytes of the
-    # sort/gather kernels vs the chip's HBM bandwidth. v5e HBM is 819 GB/s;
-    # XLA's sort makes ~log2(n) passes, so true HBM traffic is a multiple
-    # of operand bytes — this fraction is a LOWER bound on utilization
-    # (docs/ARCHITECTURE.md "Efficiency accounting").
-    roofline = max(_env_float("GAMESMAN_HBM_GBPS", 819.0), 1e-9)
+    # sort/gather kernels vs the chip's memory bandwidth. v5e HBM is
+    # 819 GB/s; XLA's sort makes ~log2(n) passes, so true traffic is a
+    # multiple of operand bytes — this fraction is a LOWER bound on
+    # utilization (docs/ARCHITECTURE.md "Efficiency accounting"). The
+    # denominator must describe the platform that actually RAN: a CPU
+    # record against a TPU roofline is a misleading artifact (VERDICT r3
+    # weak #4), so CPU runs omit the roofline fields entirely unless
+    # GAMESMAN_HBM_GBPS supplies a measured host figure.
     traffic = stats.get("bytes_sorted", 0) + stats.get("bytes_gathered", 0)
     operand_gbps = traffic / max(stats["secs_total"], 1e-9) / 1e9
     efficiency = {
         "bytes_sorted": stats.get("bytes_sorted", 0),
         "bytes_gathered": stats.get("bytes_gathered", 0),
         "operand_gbps": round(operand_gbps, 3),
-        "hbm_roofline_gbps": roofline,
-        "roofline_frac": round(operand_gbps / roofline, 6),
     }
+    roofline = None
+    roofline_env = os.environ.get("GAMESMAN_HBM_GBPS")
+    if roofline_env is not None:
+        try:
+            roofline = float(roofline_env)
+        except ValueError:
+            # A malformed override must not resurrect the TPU default on a
+            # CPU record — warn and fall through to the platform rule.
+            print(f"GAMESMAN_HBM_GBPS={roofline_env!r} is not a number; "
+                  "ignoring", file=sys.stderr)
+    if roofline is None and dev.platform != "cpu":
+        roofline = 819.0  # v5e HBM
+    if roofline is not None:
+        roofline = max(roofline, 1e-9)
+        efficiency["hbm_roofline_gbps"] = roofline
+        efficiency["roofline_frac"] = round(operand_gbps / roofline, 6)
 
     north_star_per_chip = 4.5e12 / 3600.0 / 32.0  # 39.06M pos/s/chip
     record = {

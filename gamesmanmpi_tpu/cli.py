@@ -151,6 +151,25 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _lookup_checkpoint(game, checkpointer, state):
+    """(value, remoteness) of one position from a checkpoint directory, or
+    None. Canonicalizes and levels the query exactly like the engine, then
+    reads one (level, shard) npz (LevelCheckpointer.lookup_level_state).
+
+    Never raises: the solve already succeeded, so a missing shard file (a
+    multi-host run's remote shard, a torn write) degrades this one query
+    to unanswerable — it must not abort the report or the remaining
+    queries."""
+    from gamesmanmpi_tpu.solve.engine import canonical_scalar
+
+    try:
+        canon, level = canonical_scalar(game, state)
+        return checkpointer.lookup_level_state(level, int(canon))
+    except Exception as e:  # noqa: BLE001 - per-query degradation
+        print(f"warning: checkpoint query failed ({e!r})", file=sys.stderr)
+        return None
+
+
 def _report(result, devices: int, elapsed: float, args, logger) -> None:
     """The rank-0 output block (SURVEY.md §2.1.4), shared by every engine
     path: value + remoteness + elapsed, optional table dump."""
@@ -170,15 +189,33 @@ def _report(result, devices: int, elapsed: float, args, logger) -> None:
 
         save_result_npz(args.table_out, result)
         print(f"table written: {args.table_out}")
+    ckpt = None
+    if args.query and getattr(args, "checkpoint_dir", None):
+        from gamesmanmpi_tpu.utils import LevelCheckpointer
+
+        ckpt = LevelCheckpointer(args.checkpoint_dir)
     for q in args.query or ():
         # The reference prints only the root; point queries answer for any
         # reachable position from the solved table (SolveResult.lookup
-        # canonicalizes, so sym=1 tables answer for all class members).
+        # canonicalizes, so sym=1 tables answer for all class members). In
+        # big-run mode (--no-tables) the in-memory result holds only the
+        # root level, but a --checkpoint-dir run has every solved cell on
+        # disk — serve the query from the per-(level, shard) npz instead
+        # of declaring it unreachable (SURVEY.md §1's by-product contract).
         try:
-            value, rem = result.lookup(int(q, 0))
+            state = int(q, 0)
+            try:
+                value, rem = result.lookup(state)
+            except KeyError:
+                hit = (
+                    _lookup_checkpoint(result.game, ckpt, state)
+                    if ckpt is not None else None
+                )
+                if hit is None:
+                    print(f"query {q}: not reachable")
+                    continue
+                value, rem = hit
             print(f"query {q}: value={value_name(value)} remoteness={rem}")
-        except KeyError:
-            print(f"query {q}: not reachable")
         except (ValueError, OverflowError) as e:
             # Bad literal / doesn't fit the game's state dtype — report per
             # query; the solve itself already succeeded.

@@ -563,7 +563,8 @@ def build_dense_step(tables: DenseTables, level: int, cblock: int,
     """Build the backward step for one level at one block width.
 
     Returned fn:
-      (rank0 i32, child_cells [flat] u8 (dummy at the top level),
+      (rank0 rank_dtype scalar, child_cells [flat] u8 (dummy at the top
+       level),
        binom [ncells+1, K], cellidx [ncells, P] i32, filled [P],
        newbit [P, w], valid [P, w] bool, move_row [P, w] i32,
        move_fill [P, w] i32, child_cellidx [ncells, P, w] i32,
@@ -698,7 +699,7 @@ def build_reach_step(tables: DenseTables, level: int, cblock: int,
     BFS engine discovers — validated against it in the parity tests.
 
     Returned fn:
-      (rank0 i32, parent_reach [flat] u8,
+      (rank0 rank_dtype scalar, parent_reach [flat] u8,
        binom [ncells+1, K], cellidx [ncells, P] i32, filled [P],
        topstone [P, w], parent_row [P, w] i32,
        parent_cellidx [ncells, P, w] i32)
@@ -816,6 +817,16 @@ def _counts_file() -> Optional[str]:
     return os.path.join(pkg_root, ".dense_counts.json")
 
 
+# Bump when the sweep's semantics change (what a "reachable count" means);
+# stamped into every sidecar record so a stale file from an older engine —
+# or a hand-edited one — cannot silently feed the benchmark numerator.
+_COUNTS_SCHEMA_VERSION = 2
+
+
+def _counts_tag(board_key: tuple) -> str:
+    return "x".join(str(k) for k in board_key)
+
+
 def _load_cached_counts(board_key: tuple) -> Optional[Dict[int, int]]:
     path = _counts_file()
     if path is None or not os.path.exists(path):
@@ -823,10 +834,25 @@ def _load_cached_counts(board_key: tuple) -> Optional[Dict[int, int]]:
     try:
         with open(path) as f:
             data = json.load(f)
-        rec = data.get("x".join(str(k) for k in board_key))
-        if rec is None:
+        rec = data.get(_counts_tag(board_key))
+        # Stamp check: version + board echo (unstamped = pre-stamp file or
+        # hand edit -> one re-sweep, not a silently-wrong headline metric).
+        if (
+            not isinstance(rec, dict)
+            or rec.get("version") != _COUNTS_SCHEMA_VERSION
+            or rec.get("board") != _counts_tag(board_key)
+            or not isinstance(rec.get("counts"), dict)
+        ):
             return None
-        return {int(k): int(v) for k, v in rec.items()}
+        counts = {int(k): int(v) for k, v in rec["counts"].items()}
+        # Cheap invariants of any valid sweep: one empty board at level 0,
+        # non-negative counts, levels within the cell count.
+        w, h = board_key[0], board_key[1]
+        if counts.get(0) != 1 or any(
+            v < 0 or k < 0 or k > w * h for k, v in counts.items()
+        ):
+            return None
+        return counts
     except (OSError, ValueError):
         return None
 
@@ -836,23 +862,43 @@ def _store_cached_counts(board_key: tuple, counts: Dict[int, int]) -> None:
     if path is None:
         return
     try:
-        data = {}
-        if os.path.exists(path):
+        import contextlib
+
+        try:
+            import fcntl
+        except ImportError:  # pragma: no cover - non-POSIX: lockless
+            fcntl = None
+
+        with contextlib.ExitStack() as stack:
+            # Serialize load-merge-replace across writer processes: two
+            # boards finishing sweeps concurrently must not drop each
+            # other's fresh entry (last-replace-wins on the merged dict).
             try:
-                with open(path) as f:
-                    data = json.load(f)
-            except ValueError:
-                # Corrupt file (torn write, manual edit): overwrite rather
-                # than silently abandoning the cache forever.
-                data = {}
-        data["x".join(str(k) for k in board_key)] = {
-            str(k): v for k, v in counts.items()
-        }
-        tmp = f"{path}.{os.getpid()}.tmp"  # private per writer: a shared
-        # .tmp name lets a concurrent writer truncate it mid-publish
-        with open(tmp, "w") as f:
-            json.dump(data, f)
-        os.replace(tmp, path)
+                if fcntl is not None:
+                    lockf = stack.enter_context(open(f"{path}.lock", "w"))
+                    fcntl.flock(lockf, fcntl.LOCK_EX)
+            except OSError:  # pragma: no cover - lockless best effort
+                pass
+            data = {}
+            if os.path.exists(path):
+                try:
+                    with open(path) as f:
+                        data = json.load(f)
+                except ValueError:
+                    # Corrupt file (torn write, manual edit): overwrite
+                    # rather than silently abandoning the cache forever.
+                    data = {}
+            data[_counts_tag(board_key)] = {
+                "version": _COUNTS_SCHEMA_VERSION,
+                "board": _counts_tag(board_key),
+                "counts": {str(k): v for k, v in counts.items()},
+            }
+            tmp = f"{path}.{os.getpid()}.tmp"  # private per writer: a
+            # shared .tmp name lets a concurrent writer truncate it
+            # mid-publish
+            with open(tmp, "w") as f:
+                json.dump(data, f)
+            os.replace(tmp, path)
     except (OSError, ValueError):  # pragma: no cover - best-effort cache
         pass
 
@@ -955,6 +1001,12 @@ class DenseSolver:
                               sorted_gather=sg),
         )
 
+    def _rank0(self, b: int, cblock: int):
+        """First rank of block b, in rank_dtype: Python ints don't
+        overflow, so typing the scalar here keeps b*cblock exact past
+        2^31 (uint64 boards like 6x6's C(36,18)=9.1e9 top classes)."""
+        return self._rank_dtype(b * cblock)
+
     def _cblock(self, level: int) -> tuple[int, int]:
         P = len(self.tables.profiles[level])
         C = self.tables.class_size[level]
@@ -976,7 +1028,8 @@ class DenseSolver:
         dt = t.bits_dtype
         rk = np.uint32 if self._rank_dtype == jnp.uint32 else np.uint64
         common = (
-            sds((), np.int32),
+            sds((), rk),  # rank0: rank_dtype end to end (i32 overflows
+            # past 2^31 ranks, e.g. C(36,18)=9.1e9 at 6x6 level 36)
             sds((flat,), np.uint8),
             sds((nc1, t.n1_width), rk),
             sds((t.ncells, P), np.int32),
@@ -1126,7 +1179,7 @@ class DenseSolver:
             cnt = None
             for b in range(nblk):
                 r_b, c_b = step(
-                    jnp.int32(b * cblock), reach_flat,
+                    self._rank0(b, cblock), reach_flat,
                     consts["binom"], consts["cellidx"], consts["filled"],
                     consts["topstone"], consts["parent_row"],
                     consts["parent_cellidx"],
@@ -1176,7 +1229,7 @@ class DenseSolver:
             blocks = []
             for b in range(nblk):
                 blocks.append(step(
-                    jnp.int32(b * cblock), child_flat,
+                    self._rank0(b, cblock), child_flat,
                     consts["binom"], consts["cellidx"], consts["filled"],
                     consts["newbit"], consts["valid"],
                     consts["move_row"], consts["move_fill"],

@@ -159,6 +159,50 @@ class LevelCheckpointer:
         with np.load(self._shard_level_path(level, shard)) as z:
             return z["states"], z["cells"]
 
+    def lookup_level_state(self, level: int, state):
+        """(value, remoteness) of one CANONICAL packed state, served from
+        this directory's files — or None when the level/state is absent.
+
+        The big-run query path (SURVEY.md §1: every reachable position is a
+        by-product of the solve): with store_tables=False nothing lives in
+        host memory, but the checkpoint holds every solved cell. Reads the
+        global level file when present; otherwise exactly ONE
+        (level, shard) file, chosen by the same owner hash that routed the
+        state during the solve — never assembles the level.
+        """
+        cache = getattr(self, "_lookup_cache", None)
+        path = self._level_path(level)
+        if path.exists():
+            cache_key = (level, None)
+        else:
+            num = self.level_shard_count(level)
+            if num is None:
+                return None
+            from gamesmanmpi_tpu.core.hashing import owner_shard_np
+
+            shard = int(owner_shard_np(
+                np.asarray([state], dtype=np.uint64), num
+            )[0])
+            cache_key = (level, shard)
+        if cache is not None and cache[0] == cache_key:
+            states, cells = cache[1]
+        elif cache_key[1] is None:
+            with np.load(path) as z:
+                states, cells = z["states"], z["cells"]
+        else:
+            states, cells = self.load_level_shard(level, cache_key[1])
+        # Memoize the last-loaded table: a batch of point queries often
+        # lands in the same (level, shard), and at big-run scale one shard
+        # file is a multi-hundred-MB read.
+        self._lookup_cache = (cache_key, (states, cells))
+        # Per-shard slices keep the engine's sorted invariant; the global
+        # file is sorted by construction.
+        i = int(np.searchsorted(states, states.dtype.type(state)))
+        if i >= states.shape[0] or int(states[i]) != int(state):
+            return None
+        values, remoteness = unpack_cells_np(cells[i : i + 1])
+        return int(values[0]), int(remoteness[0])
+
     def save_frontier_shard(self, shard: int, pools) -> None:
         """One shard's slice of every frontier level, one file."""
         arrays = {

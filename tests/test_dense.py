@@ -234,6 +234,27 @@ def test_dense_counts_file_roundtrip(tmp_path, monkeypatch):
     dmod._store_cached_counts(key, counts)
     assert dmod._load_cached_counts(key) == counts
     assert dmod._load_cached_counts((9, 9, 4)) is None
+
+    # The sidecar feeds the benchmark numerator, so records are stamped:
+    # an unstamped/foreign record (old engine, hand edit) must be refused
+    # and re-swept, not trusted.
+    import json
+
+    data = json.loads(path.read_text())
+    tag = dmod._counts_tag(key)
+    assert data[tag]["version"] == dmod._COUNTS_SCHEMA_VERSION
+    assert data[tag]["board"] == tag
+
+    for tamper in (
+        {tag: {"0": 1, "1": 3}},  # pre-stamp format
+        {tag: {**data[tag], "version": -1}},  # wrong engine version
+        {tag: {**data[tag], "board": "9x9x9"}},  # copied entry
+        {tag: {**data[tag], "counts": {"0": 2, "1": 3}}},  # bad invariant
+        {tag: {**data[tag], "counts": {"99": 5, "0": 1}}},  # level > cells
+    ):
+        path.write_text(json.dumps(tamper))
+        assert dmod._load_cached_counts(key) is None
+
     # Disabled cache reads/writes nothing.
     monkeypatch.setenv("GAMESMAN_DENSE_COUNTS_FILE", "0")
     assert dmod._load_cached_counts(key) is None

@@ -367,3 +367,42 @@ def test_force_platform_noop_and_epoch_keying(monkeypatch):
     assert _cache_key(game, "k", (1,), lowering=()) != key_old
     tables.drop_stale_device_caches()
     assert tables._dev_binom is None and not tables._dev_consts
+
+
+def test_cli_query_from_shard_checkpoints_no_tables(tmp_path, capsys):
+    """SURVEY §1's by-product contract at big-run scale (VERDICT r3
+    missing #4): with --no-tables nothing is materialized in host memory,
+    but --checkpoint-dir holds every solved cell as per-(level, shard)
+    npz — --query must answer from those files (one shard read, chosen by
+    the owner hash), not report 'not reachable'."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 fake devices")
+    from gamesmanmpi_tpu.core.values import value_name
+
+    # Reference answers from a full in-memory solve.
+    full = Solver(get_game("tictactoe")).solve()
+    picks = []
+    for level in sorted(full.levels):
+        states = full.levels[level].states
+        if states.shape[0] and level > 0:
+            picks.append(int(states[states.shape[0] // 2]))
+        if len(picks) == 5:
+            break
+    assert len(picks) == 5
+
+    d = str(tmp_path / "bigrun")
+    argv = ["tictactoe", "--devices", "4", "--no-tables",
+            "--checkpoint-dir", d]
+    for s in picks:
+        argv += ["--query", hex(s)]
+    rc = cli_main(argv)
+    out = capsys.readouterr().out
+    assert rc == 0
+    for s in picks:
+        v, r = full.lookup(s)
+        assert (
+            f"query {hex(s)}: value={value_name(v)} remoteness={r}" in out
+        )
+    assert "not reachable" not in out
