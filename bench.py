@@ -248,6 +248,20 @@ def inner() -> int:
 
     def make_solver(game):
         nonlocal bench_engine
+        if bench_engine == "hybrid" and isinstance(game, Connect4) \
+                and not game.sym:
+            try:
+                from gamesmanmpi_tpu.solve.hybrid import HybridSolver
+
+                return HybridSolver(game, store_tables=False)
+            except Exception as e:
+                print(
+                    f"hybrid engine setup failed "
+                    f"({type(e).__name__}: {e}); demoting to the classic "
+                    "engine",
+                    file=sys.stderr,
+                )
+                bench_engine = "classic"
         if bench_engine == "dense" and isinstance(game, Connect4) \
                 and not game.sym:
             # The reachable count is a per-board constant, not part of the
@@ -303,10 +317,12 @@ def inner() -> int:
                 # a classic failure (e.g. during the sym run, which always
                 # uses classic) must propagate, not mislabel the dense
                 # engine and silently demote the remaining runs.
-                if type(solver).__name__ == "DenseSolver":
+                if type(solver).__name__ in ("DenseSolver",
+                                             "HybridSolver"):
                     print(
-                        f"dense engine failed ({type(e).__name__}: {e}); "
-                        "demoting to the classic engine",
+                        f"{type(solver).__name__} failed "
+                        f"({type(e).__name__}: {e}); demoting to the "
+                        "classic engine",
                         file=sys.stderr,
                     )
                     bench_engine = "classic"

@@ -81,12 +81,22 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--engine",
-        choices=("auto", "classic", "dense"),
+        choices=("auto", "classic", "dense", "hybrid"),
         default="auto",
         help="solver engine: 'classic' = level-BFS discovery (all games); "
         "'dense' = class-partitioned perfect-indexing engine (Connect-4 "
         "family, single device, sym=0 — no sorts, 1 byte/position); "
-        "'auto' picks dense when eligible",
+        "'hybrid' = dense below --hybrid-cutover, BFS above (giant "
+        "boards, same eligibility as dense); 'auto' picks dense when "
+        "eligible",
+    )
+    p.add_argument(
+        "--hybrid-cutover",
+        type=int,
+        default=None,
+        metavar="K",
+        help="last dense level of --engine hybrid (default: 2/3 of the "
+        "board's cells; see solve/hybrid.py)",
     )
     p.add_argument(
         "--query",
@@ -292,12 +302,12 @@ def _main(args) -> int:
         checkpointer = LevelCheckpointer(args.checkpoint_dir)
 
     if pathlib.Path(args.game).is_file():
-        if args.engine == "dense":
+        if args.engine in ("dense", "hybrid"):
             # The validation below never runs on the compat path; without
-            # this, --engine dense would be silently ignored here.
+            # this, --engine dense/hybrid would be silently ignored here.
             print(
-                "error: --engine dense applies to the built-in Connect-4 "
-                "family, not compat game modules",
+                f"error: --engine {args.engine} applies to the built-in "
+                "Connect-4 family, not compat game modules",
                 file=sys.stderr,
             )
             return 2
@@ -409,10 +419,10 @@ def _main(args) -> int:
         and not args.checkpoint_dir and not args.paranoid
         and not args.table_out
     )
-    if args.engine == "dense" and not dense_eligible:
+    if args.engine in ("dense", "hybrid") and not dense_eligible:
         print(
-            "error: --engine dense needs a Connect-4-family game with "
-            "sym=0, --devices 1, and no --checkpoint-dir/--paranoid/"
+            f"error: --engine {args.engine} needs a Connect-4-family game "
+            "with sym=0, --devices 1, and no --checkpoint-dir/--paranoid/"
             "--table-out (those live in the classic engine)",
             file=sys.stderr,
         )
@@ -425,7 +435,22 @@ def _main(args) -> int:
 
         if jax.devices()[0].platform == "cpu":
             dense_eligible = False
-    if args.engine != "classic" and dense_eligible:
+    if args.engine == "hybrid":
+        from gamesmanmpi_tpu.solve.hybrid import HybridSolver
+
+        try:
+            solver = HybridSolver(
+                game,
+                cutover=args.hybrid_cutover,
+                store_tables=not args.no_tables,
+                logger=logger,
+            )
+        except ValueError as e:
+            # Bad --hybrid-cutover / GAMESMAN_HYBRID_CUTOVER: CLI misuse
+            # exits 2 with a message, like every other argument error.
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    elif args.engine != "classic" and dense_eligible:
         from gamesmanmpi_tpu.solve.dense import DenseSolver
 
         solver = DenseSolver(

@@ -1,6 +1,7 @@
 """solve: the solver engines (single-device sweep, dense class engine,
-host oracle). The dense engine lives in solve.dense and is imported lazily
-by its users (CLI, bench) — it is Connect-4-family specific."""
+dense/BFS hybrid, host oracle). The dense and hybrid engines live in
+solve.dense / solve.hybrid and are imported lazily by their users (CLI,
+bench) — they are Connect-4-family specific."""
 
 from gamesmanmpi_tpu.solve.engine import Solver, SolveResult, LevelTable
 from gamesmanmpi_tpu.solve.oracle import oracle_solve

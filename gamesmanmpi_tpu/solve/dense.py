@@ -217,9 +217,15 @@ class DenseTables:
         dt = self.bits_dtype
 
         filled = np.zeros(P, np.uint64)
+        # Guard bits of the game's packed encoding (one 1 per column at its
+        # height): packed state = current-player stones | guards. The
+        # hybrid engine's boundary kernels build/emit packed states from
+        # dense (row, rank) coordinates with these.
+        guards = np.zeros(P, np.uint64)
         for c in range(w):
             col = (np.uint64(1) << prof[:, c].astype(np.uint64)) - np.uint64(1)
             filled |= col << np.uint64(c * h1)
+            guards |= np.uint64(1) << (prof[:, c] + c * h1).astype(np.uint64)
 
         newbit = np.zeros((P, w), np.uint64)   # cell (c, h_c): the drop target
         topstone = np.zeros((P, w), np.uint64)  # cell (c, h_c - 1): last drop
@@ -296,6 +302,7 @@ class DenseTables:
 
         consts = {
             "filled": filled.astype(dt),
+            "guards": guards.astype(dt),
             "newbit": newbit.astype(dt),
             "topstone": topstone.astype(dt),
             "valid": valid,
@@ -1061,7 +1068,8 @@ class DenseSolver:
             str(self._rank_dtype), str(self._flat_dtype),
         )
 
-    def schedule_compiles(self, reach_first: bool = False) -> None:
+    def schedule_compiles(self, reach_first: bool = False,
+                          last_level: Optional[int] = None) -> None:
         """Queue background compiles of EVERY level's kernels.
 
         Unlike the BFS engine's speculative capacity ladder, the dense
@@ -1069,9 +1077,12 @@ class DenseSolver:
         first kernel runs, so the precompiler pool can overlap the whole
         set with the early levels' execution (the relay charges ~15 s per
         serial compile; docs/ARCHITECTURE.md "Where the time went").
+
+        last_level bounds both phases (the hybrid engine runs dense
+        kernels only up to its cutover region).
         """
         t = self.tables
-        nc = t.ncells
+        nc = t.ncells if last_level is None else min(last_level, t.ncells)
 
         def sched(kind, level, builder, for_reach):
             cblock, _ = self._cblock(level)
@@ -1156,22 +1167,29 @@ class DenseSolver:
 
     # -- reachability sweep -------------------------------------------------
 
-    def reachable_counts(self) -> Dict[int, int]:
-        """Exact per-level reachable-position counts (cached per process)."""
-        cached = _REACH_COUNTS.get(self._board_key)
-        if cached is not None:
-            return cached
-        cached = _load_cached_counts(self._board_key)
-        if cached is not None:
-            _REACH_COUNTS[self._board_key] = cached
-            return cached
+    def _maybe_drain(self, added_cells: int, ref) -> bool:
+        """Run-ahead control shared by every dense level loop (sweep,
+        backward, and the hybrid's copies of both): after sync_cells cells
+        of async dispatch, force a 1-byte fetch so the host cannot enqueue
+        every level's buffers before any kernel retires (the round-2 OOM;
+        see __init__)."""
+        self._undrained = getattr(self, "_undrained", 0) + added_cells
+        if self._undrained > self.sync_cells:
+            np.asarray(ref[:1])
+            self._undrained = 0
+            return True
+        return False
+
+    def _sweep_levels(self, last_level: int):
+        """The reach-sweep loop 1..last_level: -> (counts {0..last_level},
+        reach_flat [P*C] u8 at last_level, on device). Shared by
+        reachable_counts (full sweep) and the hybrid engine (sweep to its
+        boundary); includes the run-ahead drain."""
         t = self.tables
-        nc = t.ncells
-        self.schedule_compiles(reach_first=True)
         reach_flat = jnp.ones((1,), jnp.uint8)  # level 0: the root
-        undrained = 0  # cells enqueued since the last drain (see __init__)
+        self._undrained = 0
         counts_dev: Dict[int, jnp.ndarray] = {}
-        for L in range(1, nc + 1):
+        for L in range(1, last_level + 1):
             cblock, nblk = self._cblock(L)
             step = self._kernel("dense_reach", L, cblock, build_reach_step)
             consts = self._upload_consts(L, for_reach=True)
@@ -1193,16 +1211,49 @@ class DenseSolver:
             if nblk * cblock != C:
                 level_reach = level_reach[:, :C]
             reach_flat = level_reach.reshape(-1)
-            undrained += len(t.profiles[L]) * C
-            if undrained > self.sync_cells:
-                np.asarray(reach_flat[:1])  # drain run-ahead (see __init__)
-                undrained = 0
+            self._maybe_drain(len(t.profiles[L]) * C, reach_flat)
             counts_dev[L] = cnt
         counts = {0: 1}
         counts.update({L: int(v) for L, v in counts_dev.items()})
+        return counts, reach_flat
+
+    def reachable_counts(self) -> Dict[int, int]:
+        """Exact per-level reachable-position counts (cached per process)."""
+        cached = _REACH_COUNTS.get(self._board_key)
+        if cached is not None:
+            return cached
+        cached = _load_cached_counts(self._board_key)
+        if cached is not None:
+            _REACH_COUNTS[self._board_key] = cached
+            return cached
+        self.schedule_compiles(reach_first=True)
+        counts, _ = self._sweep_levels(self.tables.ncells)
         _REACH_COUNTS[self._board_key] = counts
         _store_cached_counts(self._board_key, counts)
         return counts
+
+    def _backward_level(self, L: int, child_flat):
+        """One dense backward level (blocked, no sync): the deeper level's
+        flat cells -> this level's [P, C] cells. Shared by solve() and the
+        hybrid's below-cutover loop."""
+        t = self.tables
+        C = t.class_size[L]
+        cblock, nblk = self._cblock(L)
+        step = self._kernel("dense_step", L, cblock, build_dense_step)
+        consts = self._upload_consts(L, for_reach=False)
+        blocks = []
+        for b in range(nblk):
+            blocks.append(step(
+                self._rank0(b, cblock), child_flat,
+                consts["binom"], consts["cellidx"], consts["filled"],
+                consts["newbit"], consts["valid"],
+                consts["move_row"], consts["move_fill"],
+                consts["child_cellidx"], consts["snapk"],
+            ))
+        cells = blocks[0] if nblk == 1 else jnp.concatenate(blocks, axis=1)
+        if nblk * cblock != C:
+            cells = cells[:, :C]
+        return cells
 
     # -- the solve ----------------------------------------------------------
 
@@ -1216,37 +1267,16 @@ class DenseSolver:
             {} if self.store_tables else None
         )
         child_flat = jnp.zeros((1,), jnp.uint8)  # dummy for the top level
-        undrained = 0  # cells enqueued since the last drain (see __init__)
+        self._undrained = 0
         last_drain = t0  # drains are the only real sync points, so they
         # are the only honest per-segment timestamps (dispatch is async)
         for L in range(nc, -1, -1):
             P = len(t.profiles[L])
             C = t.class_size[L]
             encodable_total += P * C
-            cblock, nblk = self._cblock(L)
-            step = self._kernel("dense_step", L, cblock, build_dense_step)
-            consts = self._upload_consts(L, for_reach=False)
-            blocks = []
-            for b in range(nblk):
-                blocks.append(step(
-                    self._rank0(b, cblock), child_flat,
-                    consts["binom"], consts["cellidx"], consts["filled"],
-                    consts["newbit"], consts["valid"],
-                    consts["move_row"], consts["move_fill"],
-                    consts["child_cellidx"], consts["snapk"],
-                ))
-            level_cells = (
-                blocks[0] if nblk == 1 else jnp.concatenate(blocks, axis=1)
-            )
-            if nblk * cblock != C:
-                level_cells = level_cells[:, :C]
+            level_cells = self._backward_level(L, child_flat)
             child_flat = level_cells.reshape(-1)
-            undrained += P * C
-            drained = False
-            if undrained > self.sync_cells:
-                np.asarray(child_flat[:1])  # drain run-ahead (see __init__)
-                undrained = 0
-                drained = True
+            drained = self._maybe_drain(P * C, child_flat)
             if self.logger is not None:
                 rec = {
                     "phase": "dense_backward", "level": L, "classes": P,

@@ -801,9 +801,12 @@ class Solver:
         """
         g = self.game
         levels: Dict[int, _Level] = {}
-        host0 = np.array([init], dtype=g.state_dtype)
-        frontier = jnp.asarray(pad_to(host0, self.min_bucket))
-        levels[start_level] = _Level(1, host0, frontier)
+        # init: one root state, or a whole sorted frontier (the hybrid
+        # engine starts BFS at its cutover level's reachable set).
+        host0 = np.atleast_1d(np.asarray(init, dtype=g.state_dtype))
+        cap0 = bucket_size(host0.shape[0], self.min_bucket)
+        frontier = jnp.asarray(pad_to(host0, cap0))
+        levels[start_level] = _Level(host0.shape[0], host0, frontier)
         stored_bytes = frontier.nbytes
         k = start_level
         # Speculation hides the ~65 ms relay host-sync; on CPU the sync is

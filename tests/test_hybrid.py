@@ -1,0 +1,114 @@
+"""Hybrid dense/BFS engine: bit-identical to both component engines.
+
+The hybrid is pure implementation strategy (SURVEY.md §1's contract is
+value+remoteness of every reachable position); these tests pin it to the
+classic solver's full tables across cutover placements, including the
+degenerate ends where one engine does almost all the work.
+"""
+
+import numpy as np
+import pytest
+
+from gamesmanmpi_tpu.games import get_game
+from gamesmanmpi_tpu.solve import Solver
+from gamesmanmpi_tpu.solve.hybrid import HybridSolver, default_cutover
+
+
+def _full_parity(spec: str, cutovers):
+    g = get_game(spec)
+    ref = Solver(g).solve()
+    for K in cutovers:
+        hy = HybridSolver(get_game(spec), cutover=K).solve()
+        assert (hy.value, hy.remoteness) == (ref.value, ref.remoteness), K
+        # Reachable count must match the BFS discovery exactly (the dense
+        # sweep and the BFS frontier are cross-checked inside solve too).
+        assert hy.num_positions == ref.num_positions, K
+        for level, table in ref.levels.items():
+            for i in range(table.states.shape[0]):
+                s = int(table.states[i])
+                assert hy.lookup(s) == (
+                    int(table.values[i]), int(table.remoteness[i])
+                ), (K, level, hex(s))
+
+
+def test_hybrid_full_parity_3x3c3():
+    # Cutovers spanning the whole range: K=0 (dense solves only the empty
+    # board), the default, and K=ncells-1 (BFS solves only the full level).
+    _full_parity("connect4:w=3,h=3,connect=3", (0, 3, default_cutover(9), 8))
+
+
+def test_hybrid_full_parity_4x3():
+    _full_parity("connect4:w=4,h=3", (5, 8))
+
+
+def test_hybrid_validates_args():
+    g4 = get_game("connect4:w=3,h=3,connect=3")
+    with pytest.raises(ValueError, match="cutover"):
+        HybridSolver(g4, cutover=9)  # == ncells: no BFS region
+    with pytest.raises(ValueError, match="cutover"):
+        HybridSolver(g4, cutover=-1)
+    with pytest.raises(ValueError, match="sym"):
+        HybridSolver(get_game("connect4:w=3,h=3,connect=3,sym=1"))
+    with pytest.raises(TypeError):
+        HybridSolver(get_game("tictactoe"))
+
+
+def test_hybrid_env_cutover(monkeypatch):
+    monkeypatch.setenv("GAMESMAN_HYBRID_CUTOVER", "4")
+    hy = HybridSolver(get_game("connect4:w=3,h=3,connect=3"))
+    assert hy.cutover == 4
+
+
+def test_hybrid_no_tables_root_only():
+    g = get_game("connect4:w=3,h=3,connect=3")
+    ref = Solver(g).solve()
+    hy = HybridSolver(g, store_tables=False, cutover=5).solve()
+    assert (hy.value, hy.remoteness, hy.num_positions) == (
+        ref.value, ref.remoteness, ref.num_positions
+    )
+    with pytest.raises(KeyError):
+        hy.lookup(int(g.initial_state()))
+
+
+def test_hybrid_garbage_lookup_refused():
+    """Dense-side lookup refuses the fabricated mover-already-won class,
+    exactly like DenseSolveResult.lookup."""
+    g = get_game("connect4:w=3,h=3,connect=3")
+    hy = HybridSolver(g, cutover=6).solve()
+    # Level 6, heights (3,2,1): the player to move (p1, 3 stones) owns all
+    # of column 0 — a completed vertical line of their own, so this cell
+    # is a fabricated terminal, never a position.
+    h1 = 4
+    guards = (1 << 3) | (1 << (h1 + 2)) | (1 << (2 * h1 + 1))
+    current = 0b111  # the mover's own completed line in column 0
+    with pytest.raises(KeyError, match="line"):
+        hy.lookup(guards | current)
+
+
+def test_cli_engine_hybrid(capsys):
+    from gamesmanmpi_tpu.cli import main as cli_main
+
+    rc = cli_main(["connect4:w=3,h=3,connect=3", "--engine", "hybrid",
+                   "--hybrid-cutover", "5"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "positions: 694" in out
+    assert "value: TIE" in out
+
+    # Eligibility errors mirror the dense engine's.
+    rc = cli_main(["tictactoe", "--engine", "hybrid"])
+    assert rc == 2
+
+
+def test_cli_hybrid_bad_cutover_exits_cleanly(capsys, monkeypatch):
+    from gamesmanmpi_tpu.cli import main as cli_main
+
+    rc = cli_main(["connect4:w=3,h=3,connect=3", "--engine", "hybrid",
+                   "--hybrid-cutover", "99"])
+    assert rc == 2
+    assert "cutover" in capsys.readouterr().err
+
+    monkeypatch.setenv("GAMESMAN_HYBRID_CUTOVER", "24k")
+    rc = cli_main(["connect4:w=3,h=3,connect=3", "--engine", "hybrid"])
+    assert rc == 2
+    assert "not an integer" in capsys.readouterr().err
