@@ -414,16 +414,27 @@ def _main(args) -> int:
             return 2
     from gamesmanmpi_tpu.games.connect4 import Connect4
 
-    dense_eligible = (
-        isinstance(game, Connect4) and not game.sym and args.devices == 1
+    family_ok = (
+        isinstance(game, Connect4) and not game.sym
         and not args.checkpoint_dir and not args.paranoid
         and not args.table_out
     )
-    if args.engine in ("dense", "hybrid") and not dense_eligible:
+    dense_eligible = family_ok and args.devices == 1
+    # The hybrid's dense region is single-device, but its BFS region runs
+    # on the sharded engine when --devices > 1.
+    if args.engine == "dense" and not dense_eligible:
         print(
-            f"error: --engine {args.engine} needs a Connect-4-family game "
+            "error: --engine dense needs a Connect-4-family game "
             "with sym=0, --devices 1, and no --checkpoint-dir/--paranoid/"
             "--table-out (those live in the classic engine)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.engine == "hybrid" and not family_ok:
+        print(
+            "error: --engine hybrid needs a Connect-4-family game with "
+            "sym=0 and no --checkpoint-dir/--paranoid/--table-out "
+            "(those live in the classic engine)",
             file=sys.stderr,
         )
         return 2
@@ -444,6 +455,7 @@ def _main(args) -> int:
                 cutover=args.hybrid_cutover,
                 store_tables=not args.no_tables,
                 logger=logger,
+                devices=args.devices,
             )
         except ValueError as e:
             # Bad --hybrid-cutover / GAMESMAN_HYBRID_CUTOVER: CLI misuse

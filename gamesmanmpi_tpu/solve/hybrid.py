@@ -242,10 +242,19 @@ class HybridSolver:
 
     cutover: last dense level K (0 <= K < ncells). None reads
     GAMESMAN_HYBRID_CUTOVER, else default_cutover(ncells).
+
+    devices: 1 = single-device BFS side (solve.Solver); >1 = the
+    owner-routed ShardedSolver over a devices-wide mesh — the sweep,
+    extraction and the dense region stay single-device (dense arrays are
+    closed-form and 1 byte/position; at cutovers where they would not
+    fit one chip the cutover is wrong, see the ARCHITECTURE table), while
+    the BFS region — where the reachable set and the sort work live —
+    scales across the mesh.
     """
 
     def __init__(self, game: Connect4, cutover: Optional[int] = None,
-                 store_tables: bool = True, logger=None):
+                 store_tables: bool = True, logger=None,
+                 devices: int = 1):
         if not isinstance(game, Connect4):
             raise TypeError("HybridSolver requires a Connect4-family game")
         if game.sym:
@@ -254,6 +263,9 @@ class HybridSolver:
         self.game = game
         self.store_tables = store_tables
         self.logger = logger
+        self.devices = int(devices)
+        if self.devices < 1:
+            raise ValueError(f"devices must be >= 1, got {devices}")
         # The dense half (kernels, consts, tables); its reach sweep is run
         # partially by this class, so disable its own full sweep.
         self.dense = DenseSolver(game, store_tables=store_tables,
@@ -411,16 +423,31 @@ class HybridSolver:
         self._log(phase="hybrid_sweep", boundary=B, frontier=counts[B],
                   secs=round(t_sweep, 3))
 
-        # Phase 3: BFS over levels B..N from the extracted frontier.
-        # _forward_fast/_backward_fast are driven directly (no root
-        # lookup), so the solve()-time knob resolution happens here.
-        bfs = Solver(g, store_tables=self.store_tables)
-        bfs.use_provenance = platform_auto_bool(
-            "GAMESMAN_PROVENANCE", accel=True, cpu=False
-        )
-        levels = bfs._forward_fast(frontier, B)
-        bfs_counts = {L: rec.n for L, rec in levels.items()}
-        resolved = bfs._backward_fast(levels, root_level=B)
+        # Phase 3: BFS over levels B..N from the extracted frontier —
+        # single-device or owner-routed sharded, per `devices`. The
+        # engines' internals are driven directly (no root lookup), so the
+        # solve()-time knob resolution happens here for the single-device
+        # path; the sharded path resolves its own.
+        if self.devices > 1:
+            from gamesmanmpi_tpu.parallel import ShardedSolver
+
+            bfs = ShardedSolver(g, num_shards=self.devices,
+                                store_tables=self.store_tables)
+            bfs.materialize_root_table = True  # the boundary join reads B
+            levels = bfs._forward_fast(frontier, B)
+            bfs_counts = {L: int(rec.counts.sum())
+                          for L, rec in levels.items()}
+            resolved = bfs._backward(
+                levels, B, int(frontier[0]) if frontier.size else 0
+            )
+        else:
+            bfs = Solver(g, store_tables=self.store_tables)
+            bfs.use_provenance = platform_auto_bool(
+                "GAMESMAN_PROVENANCE", accel=True, cpu=False
+            )
+            levels = bfs._forward_fast(frontier, B)
+            bfs_counts = {L: rec.n for L, rec in levels.items()}
+            resolved = bfs._backward_fast(levels, root_level=B)
         k1_table = resolved[B]
         t_bfs = time.perf_counter() - t0 - t_sweep
         self._log(phase="hybrid_bfs", levels=len(bfs_counts),

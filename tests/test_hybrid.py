@@ -112,3 +112,38 @@ def test_cli_hybrid_bad_cutover_exits_cleanly(capsys, monkeypatch):
     rc = cli_main(["connect4:w=3,h=3,connect=3", "--engine", "hybrid"])
     assert rc == 2
     assert "not an integer" in capsys.readouterr().err
+
+
+def test_hybrid_sharded_bfs_parity():
+    """devices>1 routes the BFS region through the owner-routed
+    ShardedSolver on the fake mesh; the result must be bit-identical to
+    the single-device hybrid and the classic solver."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 fake devices")
+    g = get_game("connect4:w=3,h=3,connect=3")
+    ref = Solver(g).solve()
+    hy = HybridSolver(g, cutover=4, devices=4).solve()
+    assert (hy.value, hy.remoteness) == (ref.value, ref.remoteness)
+    assert hy.num_positions == ref.num_positions
+    for level, table in ref.levels.items():
+        for i in range(table.states.shape[0]):
+            s = int(table.states[i])
+            assert hy.lookup(s) == (
+                int(table.values[i]), int(table.remoteness[i])
+            ), (level, hex(s))
+
+
+def test_hybrid_sharded_no_tables():
+    """Big-run sharded hybrid: only the boundary table materializes (the
+    seam needs it); the result still answers root + counts exactly."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 fake devices")
+    g = get_game("connect4:w=3,h=3,connect=3")
+    hy = HybridSolver(g, cutover=4, devices=4, store_tables=False).solve()
+    assert (hy.value, hy.remoteness, hy.num_positions) == (3, 9, 694)
+    with pytest.raises(KeyError):
+        hy.lookup(int(g.initial_state()))
