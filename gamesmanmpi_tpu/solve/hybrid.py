@@ -189,6 +189,111 @@ def build_boundary_step(tables, level: int, cblock: int, wcap: int,
     return step
 
 
+def build_boundary_children_step(tables, level: int, cblock: int,
+                                 rank_dtype, use_onehot: bool):
+    """Streamed boundary, phase 1: one rank block's packed children.
+
+    Returned fn:
+      (rank0, binom, cellidx, filled, guards, newbit)
+      -> (children [P, cblock, w] state_dtype, prim_mask [P, cblock] bool)
+
+    Same unrank/line/drop algebra as build_boundary_step, but the children
+    are EMITTED so the per-window-block lookups (phase 2) never repeat the
+    unrank walks — the dense engine's whole economy is amortizing them.
+    """
+    w, h, connect = tables.width, tables.height, tables.connect
+    dt = jnp.uint64 if tables.bits_dtype == np.uint64 else jnp.uint32
+    n1 = n1_of_level(level)
+    p1_moves = level % 2 == 0
+    mover_is_p1 = level % 2 == 1
+    bitpos = [int(b) for b in tables.bitpos]
+
+    def step(rank0, binom, cellidx, filled, guards, newbit):
+        p1 = _unrank_bits(
+            (rank0.astype(rank_dtype)
+             + jax.lax.iota(rank_dtype, cblock)[None, :]),
+            n1, binom, cellidx, bitpos, dt, rank_dtype, use_onehot,
+        )
+        p2 = filled[:, None] ^ p1
+        mover = p1 if mover_is_p1 else p2
+        current = p2 if mover_is_p1 else p1
+        prim_mask = (_connected_fold(mover, h, connect, dt)
+                     | _connected_fold(current, h, connect, dt))
+        opponent = p2 if p1_moves else p1
+        children = jnp.stack(
+            [opponent | (guards[:, None] + newbit[:, c : c + 1])
+             for c in range(w)],
+            axis=-1,
+        )
+        return children, prim_mask
+
+    return step
+
+
+def build_boundary_lookup_acc_step(method: str):
+    """Streamed boundary, phase 2 (once per window block): search one
+    SORTED block of the level-B table and accumulate hit cells.
+
+    Blocks partition a sorted table, so each child hits in at most one
+    block; a hit cell is nonzero (decided value), so accumulate is a
+    select — the same invariant as the sharded streamed window
+    (parallel/sharded._sharded_lookup_acc_step).
+
+    Returned fn: (children_flat [N], acc [N] u8, kstates [wb],
+    kcells [wb] u8) -> acc' [N] u8.
+
+    Deliberately NOT ops.lookup.lookup_sorted: its fused one-gather
+    payload applies only to uint32 states, and every board big enough to
+    need streaming (6x5+) packs in uint64 — where lookup_sorted's
+    separate (u8 value, i32 remoteness) arrays would also 5x the
+    per-block host->device upload this path exists to minimize. The
+    1-byte dense cell keeps the stream at (state + 1 B) per entry.
+    """
+
+    def step(children_flat, acc, kstates, kcells):
+        idx = jnp.searchsorted(kstates, children_flat, method=method)
+        idx = jnp.clip(idx, 0, kstates.shape[0] - 1).astype(jnp.int32)
+        hit = kstates[idx] == children_flat
+        return jnp.where(hit, kcells[idx], acc)
+
+    return step
+
+
+def build_boundary_combine_step(cblock: int, w: int):
+    """Streamed boundary, phase 3: accumulated child cells -> level-K cells.
+
+    Returned fn: (acc [P, cblock, w] u8, prim_mask [P, cblock] bool,
+    valid [P, w] bool) -> cells [P, cblock] u8 — the exact combine tail of
+    build_boundary_step.
+    """
+
+    def step(acc, prim_mask, valid):
+        P = valid.shape[0]
+        cv = (acc & jnp.uint8(3)).reshape(P * cblock, w)
+        cr = (acc >> jnp.uint8(2)).astype(jnp.int32).reshape(P * cblock, w)
+        mk = (valid[:, None, :] & ~prim_mask[..., None]).reshape(
+            P * cblock, w
+        )
+        values, rem_out = combine_children(cv, cr, mk)
+        values = values.reshape(P, cblock)
+        rem_out = rem_out.reshape(P, cblock)
+        values = jnp.where(prim_mask, jnp.uint8(LOSE), values)
+        rem_out = jnp.where(prim_mask, 0, rem_out)
+        return values | (jnp.clip(rem_out, 0, 63).astype(jnp.uint8)
+                         << jnp.uint8(2))
+
+    return step
+
+
+def _concat_trim(blocks, nblk: int, cblock: int, C: int):
+    """Join per-rank-block [P, cblock] results and trim the pad lanes of
+    the ragged last block — the one tail both boundary lowerings share."""
+    cells = blocks[0] if nblk == 1 else jnp.concatenate(blocks, axis=1)
+    if nblk * cblock != C:
+        cells = cells[:, :C]
+    return cells
+
+
 class HybridSolveResult:
     """Duck-typed SolveResult: dense cells below the cutover, sparse BFS
     tables above it."""
@@ -266,6 +371,9 @@ class HybridSolver:
         self.devices = int(devices)
         if self.devices < 1:
             raise ValueError(f"devices must be >= 1, got {devices}")
+        #: window blocks streamed through the boundary join (observable
+        #: for the streamed-path tests; 0 = the table stayed resident).
+        self.boundary_stream_blocks = 0
         # The dense half (kernels, consts, tables); its reach sweep is run
         # partially by this class, so disable its own full sweep.
         self.dense = DenseSolver(game, store_tables=store_tables,
@@ -354,8 +462,9 @@ class HybridSolver:
         return frontier
 
     def _dense_cell_table(self, bfs_table) -> tuple:
-        """BFS LevelTable -> (sorted padded states, dense u8 cells) device
-        arrays for the boundary kernel's binary search."""
+        """BFS LevelTable -> (sorted padded states, dense u8 cells) HOST
+        arrays for the boundary join (uploaded whole in resident mode,
+        block-sliced in streamed mode)."""
         from gamesmanmpi_tpu.ops.padding import pad_to_bucket
 
         states = pad_to_bucket(bfs_table.states)
@@ -365,10 +474,26 @@ class HybridSolver:
             bfs_table.values.astype(np.uint8)
             | (np.clip(bfs_table.remoteness, 0, 63).astype(np.uint8) << 2)
         )
-        return jnp.asarray(states), jnp.asarray(cells)
+        return states, cells
 
     def _resolve_boundary(self, kstates, kcells):
-        """Dense level-K cells resolved against the sparse level-B table."""
+        """Dense level-K cells resolved against the sparse level-B table.
+
+        Two lowerings, chosen by the table's size against
+        GAMESMAN_HYBRID_RESIDENT_MB (default 2 GiB):
+
+        * resident — the whole (states, cells) table lives in HBM and one
+          fused kernel per rank block searches it (build_boundary_step);
+        * streamed — the table stays on HOST and is streamed through HBM
+          in GAMESMAN_HYBRID_WBLOCK-position blocks: children materialize
+          once per rank block (phase 1), each sorted block is searched
+          with hits accumulated by select (phase 2, at most one hit per
+          child across the stream), one combine per rank block (phase 3).
+          HBM then holds O(rank block + window block), decoupling the
+          join from reachable(B) — the same mechanism as the sharded
+          solver's streamed window. Known cost: the table re-uploads once
+          per rank block.
+        """
         d, t, g = self.dense, self.tables, self.game
         K = self.cutover
         P = len(t.profiles[K])
@@ -378,26 +503,70 @@ class HybridSolver:
         guards = jnp.asarray(t.level_consts(K)["guards"])
         wcap = int(kstates.shape[0])
         sm = search_method()
+        w = t.width
 
-        step = get_kernel(
-            g, "hyb",
-            ("hyb", t.width, t.height, t.connect, K, cblock, wcap,
-             d.use_onehot, sm),
-            lambda _g: build_boundary_step(
-                t, K, cblock, wcap, d._rank_dtype, d.use_onehot, sm
+        def kkey(kind, *extra):
+            return (kind, t.width, t.height, t.connect, K, cblock,
+                    d.use_onehot) + extra
+
+        budget_mb = int(os.environ.get("GAMESMAN_HYBRID_RESIDENT_MB",
+                                       "2048"))
+        table_bytes = wcap * (kstates.dtype.itemsize + 1)
+        if table_bytes <= budget_mb << 20:
+            step = get_kernel(
+                g, "hyb", kkey("hyb", wcap, sm),
+                lambda _g: build_boundary_step(
+                    t, K, cblock, wcap, d._rank_dtype, d.use_onehot, sm
+                ),
+            )
+            ks_dev, kc_dev = jnp.asarray(kstates), jnp.asarray(kcells)
+            blocks = []
+            for b in range(nblk):
+                blocks.append(step(
+                    d._rank0(b, cblock), ks_dev, kc_dev,
+                    consts["binom"], consts["cellidx"], consts["filled"],
+                    guards, consts["newbit"], consts["valid"],
+                ))
+            return _concat_trim(blocks, nblk, cblock, C)
+
+        # Streamed path.
+        wb = int(os.environ.get("GAMESMAN_HYBRID_WBLOCK", str(1 << 22)))
+        wb = max(256, 1 << (wb - 1).bit_length())
+        wb = min(wb, wcap)
+        children_step = get_kernel(
+            g, "hybc", kkey("hybc"),
+            lambda _g: build_boundary_children_step(
+                t, K, cblock, d._rank_dtype, d.use_onehot
             ),
+        )
+        acc_step = get_kernel(
+            g, "hyba", kkey("hyba", wb, sm),
+            lambda _g: build_boundary_lookup_acc_step(sm),
+        )
+        combine_step = get_kernel(
+            g, "hybk", kkey("hybk"),
+            lambda _g: build_boundary_combine_step(cblock, w),
         )
         blocks = []
         for b in range(nblk):
-            blocks.append(step(
-                d._rank0(b, cblock), kstates, kcells,
+            children, prim = children_step(
+                d._rank0(b, cblock),
                 consts["binom"], consts["cellidx"], consts["filled"],
-                guards, consts["newbit"], consts["valid"],
+                guards, consts["newbit"],
+            )
+            flat = children.reshape(-1)
+            acc = jnp.zeros(flat.shape, jnp.uint8)
+            for off in range(0, wcap, wb):
+                acc = acc_step(
+                    flat, acc,
+                    jnp.asarray(kstates[off : off + wb]),
+                    jnp.asarray(kcells[off : off + wb]),
+                )
+                self.boundary_stream_blocks += 1
+            blocks.append(combine_step(
+                acc.reshape(P, cblock, w), prim, consts["valid"]
             ))
-        cells = blocks[0] if nblk == 1 else jnp.concatenate(blocks, axis=1)
-        if nblk * cblock != C:
-            cells = cells[:, :C]
-        return cells
+        return _concat_trim(blocks, nblk, cblock, C)
 
     # -------------------------------------------------------------- solve
 

@@ -147,3 +147,24 @@ def test_hybrid_sharded_no_tables():
     assert (hy.value, hy.remoteness, hy.num_positions) == (3, 9, 694)
     with pytest.raises(KeyError):
         hy.lookup(int(g.initial_state()))
+
+
+def test_hybrid_streamed_boundary_parity(monkeypatch):
+    """Forcing the boundary table out of residency must stream it through
+    the join in blocks with bit-identical results (the mechanism that
+    decouples the seam's HBM need from reachable(B) on giant boards)."""
+    g = get_game("connect4:w=4,h=3")
+    ref = Solver(g).solve()
+    monkeypatch.setenv("GAMESMAN_HYBRID_RESIDENT_MB", "0")
+    monkeypatch.setenv("GAMESMAN_HYBRID_WBLOCK", "256")
+    hy_solver = HybridSolver(get_game("connect4:w=4,h=3"), cutover=6)
+    hy = hy_solver.solve()
+    assert hy_solver.boundary_stream_blocks > 1  # streaming really engaged
+    assert (hy.value, hy.remoteness) == (ref.value, ref.remoteness)
+    assert hy.num_positions == ref.num_positions
+    for level, table in ref.levels.items():
+        for i in range(table.states.shape[0]):
+            s = int(table.states[i])
+            assert hy.lookup(s) == (
+                int(table.values[i]), int(table.remoteness[i])
+            ), (level, hex(s))
