@@ -74,6 +74,18 @@ from gamesmanmpi_tpu.solve.engine import Solver, get_kernel
 from gamesmanmpi_tpu.utils.platform import platform_auto_bool
 
 
+def _env_int_strict(name: str, default: int) -> int:
+    """Integer env knob that fails fast with a clear message (same
+    convention as the GAMESMAN_HYBRID_CUTOVER parse below)."""
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r} is not an integer") from None
+
+
 def default_cutover(ncells: int) -> int:
     """The 2/3 point: at 6x6 this is K=24, where encodable(<=K) = 3.1e10
     of the 6.0e11 total (ARCHITECTURE "Hybrid candidate" table) — the
@@ -374,6 +386,13 @@ class HybridSolver:
         #: window blocks streamed through the boundary join (observable
         #: for the streamed-path tests; 0 = the table stayed resident).
         self.boundary_stream_blocks = 0
+        # Boundary-join capacity knobs, parsed HERE so a typo fails fast
+        # with a clear message instead of a raw traceback after the sweep
+        # and the whole BFS phase have already run (the join reads them
+        # last).
+        self.resident_mb = _env_int_strict("GAMESMAN_HYBRID_RESIDENT_MB",
+                                           2048)
+        self.wblock = _env_int_strict("GAMESMAN_HYBRID_WBLOCK", 1 << 22)
         # The dense half (kernels, consts, tables); its reach sweep is run
         # partially by this class, so disable its own full sweep.
         self.dense = DenseSolver(game, store_tables=store_tables,
@@ -509,10 +528,8 @@ class HybridSolver:
             return (kind, t.width, t.height, t.connect, K, cblock,
                     d.use_onehot) + extra
 
-        budget_mb = int(os.environ.get("GAMESMAN_HYBRID_RESIDENT_MB",
-                                       "2048"))
         table_bytes = wcap * (kstates.dtype.itemsize + 1)
-        if table_bytes <= budget_mb << 20:
+        if table_bytes <= self.resident_mb << 20:
             step = get_kernel(
                 g, "hyb", kkey("hyb", wcap, sm),
                 lambda _g: build_boundary_step(
@@ -530,9 +547,7 @@ class HybridSolver:
             return _concat_trim(blocks, nblk, cblock, C)
 
         # Streamed path.
-        wb = int(os.environ.get("GAMESMAN_HYBRID_WBLOCK", str(1 << 22)))
-        wb = max(256, 1 << (wb - 1).bit_length())
-        wb = min(wb, wcap)
+        wb = min(max(256, 1 << (self.wblock - 1).bit_length()), wcap)
         children_step = get_kernel(
             g, "hybc", kkey("hybc"),
             lambda _g: build_boundary_children_step(

@@ -168,3 +168,15 @@ def test_hybrid_streamed_boundary_parity(monkeypatch):
             assert hy.lookup(s) == (
                 int(table.values[i]), int(table.remoteness[i])
             ), (level, hex(s))
+
+
+def test_hybrid_bad_capacity_knobs_fail_fast(monkeypatch):
+    """Boundary-join capacity typos must fail at construction with a
+    clear message, not hours later when the join finally reads them."""
+    monkeypatch.setenv("GAMESMAN_HYBRID_RESIDENT_MB", "2g")
+    with pytest.raises(ValueError, match="not an integer"):
+        HybridSolver(get_game("connect4:w=3,h=3,connect=3"), cutover=4)
+    monkeypatch.delenv("GAMESMAN_HYBRID_RESIDENT_MB")
+    monkeypatch.setenv("GAMESMAN_HYBRID_WBLOCK", "4M")
+    with pytest.raises(ValueError, match="not an integer"):
+        HybridSolver(get_game("connect4:w=3,h=3,connect=3"), cutover=4)

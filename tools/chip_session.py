@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Mechanical executor for docs/CHIP_PLAN.md — run when the relay is live.
+
+The TPU relay has died mid-session twice (rounds 3 and 4); every on-chip
+decision this repo is waiting on (dense lowering A/B, hybrid cutover,
+merge ladder, Pallas go/no-go, the board ladder) must therefore be
+collectable in ONE pass with per-step failure isolation: each step runs
+in a child process under a deadline, its JSON/text output is appended to
+the artifact file IMMEDIATELY, and a dead relay aborts the remaining
+steps while keeping everything already measured.
+
+Usage:
+    python tools/chip_session.py [--out artifacts/chip.jsonl] [--quick]
+
+Single-client discipline (docs/ROUND3.md): nothing else may touch the
+axon backend while this runs; concurrent work must set
+GAMESMAN_PLATFORM=cpu. The relay is TCP-probed (never with a jax client)
+before each step; refusal marks the remaining steps skipped.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RELAY_PORT = int(os.environ.get("GAMESMAN_RELAY_PORT", "8103"))
+
+
+def relay_up() -> bool:
+    try:
+        with socket.create_connection(("127.0.0.1", RELAY_PORT), timeout=5):
+            return True
+    except OSError:
+        return False
+
+
+def _last_json(text: str) -> dict | None:
+    for line in reversed((text or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+class Session:
+    def __init__(self, out_path: str):
+        self.out_path = out_path
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        self.aborted = False
+
+    def record(self, **rec) -> None:
+        rec["ts"] = round(time.time(), 1)
+        with open(self.out_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(f"[chip_session] {rec.get('step')}: "
+              f"{rec.get('status', 'ok')}", file=sys.stderr)
+
+    def step(self, name: str, argv: list[str], env: dict | None = None,
+             timeout: float = 2400.0, parse_json: bool = True) -> dict | None:
+        """One isolated child step; returns the parsed JSON record, if any."""
+        if self.aborted:
+            self.record(step=name, status="skipped", reason="session aborted")
+            return None
+        if not relay_up():
+            self.aborted = True
+            self.record(step=name, status="skipped",
+                        reason=f"relay port {RELAY_PORT} refused")
+            return None
+        full_env = dict(os.environ)
+        full_env.update(env or {})
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                argv, cwd=REPO, env=full_env, timeout=timeout,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+            out, err, rc = proc.stdout, proc.stderr, proc.returncode
+        except subprocess.TimeoutExpired as e:
+            out = e.stdout if isinstance(e.stdout, str) else ""
+            err = e.stderr if isinstance(e.stderr, str) else ""
+            rc = -1
+        secs = round(time.time() - t0, 1)
+        rec = _last_json(out) if parse_json else None
+        # Keep BOTH tails: bench's progress and tracebacks go to stderr,
+        # but microbench2's measurement lines print to stdout — the §1
+        # decision table's data would otherwise never reach the artifact.
+        self.record(
+            step=name, status="ok" if rc == 0 else f"rc={rc}",
+            secs=secs, env={k: v for k, v in (env or {}).items()},
+            record=rec,
+            stdout_tail="\n".join((out or "").splitlines()[-80:]),
+            stderr_tail="\n".join((err or "").splitlines()[-40:]),
+        )
+        if rc != 0 and not relay_up():
+            self.aborted = True
+            self.record(step=name + ".postmortem", status="relay died")
+        return rec
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "artifacts", "chip_session.jsonl"))
+    ap.add_argument("--quick", action="store_true",
+                    help="skip the board ladder (steps 3+)")
+    args = ap.parse_args()
+    s = Session(args.out)
+    py = sys.executable
+
+    if not relay_up():
+        s.record(step="probe", status="skipped",
+                 reason=f"relay port {RELAY_PORT} refused — nothing to do")
+        return 1
+    s.record(step="probe", status="ok")
+
+    bench = [py, os.path.join(REPO, "bench.py")]
+    b55 = {"BENCH_SYM": "0", "BENCH_LADDER": "0",
+           "BENCH_GAME": "connect4:w=5,h=5", "BENCH_REPEATS": "2"}
+
+    # §1 primitive costs (microbench2's lines land in stdout_tail).
+    s.step("microbench2", [py, os.path.join(REPO, "tools", "microbench2.py")],
+           timeout=1800, parse_json=False)
+
+    # §2 dense lowering A/B on 5x5.
+    s.step("dense_default", bench, env=b55)
+    s.step("dense_rank_fused", bench, env={**b55, "GAMESMAN_DENSE_RANK": "fused"})
+    s.step("dense_gather_sorted", bench,
+           env={**b55, "GAMESMAN_DENSE_GATHER": "sorted"})
+    s.step("dense_fused_sorted", bench,
+           env={**b55, "GAMESMAN_DENSE_RANK": "fused",
+                "GAMESMAN_DENSE_GATHER": "sorted"})
+    s.step("dense_binom_take", bench,
+           env={**b55, "GAMESMAN_DENSE_BINOM": "take"}, timeout=1800)
+    s.step("classic_5x5", bench, env={**b55, "BENCH_ENGINE": "classic"})
+
+    # §2b hybrid cutover scan on 5x5.
+    for k in (12, 16, 20):
+        s.step(f"hybrid_k{k}", bench,
+               env={**b55, "BENCH_ENGINE": "hybrid",
+                    "GAMESMAN_HYBRID_CUTOVER": str(k)})
+
+    if not args.quick:
+        # §3 board ladder.
+        s.step("dense_6x4", bench,
+               env={**b55, "BENCH_GAME": "connect4:w=6,h=4"}, timeout=3000)
+        s.step("dense_6x5", bench,
+               env={**b55, "BENCH_GAME": "connect4:w=6,h=5"}, timeout=5400)
+        # §4 the full default bench (primary + sym + ladder) — the shape
+        # the driver records.
+        s.step("bench_full", bench, env={}, timeout=3600)
+
+    s.record(step="done", status="aborted" if s.aborted else "complete")
+    # Nonzero on a mid-plan relay death so a driver gating on the exit
+    # code retries the unmeasured steps (same convention as the probe).
+    return 1 if s.aborted else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

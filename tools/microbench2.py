@@ -177,6 +177,25 @@ def main():
            lambda k, o: merge_sort(k, o), keys, origin_i32,
            bytes_moved=2 * 8 * N)
 
+    # 6c. searchsorted on u64 (the hybrid boundary join's primitive: child
+    # packed states searched in the sorted sparse level-B table — the
+    # GAMESMAN_SEARCH decision at the join's scale, and the per-element
+    # cost the CHIP_PLAN §2b cutover arithmetic needs). Queries half-hit.
+    M8 = 8 * 1024 * 1024
+    tbl64 = jnp.asarray(np.sort(
+        rng.integers(0, 1 << 60, size=M8, dtype=np.uint64)))
+    q64 = jnp.asarray(np.where(
+        rng.integers(0, 2, size=N).astype(bool),
+        np.asarray(tbl64)[rng.integers(0, M8, size=N)],
+        rng.integers(0, 1 << 60, size=N, dtype=np.uint64),
+    ))
+    for method in ("scan", "sort"):
+        timeit(
+            f"searchsorted u64 {method} [{N>>20}M in 8M]",
+            lambda t, q, m=method: jnp.searchsorted(t, q, method=m),
+            tbl64, q64, bytes_moved=8 * N,
+        )
+
     # 7. does Pallas compile/run over this backend at all?
     if not quick:
         try:
