@@ -262,7 +262,10 @@ def canonical_scalar(game: TensorGame, state):
         return f
 
     try:
-        cpu = jax.devices("cpu")[0]
+        # local_devices, not devices: under multi-process execution
+        # devices("cpu")[0] is process 0's device — any other process
+        # would compute onto a non-addressable buffer and die fetching it.
+        cpu = jax.local_devices(backend="cpu")[0]
     except RuntimeError:
         cpu = None
     arg = np.array([state], dtype=game.state_dtype)

@@ -1,0 +1,97 @@
+"""REAL multi-process execution over jax.distributed (SURVEY.md §5.8).
+
+Until round 4 the multi-host control plane was mock-tested only (the CLI's
+--coordinator flags drove a fake jax.distributed.initialize). XLA's CPU
+collectives (Gloo) support genuine multi-controller execution on this
+container, so these tests launch TWO OS processes that join one
+coordinator, build a 4-device global mesh (2 local devices each), and run
+the owner-routed sharded solve across it — cross-process all_to_all,
+psum-replicated control plane, non-addressable shards and all. Both
+processes must print identical, known-correct answers.
+
+This is the closest analog this environment allows to the reference's
+`mpirun -np 2` integration run.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _child_env() -> dict:
+    env = dict(os.environ)
+    # The suite's own fake-device flag must NOT leak: each child gets
+    # exactly 2 local CPU devices so the 4-device mesh spans processes.
+    env.pop("XLA_FLAGS", None)
+    env["GAMESMAN_PLATFORM"] = "cpu"
+    env["GAMESMAN_FAKE_DEVICES"] = "2"
+    return env
+
+
+def _run_two_process_solve(spec: str, extra_args=(), tmp_dir="/tmp"):
+    port = _free_port()
+    procs, files = [], []
+    for pid in range(2):
+        # File-backed stdio, not PIPEs: the two children are coupled by
+        # cross-process collectives, so blocking on one's unread pipe can
+        # stall the other — converting any verbose failure into a bare
+        # timeout with the diagnostics lost.
+        out_f = open(os.path.join(tmp_dir, f"mh_{port}_{pid}.out"), "w+")
+        err_f = open(os.path.join(tmp_dir, f"mh_{port}_{pid}.err"), "w+")
+        files.append((out_f, err_f))
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "solve_launcher.py"), spec,
+             "--devices", "4", "--no-tables",
+             "--coordinator", f"127.0.0.1:{port}",
+             "--num-processes", "2", "--process-id", str(pid),
+             *extra_args],
+            cwd=REPO, env=_child_env(), stdout=out_f, stderr=err_f,
+        ))
+    outs = []
+    for p, (out_f, err_f) in zip(procs, files):
+        try:
+            rc = p.wait(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-host solve timed out")
+        out_f.seek(0)
+        err_f.seek(0)
+        outs.append((rc, out_f.read(), err_f.read()))
+        out_f.close()
+        err_f.close()
+    for rc, out, err in outs:
+        assert rc == 0, f"process failed rc={rc}\n{err[-2000:]}"
+    return outs
+
+
+def test_multihost_generic_path_nim(tmp_path):
+    """Generic (multi-jump) engine across 2 processes: nim 2-3-4 is WIN
+    remoteness 7 with 60 positions — both processes must agree."""
+    outs = _run_two_process_solve("nim:heaps=2-3-4", tmp_dir=str(tmp_path))
+    for _, out, _ in outs:
+        assert "positions: 60" in out
+        assert "value: WIN" in out
+        assert "remoteness: 7" in out
+
+
+def test_multihost_fast_path_connect3(tmp_path):
+    """Device-resident fast path across 2 processes: 3x3 connect-3 is a
+    TIE at remoteness 9 with 694 reachable positions."""
+    outs = _run_two_process_solve("connect4:w=3,h=3,connect=3",
+                                  tmp_dir=str(tmp_path))
+    for _, out, _ in outs:
+        assert "positions: 694" in out
+        assert "value: TIE" in out
+        assert "remoteness: 9" in out

@@ -77,6 +77,13 @@ class Session:
                         reason=f"relay port {RELAY_PORT} refused")
             return None
         full_env = dict(os.environ)
+        # The single-client discipline tells CONCURRENT shells to export
+        # GAMESMAN_PLATFORM=cpu — if this script inherits that (or a
+        # fake-device count), every "chip" measurement silently runs on
+        # CPU with exit 0. Children get the real backend unless the step
+        # itself asks otherwise.
+        full_env.pop("GAMESMAN_PLATFORM", None)
+        full_env.pop("GAMESMAN_FAKE_DEVICES", None)
         full_env.update(env or {})
         t0 = time.time()
         try:
@@ -86,9 +93,15 @@ class Session:
             )
             out, err, rc = proc.stdout, proc.stderr, proc.returncode
         except subprocess.TimeoutExpired as e:
-            out = e.stdout if isinstance(e.stdout, str) else ""
-            err = e.stderr if isinstance(e.stderr, str) else ""
-            rc = -1
+            # TimeoutExpired attaches partial output as BYTES even under
+            # text=True — decode it; it is exactly the already-measured
+            # data this script exists to preserve.
+            def _txt(x):
+                if isinstance(x, bytes):
+                    return x.decode(errors="replace")
+                return x or ""
+
+            out, err, rc = _txt(e.stdout), _txt(e.stderr), -1
         secs = round(time.time() - t0, 1)
         rec = _last_json(out) if parse_json else None
         # Keep BOTH tails: bench's progress and tracebacks go to stderr,
