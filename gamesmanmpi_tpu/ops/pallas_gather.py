@@ -1,19 +1,33 @@
-"""Pallas monotone-window gather (round-4 scaffold, interpret-tested).
+"""Pallas monotone-window gather (round-4; first Mosaic-compiled on chip).
 
 The dense engine's backward step is, per move, one byte-gather with a
 globally NON-DECREASING flat index vector (solve/dense.py sorted-gather
 mode builds exactly that). XLA's TPU gather treats it as random access
-(~11 ns/element measured); a monotone gather can instead stream: each
-block of K indices touches a bounded window of the table, so the kernel
-DMAs two window-aligned table tiles into VMEM and selects locally —
-HBM traffic becomes sequential tile reads instead of per-element
-transactions.
+(~9-11 ns/element measured, microbench2 r04: 32M u32 gathers = 357 ms
+regardless of table size or the sorted-indices hint); a monotone gather
+can instead stream: each block of K indices touches a bounded window of
+the table, so the kernel keeps two window-aligned table tiles in VMEM
+and selects locally — HBM traffic becomes sequential tile reads instead
+of per-element transactions.
 
-Status: the kernel is written against the documented Pallas/Mosaic API
-and validated in INTERPRET mode (tests/test_pallas_gather.py) — the TPU
-relay was down for the whole build session, so Mosaic has never compiled
-it (docs/CHIP_PLAN.md gates its adoption on that). It is NOT wired into
-any engine; solve/dense.py's flag-gated lowerings are the shipping paths.
+Mosaic constraints that shaped this kernel (verified against the
+installed lowering, jax/_src/pallas/mosaic/lowering.py):
+
+* rank-1 block shapes must be whole-array or 128-multiples — the original
+  per-block (1,) miss-count output could not lower; the miss count is now
+  computed OUTSIDE the kernel (it depends only on idx and the window
+  bases, one fused elementwise XLA pass).
+* `lax.gather` lowers ONLY as 2-D `take_along_axis` with operand, indices
+  and output all the same 2-D shape (tpu.dynamic_gather along dim 0 or
+  dim 1). A rank-1 in-kernel `jnp.take` can never compile. The kernel
+  therefore views the 2-window tile as a [R, 128] matrix and decomposes
+  each offset into (row = off // 128, lane = off % 128):
+
+      v   = take_along_axis(tile, row*, axis=0)   # sublane row-select
+      out = take_along_axis(v,    lane*, axis=1)[:, 0]  # lane select
+
+  (row*/lane* broadcast to the [R, 128] operand shape), processing R
+  outputs per step so every gather operand/index shape matches.
 
 Contract: monotone_window_gather(table_u32, idx_i32) == table[idx] for
 non-decreasing idx, EXCEPT for elements whose block spans more than one
@@ -43,6 +57,18 @@ def monotone_window_gather(table, idx, block: int = 2048,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    if window % 128:
+        raise ValueError(f"window must be a multiple of 128, got {window}")
+    if block % 128:
+        # Mosaic rank-1 block rule (module docstring): fail loudly here,
+        # not with an opaque lowering error on chip.
+        raise ValueError(f"block must be a multiple of 128, got {block}")
+    rows = (2 * window) // 128          # [rows, 128] view of the 2-window tile
+    if block % rows:
+        raise ValueError(
+            f"block ({block}) must be a multiple of 2*window/128 ({rows})")
+    nchunk = block // rows
+
     n = idx.shape[0]
     npad = -n % block
     if npad:
@@ -70,37 +96,47 @@ def monotone_window_gather(table, idx, block: int = 2048,
         ],
         out_specs=[
             pl.BlockSpec((block,), lambda i, al, bw: (i,)),
-            # One miss COUNT per block, not a per-element vector: the
-            # kernel is judged on HBM traffic, and a 4N-byte bookkeeping
-            # write would double its output volume.
-            pl.BlockSpec((1,), lambda i, al, bw: (i,)),
         ],
     )
 
-    def kernel(al_ref, bw_ref, idx_ref, t0_ref, t1_ref, out_ref, miss_ref):
+    def kernel(al_ref, bw_ref, idx_ref, t0_ref, t1_ref, out_ref):
         i = pl.program_id(0)
-        idxs = idx_ref[:]
         base = al_ref[i]
-        off = idxs - base
-        in0 = (off >= 0) & (off < window)
-        in1 = (off >= window) & (off < 2 * window)
-        t0 = t0_ref[:]
-        t1 = t1_ref[:]
-        g0 = jnp.take(t0, jnp.clip(off, 0, window - 1))
-        g1 = jnp.take(t1, jnp.clip(off - window, 0, window - 1))
-        out_ref[:] = jnp.where(in0, g0, g1)
-        miss_ref[0] = jnp.sum((~(in0 | in1)).astype(jnp.int32))
+        # [rows, 128] row-major view of the two window tiles. Sub-32-bit
+        # tables (the dense engine's u8 cells) gather as i32 — Mosaic's
+        # dynamic_gather targets 32-bit lanes; the cast back on store is
+        # exact for unsigned sub-ranges.
+        tile = jnp.concatenate(
+            [t0_ref[:].reshape(window // 128, 128),
+             t1_ref[:].reshape(window // 128, 128)], axis=0)
+        if tile.dtype.itemsize < 4:
+            tile = tile.astype(jnp.int32)
+        off_all = (idx_ref[:] - base).reshape(nchunk, rows)
+        for k in range(nchunk):
+            off = jnp.clip(off_all[k], 0, 2 * window - 1)   # [rows]
+            r = (off // 128).astype(jnp.int32)
+            c = (off % 128).astype(jnp.int32)
+            v = jnp.take_along_axis(
+                tile, jnp.broadcast_to(r[:, None], (rows, 128)), axis=0)
+            sel = jnp.take_along_axis(
+                v, jnp.broadcast_to(c[:, None], (rows, 128)), axis=1)
+            out_ref[k * rows:(k + 1) * rows] = sel[:, 0].astype(out_ref.dtype)
 
-    out, miss = pl.pallas_call(
+    (out,) = pl.pallas_call(
         kernel,
         out_shape=[
             jax.ShapeDtypeStruct((nblk * block,), table.dtype),
-            jax.ShapeDtypeStruct((nblk,), jnp.int32),
         ],
         grid_spec=grid_spec,
         interpret=interpret,
     )(aligned, base_win, idx, table, table)
+    # Misses depend only on idx and the precomputed window bases, so the
+    # count lives OUTSIDE the kernel as one fused elementwise XLA pass
+    # (see module docstring: Mosaic's rank-1 output block rule).
+    off_all = idx - jnp.repeat(aligned, block)
+    miss = jnp.sum(((off_all < 0) | (off_all >= 2 * window))
+                   .astype(jnp.int32))
     # Padding lanes replicate idx[-1]; they miss iff the real tail element
     # misses, so nmiss stays 0 exactly when every real element hit (the
     # contract callers check). When nonzero it may count tail replicas.
-    return out[:n], jnp.sum(miss)
+    return out[:n], miss

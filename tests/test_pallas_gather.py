@@ -56,6 +56,21 @@ def test_wide_jumps_are_miss_flagged_not_wrong():
     assert int(nmiss) == int((~ok).sum())
 
 
+def test_u8_table_gathers_as_i32_exactly():
+    # The dense engine's tables are u8 cells; the kernel gathers them as
+    # i32 in VMEM (Mosaic's dynamic_gather targets 32-bit lanes) and must
+    # cast back exactly.
+    rng = np.random.default_rng(7)
+    table = rng.integers(0, 256, size=1 << 16, dtype=np.uint8)
+    steps = rng.integers(0, 3, size=5000)
+    idx = np.minimum(np.cumsum(steps), table.shape[0] - 1).astype(np.int32)
+    out, nmiss = monotone_window_gather(table, idx, block=256, window=2048,
+                                        interpret=True)
+    assert int(nmiss) == 0
+    assert np.asarray(out).dtype == np.uint8
+    np.testing.assert_array_equal(np.asarray(out), table[idx])
+
+
 @pytest.mark.parametrize("n", [1, 255, 256, 257, 5000])
 def test_ragged_lengths(n):
     table, idx = _case(1 << 14, n, n, span=2)

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Focused chip check for ops/pallas_gather.monotone_window_gather.
+
+Answers, in under ~2 minutes of chip time, the CHIP_PLAN §1 question the
+full microbench2 run spends 15 minutes around: does Mosaic accept the
+kernel, is it CORRECT on silicon (vs the XLA gather), and does it beat
+XLA's ~9-11 ns/element random-access gather on the dense engine's actual
+access pattern (globally non-decreasing indices)?
+
+Prints one human line per case plus a final JSON line
+{"kernel_ok": bool, "best": {...}} for artifacts.
+
+Single-client discipline: run ONLY when nothing else is on the relay.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from gamesmanmpi_tpu.ops.pallas_gather import monotone_window_gather
+
+
+def timeit(fn, *args, n=3, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def main() -> int:
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev})", flush=True)
+    rng = np.random.default_rng(0)
+    N = 32 * 1024 * 1024
+    M = 8 * 1024 * 1024
+    idx_np = np.sort(rng.integers(0, M, size=N)).astype(np.int32)
+    idx = jnp.asarray(idx_np)
+
+    results = []
+    kernel_ok = True
+    for dtype, hi in ((np.uint32, 1 << 30), (np.uint8, 256)):
+        tb_np = rng.integers(0, hi, size=M, dtype=dtype)
+        tb = jnp.asarray(tb_np)
+        name = np.dtype(dtype).name
+
+        secs_x, ref = timeit(lambda t, i: t[i], tb, idx)
+        print(f"xla gather {name} [32M from 8M]      {secs_x*1e3:9.2f} ms",
+              flush=True)
+        ref_np = np.asarray(ref)
+
+        for block, window in ((2048, 8192), (4096, 16384), (8192, 32768)):
+            label = f"pallas monotone {name} b={block} w={window}"
+            try:
+                fn = jax.jit(lambda t, i: monotone_window_gather(
+                    t, i, block=block, window=window))
+                secs, (out, nmiss) = timeit(fn, tb, idx)
+            except Exception as e:  # Mosaic rejection or runtime failure
+                kernel_ok = False
+                print(f"{label}  FAILED: {type(e).__name__}: {e}"[:220],
+                      flush=True)
+                continue
+            nmiss = int(nmiss)
+            good = bool((np.asarray(out) == ref_np).all()) and nmiss == 0
+            print(f"{label}  {secs*1e3:9.2f} ms   miss={nmiss} "
+                  f"correct={good}  speedup={secs_x/secs:5.2f}x", flush=True)
+            if not good:
+                kernel_ok = False
+            results.append({"dtype": name, "block": block, "window": window,
+                            "secs": round(secs, 4), "nmiss": nmiss,
+                            "correct": good,
+                            "xla_secs": round(secs_x, 4),
+                            "speedup": round(secs_x / secs, 2)})
+
+    best = max((r for r in results if r["correct"]),
+               key=lambda r: r["speedup"], default=None)
+    print(json.dumps({"kernel_ok": kernel_ok, "device": dev.platform,
+                      "best": best}), flush=True)
+    return 0 if kernel_ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
