@@ -42,6 +42,30 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+
+
+def _dyn_gather(x, idx, axis: int):
+    """x[idx[r,c], c] (axis=0) / x[r, idx[r,c]] (axis=1) for 2-D x, idx.
+
+    This is take_along_axis's gather, built directly so the indices stay
+    int32: under jax_enable_x64 (which this package turns on for uint64
+    boards) jnp.take_along_axis converts indices to int64 for its
+    negative-index normalization, and Mosaic's int64->int32 convert
+    lowering recurses forever (observed on-chip as a RecursionError,
+    microbench2 r04). The dimension numbers below are exactly the two
+    forms _gather_lowering_rule pattern-matches into tpu.dynamic_gather.
+    """
+    dnums = lax.GatherDimensionNumbers(
+        offset_dims=(),
+        collapsed_slice_dims=(axis,),
+        start_index_map=(axis,),
+        operand_batching_dims=(1 - axis,),
+        start_indices_batching_dims=(1 - axis,),
+    )
+    return lax.gather(
+        x, idx[..., None], dnums, (1, 1),
+        mode=lax.GatherScatterMode.PROMISE_IN_BOUNDS)
 
 
 def monotone_window_gather(table, idx, block: int = 2048,
@@ -112,13 +136,19 @@ def monotone_window_gather(table, idx, block: int = 2048,
         if tile.dtype.itemsize < 4:
             tile = tile.astype(jnp.int32)
         off_all = (idx_ref[:] - base).reshape(nchunk, rows)
+        # All scalars below are pinned int32: under jax_enable_x64 bare
+        # Python ints trace as weak int64 scalars, and ANY int64 in a
+        # Mosaic kernel hits the infinitely-recursing int64->int32
+        # convert lowering (see _dyn_gather's docstring).
+        zero, c128 = jnp.int32(0), jnp.int32(128)
+        hi = jnp.int32(2 * window - 1)
         for k in range(nchunk):
-            off = jnp.clip(off_all[k], 0, 2 * window - 1)   # [rows]
-            r = (off // 128).astype(jnp.int32)
-            c = (off % 128).astype(jnp.int32)
-            v = jnp.take_along_axis(
+            off = lax.max(lax.min(off_all[k], hi), zero)    # [rows]
+            r = lax.div(off, c128)
+            c = lax.rem(off, c128)
+            v = _dyn_gather(
                 tile, jnp.broadcast_to(r[:, None], (rows, 128)), axis=0)
-            sel = jnp.take_along_axis(
+            sel = _dyn_gather(
                 v, jnp.broadcast_to(c[:, None], (rows, 128)), axis=1)
             out_ref[k * rows:(k + 1) * rows] = sel[:, 0].astype(out_ref.dtype)
 
