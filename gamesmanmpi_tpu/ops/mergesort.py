@@ -33,6 +33,16 @@ compiler in a state where a later compile crashes — an upstream stress
 bug, not a correctness issue (every equivalence test passes). Treat
 GAMESMAN_SORT=merge as a per-process experimental flag; the default
 stays "xla" until the chip measurement decides (docs/CHIP_PLAN.md).
+
+MEASURED no-go (chip session r04, v5e): merge_sort u32 [32M] =
+1.13-1.16 s across row sizes vs jnp.sort's 0.15 s — the ladder LOSES
+7.5x. The premise failed on silicon: XLA's one-shot sort ran at
+1.76 GB/s (not the 0.85 GB/s round-3 figure), while the ladder's many
+full-array elementwise stages each pay real HBM traffic (measured
+elementwise ceiling ~4 GB/s through the relay) and their sum dwarfs the
+sort network. The u32+payload variant additionally crashed the relay's
+compile helper (HTTP 500). The flag stays for CPU experiments; do NOT
+flip it for accelerators.
 """
 
 from __future__ import annotations

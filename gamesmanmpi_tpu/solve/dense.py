@@ -965,21 +965,27 @@ class DenseSolver:
         # work (K-1 selects, K <= 23); take_along_axis emits a gather,
         # and XLA's TPU gathers measured ~11 ns/element (tools/microbench)
         # — at (1 + max_moves) * ncells lookups per position that would
-        # dominate the whole solve. Default to the predictable lowering;
+        # dominate the whole solve. CONFIRMED on the v5e (chip session
+        # r04, 5x5): onehot 9.04M pos/s vs take 212k — a 43x collapse,
+        # exactly the predicted gather catastrophe. onehot is the default;
         # GAMESMAN_DENSE_BINOM=take re-enables the gather for measurement.
         self.use_onehot = os.environ.get(
             "GAMESMAN_DENSE_BINOM", "onehot"
         ) != "take"
         # Child-ranking lowering: "fused" = one walk for all moves
         # (_rank_all_moves_fused), "simple" = per-move walks. Identical
-        # results (tests pin it); default simple until the chip measures
-        # both.
+        # results (tests pin it). MEASURED on the v5e (chip session r04,
+        # 5x5 A/B): simple 9.04M pos/s vs fused 4.83M — simple wins 1.9x
+        # and stays the default; the flag remains for re-measurement.
         self.use_fused = os.environ.get(
             "GAMESMAN_DENSE_RANK", "simple"
         ) == "fused"
         # Gather lowering: "sorted" fills invalid/pad lanes monotonically
         # and passes indices_are_sorted to XLA. Identical results (tests
-        # pin it); default plain until the chip measures both.
+        # pin it). MEASURED on the v5e (chip session r04): plain 9.04M
+        # pos/s vs sorted 6.35M — the hint costs extra fill arithmetic and
+        # buys nothing (microbench2: XLA's gather runs ~0.37 GB/s with or
+        # without sorted indices), so plain stays the default.
         self.use_sorted_gather = os.environ.get(
             "GAMESMAN_DENSE_GATHER", "plain"
         ) == "sorted"
