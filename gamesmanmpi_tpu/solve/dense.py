@@ -83,7 +83,7 @@ from gamesmanmpi_tpu.games.connect4 import Connect4
 from gamesmanmpi_tpu.ops.combine import combine_children
 from gamesmanmpi_tpu.solve.engine import get_kernel, schedule_kernel
 from gamesmanmpi_tpu.solve.precompile import sds
-from gamesmanmpi_tpu.utils.platform import backend_epoch
+from gamesmanmpi_tpu.utils.platform import backend_epoch, platform_auto_flag
 
 
 def _profiles_for_level(width: int, height: int, level: int) -> np.ndarray:
@@ -563,10 +563,20 @@ def _rank_all_moves_fused(bits, binom, cellidx, snapk, bitpos, rank_dtype,
     return snaps + acc_sh[None]
 
 
+# Kernel block / window for gather_mode="pallas". block=2048 divides every
+# _cblock (which rounds to a PALLAS_BLOCK multiple), so no kernel block
+# straddles a profile row; window=4*block covers child-rank spans up to a
+# ~4x per-level expansion ratio (near-full levels, where the time is, are
+# close to 1x). Blocks that still miss (tiny early levels can expand
+# faster) fall back per-call via lax.cond.
+PALLAS_BLOCK = 2048
+PALLAS_WINDOW = 8192
+
+
 def build_dense_step(tables: DenseTables, level: int, cblock: int,
                      rank_dtype, flat_dtype, use_onehot: bool,
                      fused_rank: bool = False,
-                     sorted_gather: bool = False):
+                     gather_mode: str = "plain"):
     """Build the backward step for one level at one block width.
 
     Returned fn:
@@ -578,9 +588,23 @@ def build_dense_step(tables: DenseTables, level: int, cblock: int,
        snapk [ncells, P] i32)
       -> cells [P, cblock] u8
 
-    sorted_gather replaces invalid rows' flat indices with a monotone fill
-    and gathers with indices_are_sorted=True (see level_consts move_fill)
-    — a lowering hint; results are identical either way.
+    gather_mode picks the child-cell gather lowering; results are
+    identical in all three (tests pin it):
+      "plain"  — clip + XLA gather (measured fastest XLA form on-chip);
+      "sorted" — invalid rows' flat indices get a monotone fill (see
+                 level_consts move_fill) and the gather carries
+                 indices_are_sorted=True (measured: the hint buys
+                 nothing, chip session r04);
+      "pallas" — the same monotone fill feeds the Pallas monotone-window
+                 gather (ops/pallas_gather.py), which streams window
+                 tiles through VMEM instead of issuing per-element HBM
+                 transactions. A block whose child-rank span exceeds the
+                 window misses; nmiss>0 falls back to the sorted-hint
+                 XLA gather for that call via lax.cond. Blocks never
+                 straddle profile rows (_cblock rounds to the kernel
+                 block), so spans are bounded by the per-level child
+                 expansion ratio and the big near-full levels — where
+                 the time is — run miss-free.
 
     fused_rank picks the single-walk child ranking
     (_rank_all_moves_fused) over the per-move walks; results are
@@ -589,6 +613,11 @@ def build_dense_step(tables: DenseTables, level: int, cblock: int,
 
     All shape-static; one compiled program per (level-shape, block width).
     """
+    # Resolved at build time (kernels are built per backend epoch): the
+    # Pallas kernel runs in interpret mode on CPU so the parity tests and
+    # the fake-mesh suite exercise the exact same program structure.
+    pallas_interpret = (gather_mode == "pallas"
+                        and jax.default_backend() == "cpu")
     w, h, connect = tables.width, tables.height, tables.connect
     ncells = tables.ncells
     dt = jnp.uint64 if tables.bits_dtype == np.uint64 else jnp.uint32
@@ -643,7 +672,7 @@ def build_dense_step(tables: DenseTables, level: int, cblock: int,
             flat = (move_row[:, c : c + 1].astype(flat_dtype)
                     * flat_dtype(Cc) + crank.astype(flat_dtype))
             ok = valid[:, c : c + 1] & jnp.ones((1, cblock), bool)
-            if sorted_gather:
+            if gather_mode in ("sorted", "pallas"):
                 # Invalid rows and pad lanes (rank >= C in the last block,
                 # whose unranked bits are garbage) get a monotone fill —
                 # invalid rows the previous valid row's LAST slot (or 0
@@ -661,9 +690,29 @@ def build_dense_step(tables: DenseTables, level: int, cblock: int,
                     fillr * flat_dtype(Cc) + flat_dtype(Cc - 1),
                 )
                 flat = jnp.where(ok & in_range, flat, fill)
-                cell = child_cells.at[flat.reshape(-1)].get(
-                    indices_are_sorted=True, mode="clip"
-                ).reshape(flat.shape)
+
+                def _xla_sorted(f=flat):
+                    return child_cells.at[f.reshape(-1)].get(
+                        indices_are_sorted=True, mode="clip"
+                    ).reshape(f.shape)
+
+                if gather_mode == "pallas":
+                    from gamesmanmpi_tpu.ops.pallas_gather import (
+                        monotone_window_gather,
+                    )
+
+                    out, nmiss = monotone_window_gather(
+                        child_cells, flat.reshape(-1).astype(jnp.int32),
+                        block=PALLAS_BLOCK, window=PALLAS_WINDOW,
+                        interpret=pallas_interpret,
+                    )
+                    cell = jax.lax.cond(
+                        nmiss == jnp.int32(0),
+                        lambda: out.reshape(flat.shape),
+                        _xla_sorted,
+                    )
+                else:
+                    cell = _xla_sorted()
             else:
                 cell = child_cells[
                     jnp.clip(flat, 0, child_cells.shape[0] - 1)
@@ -692,10 +741,10 @@ def build_dense_step(tables: DenseTables, level: int, cblock: int,
 
 def build_reach_step(tables: DenseTables, level: int, cblock: int,
                      rank_dtype, flat_dtype, use_onehot: bool,
-                     fused_rank: bool = False, sorted_gather: bool = False):
+                     fused_rank: bool = False, gather_mode: str = "plain"):
     """Build the reachability-sweep step for one level (level >= 1).
 
-    fused_rank/sorted_gather are accepted for builder-signature uniformity
+    fused_rank/gather_mode are accepted for builder-signature uniformity
     and ignored: the sweep's one-rank-per-column walk has no per-move
     fan-out to fuse (each column ranks a DIFFERENT parent bit pattern).
 
@@ -980,15 +1029,25 @@ class DenseSolver:
         self.use_fused = os.environ.get(
             "GAMESMAN_DENSE_RANK", "simple"
         ) == "fused"
-        # Gather lowering: "sorted" fills invalid/pad lanes monotonically
-        # and passes indices_are_sorted to XLA. Identical results (tests
-        # pin it). MEASURED on the v5e (chip session r04): plain 9.04M
-        # pos/s vs sorted 6.35M — the hint costs extra fill arithmetic and
-        # buys nothing (microbench2: XLA's gather runs ~0.37 GB/s with or
-        # without sorted indices), so plain stays the default.
-        self.use_sorted_gather = os.environ.get(
-            "GAMESMAN_DENSE_GATHER", "plain"
-        ) == "sorted"
+        # Gather lowering (identical results in all modes, tests pin it):
+        #   "plain"  — clip + XLA gather. MEASURED on the v5e (chip
+        #              session r04): 9.04M pos/s — the fastest XLA form.
+        #   "sorted" — monotone fill + indices_are_sorted hint. MEASURED:
+        #              6.35M — the hint costs fill arithmetic and buys
+        #              nothing (microbench2: XLA's gather runs ~0.37 GB/s
+        #              with or without sorted indices).
+        #   "pallas" — monotone fill + the Pallas monotone-window gather
+        #              (ops/pallas_gather.py), streaming window tiles
+        #              through VMEM; per-call lax.cond fallback to the
+        #              sorted XLA gather when any block's span misses its
+        #              window. The dense backward is ~pure gather (8.6e8
+        #              operand bytes at 0.112 GB/s, r04), so this is the
+        #              candidate past 9M pos/s; go/no-go is
+        #              tools/pallas_chip_check.py on silicon.
+        self.gather_mode = platform_auto_flag(
+            "GAMESMAN_DENSE_GATHER", accel="plain", cpu="plain",
+            choices=("plain", "sorted", "pallas"),
+        )
         nc = self.tables.ncells
         max_class = max(self.tables.class_size)
         self._rank_dtype = (jnp.uint32 if max_class < (1 << 31)
@@ -998,6 +1057,15 @@ class DenseSolver:
             for L in range(nc + 1)
         )
         self._flat_dtype = jnp.int32 if max_flat < (1 << 31) else jnp.int64
+        if self.gather_mode == "pallas" and self._flat_dtype != jnp.int32:
+            # The Mosaic kernel takes int32 indices (64-bit types don't
+            # lower); boards whose flat index space passes 2^31 (6x6+)
+            # would need block-local offsets computed outside the kernel.
+            raise ValueError(
+                "GAMESMAN_DENSE_GATHER=pallas requires the board's flat "
+                f"index space to fit int32; {game.name} needs int64 "
+                "(future work: pre-subtracted block-local offsets)"
+            )
 
     @property
     def _board_key(self):
@@ -1005,13 +1073,13 @@ class DenseSolver:
         return (g.width, g.height, g.connect)
 
     def _kernel(self, kind: str, level: int, cblock: int, builder):
-        t, rd, fd, oh, fr, sg = (self.tables, self._rank_dtype,
+        t, rd, fd, oh, fr, gm = (self.tables, self._rank_dtype,
                                  self._flat_dtype, self.use_onehot,
-                                 self.use_fused, self.use_sorted_gather)
+                                 self.use_fused, self.gather_mode)
         return get_kernel(
             self.game, kind, self._kernel_key(kind, level, cblock),
             lambda g: builder(t, level, cblock, rd, fd, oh, fused_rank=fr,
-                              sorted_gather=sg),
+                              gather_mode=gm),
         )
 
     def _rank0(self, b: int, cblock: int):
@@ -1024,6 +1092,14 @@ class DenseSolver:
         P = len(self.tables.profiles[level])
         C = self.tables.class_size[level]
         cblock = max(min(C, max(self.block_elems // max(P, 1), 1)), 1)
+        if self.gather_mode == "pallas" and cblock >= PALLAS_BLOCK:
+            # Round to a PALLAS_BLOCK multiple so the Pallas kernel's
+            # blocks never straddle a profile row (a straddling block's
+            # index span is ~the child class size — a guaranteed window
+            # miss). Only in pallas mode: the rounding changes cblock,
+            # which keys every kernel cache entry — the other modes would
+            # recompile their whole program set for nothing.
+            cblock -= cblock % PALLAS_BLOCK
         return cblock, -(-C // cblock)
 
     def _avals(self, level: int, cblock: int, for_reach: bool):
@@ -1064,13 +1140,13 @@ class DenseSolver:
         )
 
     def _kernel_key(self, kind: str, level: int, cblock: int):
-        # use_fused/use_sorted_gather only change dense_step lowering;
+        # use_fused/gather_mode only change dense_step lowering;
         # keying them into the reach kernels would recompile byte-identical
         # programs on a flag flip (seconds each over the relay).
         fused = self.use_fused if kind == "dense_step" else False
-        sg = self.use_sorted_gather if kind == "dense_step" else False
+        gm = self.gather_mode if kind == "dense_step" else "plain"
         return (
-            kind, level, cblock, self.use_onehot, fused, sg,
+            kind, level, cblock, self.use_onehot, fused, gm,
             str(self._rank_dtype), str(self._flat_dtype),
         )
 
@@ -1093,14 +1169,14 @@ class DenseSolver:
         def sched(kind, level, builder, for_reach):
             cblock, _ = self._cblock(level)
             key = self._kernel_key(kind, level, cblock)
-            rd, fd, oh, fr, sg = (self._rank_dtype, self._flat_dtype,
+            rd, fd, oh, fr, gm = (self._rank_dtype, self._flat_dtype,
                                   self.use_onehot, self.use_fused,
-                                  self.use_sorted_gather)
+                                  self.gather_mode)
             P = len(t.profiles[level])
             schedule_kernel(
                 self.game, kind, key,
                 lambda g: builder(t, level, cblock, rd, fd, oh,
-                                  fused_rank=fr, sorted_gather=sg),
+                                  fused_rank=fr, gather_mode=gm),
                 self._avals(level, cblock, for_reach),
                 heavy=P * cblock * 8 > (512 << 20),
             )

@@ -199,6 +199,32 @@ def test_dense_sorted_gather_matches_plain(monkeypatch):
         np.testing.assert_array_equal(both.cells[L], cells)
 
 
+def test_dense_pallas_gather_matches_plain(monkeypatch):
+    # GAMESMAN_DENSE_GATHER=pallas routes the monotone fill through the
+    # Mosaic monotone-window gather (interpret mode on CPU) with the
+    # lax.cond miss fallback; every cell of every level table must match
+    # the plain-gather solve. block_elems sized so the big 4x4 levels get
+    # cblock >= PALLAS_BLOCK (the rounded, row-aligned fast path) while
+    # small levels take the fallback — both paths in one solve.
+    g = get_game("connect4:w=4,h=4")
+    plain = DenseSolver(g, block_elems=150_000).solve()
+    monkeypatch.setenv("GAMESMAN_DENSE_GATHER", "pallas")
+    pal = DenseSolver(g, block_elems=150_000).solve()
+    assert (pal.value, pal.remoteness, pal.num_positions) == (
+        plain.value, plain.remoteness, plain.num_positions
+    )
+    for L, cells in plain.cells.items():
+        np.testing.assert_array_equal(pal.cells[L], cells)
+
+
+def test_dense_pallas_gather_rejects_int64_boards(monkeypatch):
+    # The Mosaic kernel takes int32 indices; boards whose flat index
+    # space passes 2^31 must fail fast at construction, not mid-solve.
+    monkeypatch.setenv("GAMESMAN_DENSE_GATHER", "pallas")
+    with pytest.raises(ValueError, match="pallas"):
+        DenseSolver(get_game("connect4:w=6,h=6"))
+
+
 def test_dense_blocked_levels_match_unblocked():
     # Tiny block_elems forces nblk > 1 on every non-trivial level,
     # exercising the block concat + tail-slice path end to end.

@@ -126,6 +126,10 @@ def main() -> int:
         REPO, "artifacts", "chip_session.jsonl"))
     ap.add_argument("--quick", action="store_true",
                     help="skip the board ladder (steps 3+)")
+    ap.add_argument("--phase2", action="store_true",
+                    help="run only what the r04 mid-plan relay death left: "
+                         "pallas chip check, pallas-gather 5x5 A/B, hybrid "
+                         "k16/k20, the board ladder, the full bench")
     args = ap.parse_args()
     s = Session(args.out)
     py = sys.executable
@@ -140,24 +144,37 @@ def main() -> int:
     b55 = {"BENCH_SYM": "0", "BENCH_LADDER": "0",
            "BENCH_GAME": "connect4:w=5,h=5", "BENCH_REPEATS": "2"}
 
-    # §1 primitive costs (microbench2's lines land in stdout_tail).
-    s.step("microbench2", [py, os.path.join(REPO, "tools", "microbench2.py")],
-           timeout=1800, parse_json=False)
+    if args.phase2:
+        # Only what the r04 mid-plan relay death left unmeasured; falls
+        # through to the shared board-ladder / full-bench tail below.
+        s.step("pallas_chip_check",
+               [py, os.path.join(REPO, "tools", "pallas_chip_check.py")],
+               timeout=1200, parse_json=False)
+        s.step("dense_gather_pallas", bench,
+               env={**b55, "GAMESMAN_DENSE_GATHER": "pallas"})
+        hybrid_ks = (16, 20)
+    else:
+        # §1 primitive costs (microbench2's lines land in stdout_tail).
+        s.step("microbench2",
+               [py, os.path.join(REPO, "tools", "microbench2.py")],
+               timeout=1800, parse_json=False)
 
-    # §2 dense lowering A/B on 5x5.
-    s.step("dense_default", bench, env=b55)
-    s.step("dense_rank_fused", bench, env={**b55, "GAMESMAN_DENSE_RANK": "fused"})
-    s.step("dense_gather_sorted", bench,
-           env={**b55, "GAMESMAN_DENSE_GATHER": "sorted"})
-    s.step("dense_fused_sorted", bench,
-           env={**b55, "GAMESMAN_DENSE_RANK": "fused",
-                "GAMESMAN_DENSE_GATHER": "sorted"})
-    s.step("dense_binom_take", bench,
-           env={**b55, "GAMESMAN_DENSE_BINOM": "take"}, timeout=1800)
-    s.step("classic_5x5", bench, env={**b55, "BENCH_ENGINE": "classic"})
+        # §2 dense lowering A/B on 5x5.
+        s.step("dense_default", bench, env=b55)
+        s.step("dense_rank_fused", bench,
+               env={**b55, "GAMESMAN_DENSE_RANK": "fused"})
+        s.step("dense_gather_sorted", bench,
+               env={**b55, "GAMESMAN_DENSE_GATHER": "sorted"})
+        s.step("dense_fused_sorted", bench,
+               env={**b55, "GAMESMAN_DENSE_RANK": "fused",
+                    "GAMESMAN_DENSE_GATHER": "sorted"})
+        s.step("dense_binom_take", bench,
+               env={**b55, "GAMESMAN_DENSE_BINOM": "take"}, timeout=1800)
+        s.step("classic_5x5", bench, env={**b55, "BENCH_ENGINE": "classic"})
+        hybrid_ks = (12, 16, 20)
 
     # §2b hybrid cutover scan on 5x5.
-    for k in (12, 16, 20):
+    for k in hybrid_ks:
         s.step(f"hybrid_k{k}", bench,
                env={**b55, "BENCH_ENGINE": "hybrid",
                     "GAMESMAN_HYBRID_CUTOVER": str(k)})
