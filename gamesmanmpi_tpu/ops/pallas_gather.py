@@ -68,6 +68,15 @@ def _dyn_gather(x, idx, axis: int):
         mode=lax.GatherScatterMode.PROMISE_IN_BOUNDS)
 
 
+def padded_table_len(m: int, window: int) -> int:
+    """Table length monotone_window_gather pads to internally: a whole
+    number of windows, at least two (so tile q+1 always exists). Callers
+    that gather repeatedly from one table (the dense backward's w
+    per-move gathers) pre-pad to this length once, making the kernel's
+    internal pad a no-op."""
+    return max(-(-m // window), 2) * window
+
+
 def monotone_window_gather(table, idx, block: int = 2048,
                            window: int = 8192, interpret: bool = False):
     """table [M] uint32, idx [N] int32 non-decreasing ->
@@ -100,8 +109,9 @@ def monotone_window_gather(table, idx, block: int = 2048,
     nblk = idx.shape[0] // block
     # Window-aligned base of each block's view, clamped so tile q+1 exists.
     m = table.shape[0]
-    nwin = max(-(-m // window), 2)
-    tpad = nwin * window - m
+    padded = padded_table_len(m, window)
+    nwin = padded // window
+    tpad = padded - m
     if tpad:
         table = jnp.concatenate(
             [table, jnp.zeros((tpad,), table.dtype)]

@@ -659,6 +659,19 @@ def build_dense_step(tables: DenseTables, level: int, cblock: int,
                 p1, binom, cellidx, snapk, bitpos, rank_dtype, use_onehot,
                 p1_moves, w, h,
             )
+        if gather_mode == "pallas":
+            # Window-pad the child table ONCE per step so the kernel's
+            # internal pad (a full-table copy) is a no-op for all w move
+            # gathers. The XLA fallback keeps the unpadded table.
+            from gamesmanmpi_tpu.ops.pallas_gather import padded_table_len
+
+            m = child_cells.shape[0]
+            tpad = padded_table_len(m, PALLAS_WINDOW) - m
+            child_cells_pal = (
+                jnp.concatenate(
+                    [child_cells, jnp.zeros((tpad,), child_cells.dtype)]
+                ) if tpad else child_cells
+            )
         child_vals = []
         child_rems = []
         masks = []
@@ -702,7 +715,7 @@ def build_dense_step(tables: DenseTables, level: int, cblock: int,
                     )
 
                     out, nmiss = monotone_window_gather(
-                        child_cells, flat.reshape(-1).astype(jnp.int32),
+                        child_cells_pal, flat.reshape(-1).astype(jnp.int32),
                         block=PALLAS_BLOCK, window=PALLAS_WINDOW,
                         interpret=pallas_interpret,
                     )
