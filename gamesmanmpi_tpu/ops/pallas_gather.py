@@ -120,13 +120,24 @@ def monotone_window_gather(table, idx, block: int = 2048,
     base_win = jnp.clip(starts // window, 0, nwin - 2).astype(jnp.int32)
     aligned = base_win * window
 
+    # The table reaches the kernel as a [padded/128, 128] matrix, reshaped
+    # ONCE outside (a free XLA relayout): an in-kernel rank-1 -> rank-2
+    # reshape is a Mosaic shape cast, and for packed dtypes (the dense
+    # engine's u8 cells) layout inference rejects it on chip —
+    # "infer-vector-layout: unsupported shape cast, vector<8192xi8> ->
+    # vector<64x128xi8>" (chip session r04). With 2-D BlockSpecs the tiles
+    # arrive already [window/128, 128] and no shape cast exists for ANY
+    # table dtype.
+    wrows = window // 128
+    table2d = table.reshape(padded // 128, 128)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # aligned bases (element units + window units)
         grid=(nblk,),
         in_specs=[
             pl.BlockSpec((block,), lambda i, al, bw: (i,)),
-            pl.BlockSpec((window,), lambda i, al, bw: (bw[i],)),
-            pl.BlockSpec((window,), lambda i, al, bw: (bw[i] + 1,)),
+            pl.BlockSpec((wrows, 128), lambda i, al, bw: (bw[i], 0)),
+            pl.BlockSpec((wrows, 128), lambda i, al, bw: (bw[i] + 1, 0)),
         ],
         out_specs=[
             pl.BlockSpec((block,), lambda i, al, bw: (i,)),
@@ -136,24 +147,24 @@ def monotone_window_gather(table, idx, block: int = 2048,
     def kernel(al_ref, bw_ref, idx_ref, t0_ref, t1_ref, out_ref):
         i = pl.program_id(0)
         base = al_ref[i]
-        # [rows, 128] row-major view of the two window tiles. Sub-32-bit
-        # tables (the dense engine's u8 cells) gather as i32 — Mosaic's
-        # dynamic_gather targets 32-bit lanes; the cast back on store is
-        # exact for unsigned sub-ranges.
-        tile = jnp.concatenate(
-            [t0_ref[:].reshape(window // 128, 128),
-             t1_ref[:].reshape(window // 128, 128)], axis=0)
+        # [rows, 128] view of the two window tiles. Sub-32-bit tables (the
+        # dense engine's u8 cells) gather as i32 — Mosaic's dynamic_gather
+        # targets 32-bit lanes; the cast back on store is exact for
+        # unsigned sub-ranges.
+        tile = jnp.concatenate([t0_ref[:], t1_ref[:]], axis=0)
         if tile.dtype.itemsize < 4:
             tile = tile.astype(jnp.int32)
-        off_all = (idx_ref[:] - base).reshape(nchunk, rows)
         # All scalars below are pinned int32: under jax_enable_x64 bare
         # Python ints trace as weak int64 scalars, and ANY int64 in a
         # Mosaic kernel hits the infinitely-recursing int64->int32
-        # convert lowering (see _dyn_gather's docstring).
+        # convert lowering (see _dyn_gather's docstring). Chunks are
+        # STATIC rank-1 slices of idx_ref — a [nchunk, rows] reshape
+        # would be another Mosaic shape cast (see the tile note above).
         zero, c128 = jnp.int32(0), jnp.int32(128)
         hi = jnp.int32(2 * window - 1)
         for k in range(nchunk):
-            off = lax.max(lax.min(off_all[k], hi), zero)    # [rows]
+            off = idx_ref[k * rows:(k + 1) * rows] - base   # [rows]
+            off = lax.max(lax.min(off, hi), zero)
             r = lax.div(off, c128)
             c = lax.rem(off, c128)
             v = _dyn_gather(
@@ -169,7 +180,7 @@ def monotone_window_gather(table, idx, block: int = 2048,
         ],
         grid_spec=grid_spec,
         interpret=interpret,
-    )(aligned, base_win, idx, table, table)
+    )(aligned, base_win, idx, table2d, table2d)
     # Misses depend only on idx and the precomputed window bases, so the
     # count lives OUTSIDE the kernel as one fused elementwise XLA pass
     # (see module docstring: Mosaic's rank-1 output block rule).

@@ -751,21 +751,58 @@ class ShardedSolver:
         counts = np.array([a.shape[0] for a in shards], dtype=np.int64)
         return shards, counts
 
-    def _forward_fast(self, init, start_level: int) -> Dict[int, _SLevel]:
+    def _forward_fast(self, init, start_level: int,
+                      resume: Dict[int, list] | None = None,
+                      ) -> Dict[int, _SLevel]:
         """Device-resident forward sweep for uniform_level_jump games.
 
         The frontier chains on device: each level's routed+dedup'd children
         (already per-shard sorted) are resized to the next capacity bucket
         without leaving HBM. Host work per level: one counts sync.
+
+        With a checkpointer, every discovered level's shard rows are saved
+        immediately (save_forward_level_shard; sealed per level by process
+        0 post-barrier) so a death mid-discovery keeps the prefix; `resume`
+        is that prefix ({level: per-shard arrays} at THIS shard count) and
+        expansion continues from its deepest level. The consolidated
+        end-of-forward snapshot still supersedes these files on completion
+        — it alone supports shard-count-changing resumes.
         """
         g = self.game
         S = self.S
-        shards, counts = self._seed(init)
-        cap = bucket_size(int(counts.max()), self.min_bucket)
-        frontier = jax.device_put(_pad_shards(shards, cap), self._sharding)
-        levels = {start_level: _SLevel(counts, frontier, shards)}
+        if resume:
+            ks = sorted(resume)
+            if ks != list(range(ks[0], ks[-1] + 1)) or ks[0] != start_level:
+                raise SolverError(
+                    f"forward checkpoint levels {ks} are not contiguous "
+                    f"from the root level {start_level} — stale checkpoint "
+                    "directory?"
+                )
+            levels = {}
+            for kk in ks:
+                shards = [np.asarray(a, dtype=g.state_dtype)
+                          for a in resume[kk]]
+                levels[kk] = _SLevel(
+                    np.array([a.shape[0] for a in shards], dtype=np.int64),
+                    None, shards,
+                )
+            k = ks[-1]
+            deep = levels[k]
+            counts = deep.counts
+            cap = bucket_size(int(counts.max()), self.min_bucket)
+            frontier = jax.device_put(
+                _pad_shards(deep.host, cap), self._sharding
+            )
+            deep.dev = frontier
+        else:
+            shards, counts = self._seed(init)
+            cap = bucket_size(int(counts.max()), self.min_bucket)
+            frontier = jax.device_put(_pad_shards(shards, cap),
+                                      self._sharding)
+            levels = {start_level: _SLevel(counts, frontier, shards)}
+            k = start_level
+            self._ckpt_forward_level(k, levels[k])
         stored_bytes = frontier.nbytes
-        k = start_level
         while True:
             t0 = time.perf_counter()
             b0 = (self.bytes_routed, self.bytes_sorted)
@@ -807,6 +844,7 @@ class ShardedSolver:
             levels[k + 1] = rec
             frontier = nxt
             cap = next_cap
+            self._ckpt_forward_level(k + 1, rec)
             if self.logger is not None:
                 self.logger.log(
                     {
@@ -1339,6 +1377,25 @@ class ShardedSolver:
 
             multihost_utils.sync_global_devices(tag)
 
+    def _ckpt_forward_level(self, k: int, rec) -> None:
+        """Incrementally checkpoint one just-discovered level's shards.
+
+        Forward alone outlasts the preemption/MTBF horizon at big-board
+        scale; per-level saves keep the discovered prefix on a death
+        mid-sweep (the single-device engine does the same). Each process
+        writes only its addressable shards; process 0 seals the level after
+        the barrier, so a torn level is never listed in the manifest.
+        """
+        if self.checkpointer is None:
+            return
+        for s in range(self.S):
+            rows = self._shard_rows(rec, s)
+            if rows is not None:
+                self.checkpointer.save_forward_level_shard(k, s, rows)
+        self._sync_processes(f"forward_level_{k}_shards_written")
+        if jax.process_index() == 0:
+            self.checkpointer.finish_forward_level(k, self.S)
+
     def _checkpoint_frontier_shards(self, levels) -> None:
         """Per-shard frontier snapshot files, one shard at a time.
 
@@ -1421,12 +1478,26 @@ class ShardedSolver:
                     shards,
                 )
         elif self.fast:
-            levels = self._forward_fast(init, start_level)
+            # A previous run's interrupted forward left sealed per-level
+            # shard files at this shard count: continue from its deepest.
+            partial = (
+                self.checkpointer.load_forward_level_shards(self.S)
+                if self.checkpointer is not None
+                else {}
+            )
+            levels = self._forward_fast(init, start_level,
+                                        resume=partial or None)
         else:
             levels = self._forward_generic(init, start_level)
         if (saved is None and saved_shards is None
                 and self.checkpointer is not None):
             self._checkpoint_frontier_shards(levels)
+            self._sync_processes("forward_level_files_superseded")
+            if jax.process_index() == 0:
+                # The consolidated snapshot is sealed; the incremental
+                # per-level files are now a redundant second copy of the
+                # biggest artifact on disk.
+                self.checkpointer.drop_forward_level_shards()
         t_forward = time.perf_counter() - t0
         # Positions counted from the per-shard counters, not the tables —
         # valid in store_tables=False mode too.

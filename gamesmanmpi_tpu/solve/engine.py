@@ -788,7 +788,9 @@ class Solver:
 
     # ------------------------------------------------------------- fast phase
 
-    def _forward_fast(self, init, start_level: int) -> Dict[int, _Level]:
+    def _forward_fast(self, init, start_level: int,
+                      resume: Optional[Dict[int, np.ndarray]] = None,
+                      ) -> Dict[int, _Level]:
         """Device-resident forward sweep for uniform_level_jump games.
 
         Two latency hiders on top of the level loop:
@@ -801,17 +803,44 @@ class Solver:
           most levels keep their bucket, so the device computes through the
           sync instead of idling. A mispredicted bucket just re-dispatches
           at the right capacity — the speculative result is dropped.
+
+        With a checkpointer, each level's frontier is saved the moment its
+        count is known (same total bytes as the old end-of-forward snapshot
+        — host_states() caches the download — but a mid-forward death keeps
+        the prefix). `resume` is that prefix from a previous interrupted
+        run: expansion continues from its deepest level; earlier levels
+        carry no provenance, so the backward pass uses the lookup join for
+        them, exactly as for budget-evicted levels.
         """
         g = self.game
         levels: Dict[int, _Level] = {}
-        # init: one root state, or a whole sorted frontier (the hybrid
-        # engine starts BFS at its cutover level's reachable set).
-        host0 = np.atleast_1d(np.asarray(init, dtype=g.state_dtype))
+        if resume:
+            ks = sorted(resume)
+            if ks != list(range(ks[0], ks[-1] + 1)) or ks[0] != start_level:
+                raise SolverError(
+                    f"forward checkpoint levels {ks} are not contiguous from "
+                    f"the root level {start_level} — stale checkpoint "
+                    "directory?"
+                )
+            for kk in ks:
+                arr = np.asarray(resume[kk], dtype=g.state_dtype)
+                levels[kk] = _Level(arr.shape[0], arr, None)
+            k = ks[-1]
+            host0 = levels[k].host
+        else:
+            # init: one root state, or a whole sorted frontier (the hybrid
+            # engine starts BFS at its cutover level's reachable set).
+            host0 = np.atleast_1d(np.asarray(init, dtype=g.state_dtype))
+            k = start_level
         cap0 = bucket_size(host0.shape[0], self.min_bucket)
         frontier = jnp.asarray(pad_to(host0, cap0))
-        levels[start_level] = _Level(host0.shape[0], host0, frontier)
+        if resume:
+            levels[k].dev = frontier
+        else:
+            levels[k] = _Level(host0.shape[0], host0, frontier)
+            if self.checkpointer is not None:
+                self.checkpointer.save_frontier_level(k, host0)
         stored_bytes = frontier.nbytes
-        k = start_level
         # Speculation hides the ~65 ms relay host-sync; on CPU the sync is
         # microseconds and a dropped speculative expand is real wasted work.
         speculate = platform_auto_bool(
@@ -897,6 +926,9 @@ class Solver:
                 stored_bytes += nxt.nbytes
             levels[k + 1] = rec
             frontier = nxt
+            if self.checkpointer is not None:
+                self.checkpointer.save_frontier_level(k + 1,
+                                                      rec.host_states())
             item = np.dtype(g.state_dtype).itemsize
             # Only operands of actual sorts count (the traffic denominator
             # must match the kernel the platform lowered).
@@ -1287,10 +1319,26 @@ class Solver:
             if self.checkpointer is not None
             else None
         )
+        # A previous run's interrupted forward left per-level frontier
+        # files: continue expansion from its deepest level.
+        partial = (
+            self.checkpointer.load_forward_levels()
+            if self.fast and saved is None and self.checkpointer is not None
+            else {}
+        )
         if self.fast and saved is None:
-            # Resumed runs skip forward discovery entirely — the ladder's
-            # speculative forward compiles would be dead weight.
-            self._schedule_initial_ladder()
+            if not partial:
+                # Fully-resumed runs skip forward discovery entirely — the
+                # ladder's speculative forward compiles would be dead
+                # weight; mid-forward resumes (below) seed the plan at the
+                # resumed capacity instead of the root's min_bucket.
+                self._schedule_initial_ladder()
+            else:
+                cap = bucket_size(partial[max(partial)].shape[0],
+                                  self.min_bucket)
+                for c in (cap, cap * 2, cap * 4):
+                    self._sched_fwd_step(c)
+                    self._sched_bwd_step(min(c, self._block_size()), c)
         init, start_level = canonical_scalar(g, g.initial_state())
         if self.fast:
             if saved is not None:
@@ -1300,11 +1348,10 @@ class Solver:
                     for k, v in saved.items()
                 }
             else:
-                levels = self._forward_fast(init, start_level)
+                levels = self._forward_fast(init, start_level,
+                                            resume=partial or None)
                 if self.checkpointer is not None:
-                    self.checkpointer.save_frontiers(
-                        {k: rec.host_states() for k, rec in levels.items()}
-                    )
+                    self.checkpointer.mark_frontiers_complete()
             t_forward = time.perf_counter() - t0
             num_positions = sum(rec.n for rec in levels.values())
             resolved = self._backward_fast(levels, start_level)

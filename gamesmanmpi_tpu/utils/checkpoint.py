@@ -58,6 +58,17 @@ class LevelCheckpointer:
     def _level_path(self, level: int) -> pathlib.Path:
         return self.dir / f"level_{level:04d}.npz"
 
+    def _write_manifest(self, manifest: dict) -> None:
+        """Atomic replace, never truncate-in-place: under multi-host, only
+        process 0 writes the manifest, but PEERS read it concurrently
+        (completed_levels at backward start races the post-barrier seals)
+        — a torn read crashed a two-process run with JSONDecodeError
+        (round 4). os.replace guarantees readers see old-or-new, never
+        partial."""
+        tmp = self.manifest_path.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps(manifest))
+        os.replace(tmp, self.manifest_path)
+
     def bind_game(self, name: str) -> None:
         """Record/validate which game this directory belongs to.
 
@@ -70,7 +81,7 @@ class LevelCheckpointer:
         bound = manifest.get("game")
         if bound is None:
             manifest["game"] = name
-            self.manifest_path.write_text(json.dumps(manifest))
+            self._write_manifest(manifest)
         elif bound != name:
             raise ValueError(
                 f"checkpoint directory {self.dir} belongs to game {bound!r}, "
@@ -86,7 +97,7 @@ class LevelCheckpointer:
         )
         manifest = self.load_manifest()
         manifest["levels"] = sorted(set(manifest.get("levels", [])) | {level})
-        self.manifest_path.write_text(json.dumps(manifest))
+        self._write_manifest(manifest)
 
     def load_manifest(self) -> dict:
         if self.manifest_path.exists():
@@ -148,7 +159,7 @@ class LevelCheckpointer:
     def finish_level_shards(self, level: int, num_shards: int) -> None:
         manifest = self.load_manifest()
         manifest.setdefault("sharded_levels", {})[str(level)] = num_shards
-        self.manifest_path.write_text(json.dumps(manifest))
+        self._write_manifest(manifest)
 
     def level_shard_count(self, level: int):
         """Shards the level was saved with, or None if not saved sharded."""
@@ -203,6 +214,60 @@ class LevelCheckpointer:
         values, remoteness = unpack_cells_np(cells[i : i + 1])
         return int(values[0]), int(remoteness[0])
 
+    # Incremental per-(level, shard) forward saves — the sharded analog of
+    # save_frontier_level: written as each level is discovered, superseded
+    # by the consolidated per-shard snapshot once forward completes (the
+    # format load_frontier_shards/load_frontiers already resume from, which
+    # also supports shard-count changes), then deleted.
+
+    def save_forward_level_shard(self, level: int, shard: int,
+                                 states) -> None:
+        _savez(
+            self.dir / f"frontier_{level:04d}.shard_{shard:04d}.npz",
+            states=np.asarray(states),
+        )
+
+    def finish_forward_level(self, level: int, num_shards: int) -> None:
+        """Seal one forward level's shard set (process 0, post-barrier —
+        same write discipline as finish_level_shards)."""
+        manifest = self.load_manifest()
+        manifest.setdefault("forward_level_shards", {})[str(level)] = (
+            num_shards
+        )
+        self._write_manifest(manifest)
+
+    def load_forward_level_shards(self, num_shards: int) -> dict:
+        """-> {level: [per-shard arrays]} of every sealed forward level, a
+        (possibly partial) discovery prefix; {} when none exist or any
+        level was sealed at a different shard count (shard-to-shard resume
+        only — a changed mesh re-runs forward)."""
+        rec = self.load_manifest().get("forward_level_shards", {})
+        out: dict = {}
+        for k, saved in rec.items():
+            if saved != num_shards:
+                return {}
+            arrs = []
+            for s in range(num_shards):
+                path = self.dir / (
+                    f"frontier_{int(k):04d}.shard_{s:04d}.npz"
+                )
+                with np.load(path) as z:
+                    arrs.append(z["states"])
+            out[int(k)] = arrs
+        return out
+
+    def drop_forward_level_shards(self) -> None:
+        """Forward completed and the consolidated snapshot is sealed: the
+        incremental files are now redundant on disk (at big-run scale the
+        frontier set is the largest artifact — keep exactly one copy)."""
+        manifest = self.load_manifest()
+        for k in manifest.pop("forward_level_shards", {}):
+            for path in self.dir.glob(
+                f"frontier_{int(k):04d}.shard_*.npz"
+            ):
+                path.unlink(missing_ok=True)
+        self._write_manifest(manifest)
+
     def save_frontier_shard(self, shard: int, pools) -> None:
         """One shard's slice of every frontier level, one file."""
         arrays = {
@@ -215,7 +280,7 @@ class LevelCheckpointer:
     def finish_frontier_shards(self, num_shards: int) -> None:
         manifest = self.load_manifest()
         manifest["frontier_shards"] = num_shards
-        self.manifest_path.write_text(json.dumps(manifest))
+        self._write_manifest(manifest)
 
     def load_frontier_shards(self, num_shards: int):
         """-> {level: [per-shard arrays]} when saved with num_shards, else
@@ -235,6 +300,47 @@ class LevelCheckpointer:
     # Forward-phase snapshot: all per-level frontiers after discovery, so a
     # restarted solve skips the whole forward sweep (restart-from-level,
     # SURVEY.md §5.4 — the backward phase then loads completed levels).
+    #
+    # Two granularities. The original all-at-once snapshot (save_frontiers)
+    # only helps once forward COMPLETES; at big-board scale forward alone is
+    # a multi-hour phase, longer than this environment's observed relay MTBF
+    # (docs/ARCHITECTURE.md "6x6 single-chip feasibility"), so the fast-path
+    # engine saves each level INCREMENTALLY as it is discovered
+    # (save_frontier_level) and marks completion with a manifest flag — same
+    # total bytes as the end snapshot, but a mid-forward death keeps the
+    # discovered prefix and the next run resumes expansion from the deepest
+    # saved level instead of restarting discovery from the root.
+
+    def save_frontier_level(self, level: int, states) -> None:
+        """One discovered level's frontier, saved the moment its count is
+        known. The manifest records the level only after the file is fully
+        written, so a death mid-write never yields a listed-but-corrupt
+        entry (same discipline as save_level)."""
+        _savez(
+            self.dir / f"frontier_{level:04d}.npz", states=np.asarray(states)
+        )
+        manifest = self.load_manifest()
+        manifest["forward_levels"] = sorted(
+            set(manifest.get("forward_levels", [])) | {level}
+        )
+        self._write_manifest(manifest)
+
+    def load_forward_levels(self) -> dict:
+        """-> {level: sorted packed states} saved incrementally during a
+        (possibly interrupted) forward sweep; {} when none exist."""
+        out = {}
+        for k in self.load_manifest().get("forward_levels", []):
+            with np.load(self.dir / f"frontier_{int(k):04d}.npz") as z:
+                out[int(k)] = z["states"]
+        return out
+
+    def mark_frontiers_complete(self) -> None:
+        """Forward discovery finished; every level is on disk via
+        save_frontier_level. load_frontiers then serves resumes from the
+        per-level files — no end-of-forward re-snapshot."""
+        manifest = self.load_manifest()
+        manifest["frontiers_complete"] = True
+        self._write_manifest(manifest)
 
     def save_frontiers(self, pools) -> None:
         # Frontiers keep the game's state dtype (uint32 games stay uint32 —
@@ -245,7 +351,7 @@ class LevelCheckpointer:
         _savez(self.dir / "frontiers.npz", **arrays)
         manifest = self.load_manifest()
         manifest["frontiers"] = True
-        self.manifest_path.write_text(json.dumps(manifest))
+        self._write_manifest(manifest)
 
     def load_frontiers(self):
         """-> {level: sorted packed states} or None if no snapshot exists.
@@ -263,6 +369,8 @@ class LevelCheckpointer:
                     for name in z.files:
                         out[int(name.split("_")[1])] = z[name]
                 return out
+        if manifest.get("frontiers_complete"):
+            return self.load_forward_levels()
         num = manifest.get("frontier_shards")
         if num is None:
             return None

@@ -101,6 +101,112 @@ def test_checkpoint_resume_skips_recompute(tmp_path):
     assert resumed.num_positions == first.num_positions
 
 
+def test_forward_checkpoint_resume_mid_forward(tmp_path):
+    """A run killed mid-DISCOVERY resumes from the deepest saved frontier.
+
+    Forward alone is a multi-hour phase at big-board scale — longer than
+    the environment's relay MTBF — so frontiers are checkpointed per level
+    as discovered, not only after the sweep completes (the r04 gap the 6x6
+    feasibility analysis named). The kill lands after level 3's save; the
+    resumed run must re-expand only from level 3 down and still match the
+    uncheckpointed solve exactly.
+    """
+    d = str(tmp_path / "fwd_resume")
+    full = Solver(get_game("tictactoe")).solve()
+
+    class _Die(Exception):
+        pass
+
+    ckpt = LevelCheckpointer(d)
+    orig = LevelCheckpointer.save_frontier_level
+
+    def dying(level, states):
+        orig(ckpt, level, states)
+        if level >= 3:
+            raise _Die()
+
+    ckpt.save_frontier_level = dying
+    with pytest.raises(_Die):
+        Solver(get_game("tictactoe"), checkpointer=ckpt).solve()
+    assert LevelCheckpointer(d).load_manifest()["forward_levels"] == [0, 1, 2, 3]
+
+    resumed_ckpt = LevelCheckpointer(d)
+    saved_during_resume = []
+
+    def recording(level, states):
+        saved_during_resume.append(level)
+        orig(resumed_ckpt, level, states)
+
+    resumed_ckpt.save_frontier_level = recording
+    resumed = Solver(get_game("tictactoe"), checkpointer=resumed_ckpt).solve()
+    # Levels 0-3 came from disk: only 4+ are newly discovered and saved.
+    assert saved_during_resume and min(saved_during_resume) == 4
+    assert (resumed.value, resumed.remoteness) == (full.value, full.remoteness)
+    assert resumed.num_positions == full.num_positions
+    for level, table in full.levels.items():
+        rt = resumed.levels[level]
+        assert (rt.states == table.states).all()
+        assert (rt.values == table.values).all()
+        assert (rt.remoteness == table.remoteness).all()
+    # A third run resumes the COMPLETED forward without any discovery.
+    assert LevelCheckpointer(d).load_frontiers() is not None
+
+
+def test_sharded_forward_checkpoint_resume_mid_forward(tmp_path):
+    """Sharded analog of the mid-discovery resume: per-(level, shard)
+    frontier files keep the prefix; completion consolidates into the
+    per-shard snapshot and drops the now-redundant incremental files."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 fake devices")
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+
+    d = str(tmp_path / "fwd_resume_sharded")
+    full = ShardedSolver(get_game("tictactoe"), num_shards=4).solve()
+
+    class _Die(Exception):
+        pass
+
+    ckpt = LevelCheckpointer(d)
+    orig = LevelCheckpointer.save_forward_level_shard
+
+    def dying(level, shard, states):
+        orig(ckpt, level, shard, states)
+        # Level 3's files all land but the level is never SEALED — the
+        # resume must treat it as absent and re-expand from level 2.
+        if level >= 3 and shard == 3:
+            raise _Die()
+
+    ckpt.save_forward_level_shard = dying
+    with pytest.raises(_Die):
+        ShardedSolver(get_game("tictactoe"), num_shards=4,
+                      checkpointer=ckpt).solve()
+    sealed = LevelCheckpointer(d).load_manifest()["forward_level_shards"]
+    assert sorted(int(k) for k in sealed) == [0, 1, 2]
+
+    resumed_ckpt = LevelCheckpointer(d)
+    saved_during_resume = []
+
+    def recording(level, shard, states):
+        saved_during_resume.append(level)
+        orig(resumed_ckpt, level, shard, states)
+
+    resumed_ckpt.save_forward_level_shard = recording
+    resumed = ShardedSolver(get_game("tictactoe"), num_shards=4,
+                            checkpointer=resumed_ckpt).solve()
+    assert saved_during_resume and min(saved_during_resume) == 3
+    assert (resumed.value, resumed.remoteness) == (full.value, full.remoteness)
+    assert resumed.num_positions == full.num_positions
+    # Completion consolidated the snapshot and dropped the incrementals.
+    manifest = LevelCheckpointer(d).load_manifest()
+    assert manifest.get("frontier_shards") == 4
+    assert "forward_level_shards" not in manifest
+    import os as _os
+
+    assert not [f for f in _os.listdir(d) if f.startswith("frontier_")]
+
+
 def test_checkpoint_resume_sharded(tmp_path):
     import jax
 

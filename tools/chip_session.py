@@ -84,6 +84,12 @@ class Session:
         # itself asks otherwise.
         full_env.pop("GAMESMAN_PLATFORM", None)
         full_env.pop("GAMESMAN_FAKE_DEVICES", None)
+        # tools/ scripts get sys.path[0]=tools/, not the repo root; make
+        # the package importable regardless of the child's own hygiene.
+        full_env["PYTHONPATH"] = REPO + (
+            os.pathsep + full_env["PYTHONPATH"]
+            if full_env.get("PYTHONPATH") else ""
+        )
         full_env.update(env or {})
         t0 = time.time()
         try:
@@ -130,6 +136,10 @@ def main() -> int:
                     help="run only what the r04 mid-plan relay death left: "
                          "pallas chip check, pallas-gather 5x5 A/B, hybrid "
                          "k16/k20, the board ladder, the full bench")
+    ap.add_argument("--phase3", action="store_true",
+                    help="run only what the r04 SECOND relay death left: "
+                         "the fixed pallas kernel's chip check + 5x5 A/B, "
+                         "the 6x5 board, the full bench")
     args = ap.parse_args()
     s = Session(args.out)
     py = sys.executable
@@ -143,6 +153,21 @@ def main() -> int:
     bench = [py, os.path.join(REPO, "bench.py")]
     b55 = {"BENCH_SYM": "0", "BENCH_LADDER": "0",
            "BENCH_GAME": "connect4:w=5,h=5", "BENCH_REPEATS": "2"}
+
+    if args.phase3:
+        # Second relay death landed mid-6x5; the pallas kernel was ALSO
+        # rewritten after this window's Mosaic rejection (2-D BlockSpecs,
+        # no in-kernel reshape) — re-prove it before the remaining ladder.
+        s.step("pallas_chip_check",
+               [py, os.path.join(REPO, "tools", "pallas_chip_check.py")],
+               timeout=1200, parse_json=False)
+        s.step("dense_gather_pallas", bench,
+               env={**b55, "GAMESMAN_DENSE_GATHER": "pallas"})
+        s.step("dense_6x5", bench,
+               env={**b55, "BENCH_GAME": "connect4:w=6,h=5"}, timeout=5400)
+        s.step("bench_full", bench, env={}, timeout=3600)
+        s.record(step="done", status="aborted" if s.aborted else "complete")
+        return 1 if s.aborted else 0
 
     if args.phase2:
         # Only what the r04 mid-plan relay death left unmeasured; falls
