@@ -101,6 +101,40 @@ def test_checkpoint_resume_skips_recompute(tmp_path):
     assert resumed.num_positions == first.num_positions
 
 
+def test_manifest_writes_are_atomic(tmp_path):
+    """A peer process may read the manifest while process 0 seals levels;
+    every read must parse (old or new content, never torn). The
+    truncate-in-place write this replaces crashed a two-process run with
+    JSONDecodeError mid-seal (round 4). Threads stand in for processes —
+    same file, same syscalls."""
+    import threading
+
+    ckpt = LevelCheckpointer(str(tmp_path / "atomic"))
+    errors = []
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            try:
+                ckpt.load_manifest()
+            except Exception as e:  # torn read
+                errors.append(e)
+                return
+
+    t = threading.Thread(target=reader)
+    t.start()
+    try:
+        for i in range(300):
+            ckpt.finish_forward_level(i, 4)
+    finally:
+        stop.set()
+        t.join()
+    assert not errors
+    assert len(ckpt.load_manifest()["forward_level_shards"]) == 300
+    # No temp files left behind.
+    assert not list((tmp_path / "atomic").glob("*.tmp"))
+
+
 def test_forward_checkpoint_resume_mid_forward(tmp_path):
     """A run killed mid-DISCOVERY resumes from the deepest saved frontier.
 
