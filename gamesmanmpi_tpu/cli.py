@@ -419,13 +419,14 @@ def _main(args) -> int:
         and not args.checkpoint_dir and not args.paranoid
         and not args.table_out
     )
-    dense_eligible = family_ok and args.devices == 1
-    # The hybrid's dense region is single-device, but its BFS region runs
-    # on the sharded engine when --devices > 1.
+    # devices > 1 partitions the dense level kernels over the mesh by rank
+    # (DenseSolver devices=N); the hybrid's dense region stays
+    # single-device while its BFS region shards.
+    dense_eligible = family_ok
     if args.engine == "dense" and not dense_eligible:
         print(
             "error: --engine dense needs a Connect-4-family game "
-            "with sym=0, --devices 1, and no --checkpoint-dir/--paranoid/"
+            "with sym=0 and no --checkpoint-dir/--paranoid/"
             "--table-out (those live in the classic engine)",
             file=sys.stderr,
         )
@@ -446,6 +447,12 @@ def _main(args) -> int:
 
         if jax.devices()[0].platform == "cpu":
             dense_eligible = False
+        if args.devices > 1:
+            # auto + a mesh keeps the OLD routing (owner-sharded BFS,
+            # which shards MEMORY): the mesh dense engine re-replicates
+            # each level, so it only fits boards whose peak level fits one
+            # device — a policy the user opts into with --engine dense.
+            dense_eligible = False
     if args.engine == "hybrid":
         from gamesmanmpi_tpu.solve.hybrid import HybridSolver
 
@@ -465,11 +472,16 @@ def _main(args) -> int:
     elif args.engine != "classic" and dense_eligible:
         from gamesmanmpi_tpu.solve.dense import DenseSolver
 
-        solver = DenseSolver(
-            game,
-            store_tables=not args.no_tables,
-            logger=logger,
-        )
+        try:
+            solver = DenseSolver(
+                game,
+                store_tables=not args.no_tables,
+                logger=logger,
+                devices=args.devices,
+            )
+        except ValueError as e:  # bad --devices: CLI misuse exits 2
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     elif args.devices > 1:
         from gamesmanmpi_tpu.parallel import ShardedSolver
 

@@ -985,7 +985,7 @@ def tables_for(width: int, height: int, connect: int = 4) -> DenseTables:
 
 
 class DenseSolver:
-    """Single-chip dense solver for Connect4 games (sym=False).
+    """Dense solver for Connect4 games (sym=False); single-chip or meshed.
 
     Usage mirrors solve.Solver: DenseSolver(game).solve() -> result with
     .value/.remoteness/.num_positions/.stats/.lookup.
@@ -993,11 +993,25 @@ class DenseSolver:
     count_positions: "auto" runs the reachability sweep once per board per
     process (exact reachable count, validated against the BFS engine);
     False skips it and reports positions=0 unless already cached.
+
+    devices > 1 partitions every level kernel over a 1-D mesh by RANK
+    (the [P, cblock] work arrays' lane axis): the unrank walks, win folds
+    and child ranking — the VPU work that is ~all of the dense cost — are
+    embarrassingly parallel per position, so each device computes only
+    its rank slice (XLA SPMD partitions from the out_sharding constraint;
+    the global `iota` makes each shard's ranks correct with no kernel
+    changes). The one communication is re-replicating each level's cells
+    for the NEXT level's child gathers — an all_gather of the level
+    (table bytes total over the whole solve, riding ICI), which is the
+    simple regime this engine targets (boards whose peak level fits one
+    device's HBM, <= 6x5; the 6x6+ halo-exchange design is recorded in
+    docs/ARCHITECTURE.md). Single-controller only: the mesh spans local
+    devices.
     """
 
     def __init__(self, game: Connect4, store_tables: bool = True,
                  block_elems: Optional[int] = None, logger=None,
-                 count_positions="auto"):
+                 count_positions="auto", devices: int = 1):
         if not isinstance(game, Connect4):
             raise TypeError("DenseSolver requires a Connect4-family game")
         if game.sym:
@@ -1010,6 +1024,15 @@ class DenseSolver:
         self.store_tables = store_tables
         self.logger = logger
         self.count_positions = count_positions
+        self.devices = int(devices)
+        if self.devices < 1:
+            raise ValueError(f"devices must be >= 1, got {devices}")
+        if self.devices > 1:
+            from gamesmanmpi_tpu.parallel.mesh import make_mesh
+
+            self._mesh = make_mesh(self.devices)
+        else:
+            self._mesh = None
         self.tables = tables_for(game.width, game.height, game.connect)
         self.block_elems = block_elems or int(
             os.environ.get("GAMESMAN_DENSE_BLOCK", str(64 * 1024 * 1024))
@@ -1085,6 +1108,42 @@ class DenseSolver:
         g = self.game
         return (g.width, g.height, g.connect)
 
+    def _replicate(self, arr):
+        """Re-replicate a level's flat cells for the next level's gathers
+        (devices > 1): THE one communication of the sharded dense design —
+        an all_gather of the level, riding ICI. The outputs come back
+        committed with the rank-partitioned sharding; the next kernel's
+        in_shardings would otherwise reject them (committed arrays are
+        never silently resharded)."""
+        if self._mesh is None:
+            return arr
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(
+            arr, NamedSharding(self._mesh, PartitionSpec())
+        )
+
+    def _jit_kwargs(self, kind: str):
+        """Mesh partitioning for a level kernel (devices > 1), else {}.
+
+        Inputs replicate (the child/parent flat table is what every shard
+        gathers from; consts are KBs); the [P, cblock] output shards over
+        its RANK axis, and XLA's SPMD partitioner propagates that
+        constraint back through the elementwise/fori unrank chain so each
+        device computes only its lane slice. The reach step's scalar count
+        replicates (XLA inserts the cross-shard sum).
+        """
+        if self._mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from gamesmanmpi_tpu.parallel.mesh import AXIS
+
+        rep = NamedSharding(self._mesh, PartitionSpec())
+        cells = NamedSharding(self._mesh, PartitionSpec(None, AXIS))
+        out = (cells, rep) if kind == "dense_reach" else cells
+        return dict(in_shardings=rep, out_shardings=out)
+
     def _kernel(self, kind: str, level: int, cblock: int, builder):
         t, rd, fd, oh, fr, gm = (self.tables, self._rank_dtype,
                                  self._flat_dtype, self.use_onehot,
@@ -1093,6 +1152,7 @@ class DenseSolver:
             self.game, kind, self._kernel_key(kind, level, cblock),
             lambda g: builder(t, level, cblock, rd, fd, oh, fused_rank=fr,
                               gather_mode=gm),
+            jit_kwargs=self._jit_kwargs(kind),
         )
 
     def _rank0(self, b: int, cblock: int):
@@ -1113,6 +1173,24 @@ class DenseSolver:
             # which keys every kernel cache entry — the other modes would
             # recompile their whole program set for nothing.
             cblock -= cblock % PALLAS_BLOCK
+        if self._mesh is not None:
+            # A sharded [P, cblock] output must split its rank axis evenly
+            # across the mesh; round UP (pad ranks) — out-of-range lanes
+            # already exist in every last block and both kernels handle
+            # them (in_range masks / clipped gathers), and callers slice
+            # back to C.
+            cblock = -(-cblock // self.devices) * self.devices
+            if (self.gather_mode == "pallas" and cblock >= PALLAS_BLOCK
+                    and cblock % PALLAS_BLOCK):
+                # The round-up broke the pallas invariant (every cblock
+                # >= PALLAS_BLOCK is a PALLAS_BLOCK multiple, so kernel
+                # blocks never straddle profile rows — including when the
+                # round-up itself crossed the threshold); re-round to a
+                # size satisfying both.
+                import math
+
+                q = math.lcm(self.devices, PALLAS_BLOCK)
+                cblock = -(-cblock // q) * q
         return cblock, -(-C // cblock)
 
     def _avals(self, level: int, cblock: int, for_reach: bool):
@@ -1160,7 +1238,7 @@ class DenseSolver:
         gm = self.gather_mode if kind == "dense_step" else "plain"
         return (
             kind, level, cblock, self.use_onehot, fused, gm,
-            str(self._rank_dtype), str(self._flat_dtype),
+            str(self._rank_dtype), str(self._flat_dtype), self.devices,
         )
 
     def schedule_compiles(self, reach_first: bool = False,
@@ -1192,6 +1270,7 @@ class DenseSolver:
                                   fused_rank=fr, gather_mode=gm),
                 self._avals(level, cblock, for_reach),
                 heavy=P * cblock * 8 > (512 << 20),
+                jit_kwargs=self._jit_kwargs(kind),
             )
 
         phases = [
@@ -1305,7 +1384,7 @@ class DenseSolver:
             C = t.class_size[L]
             if nblk * cblock != C:
                 level_reach = level_reach[:, :C]
-            reach_flat = level_reach.reshape(-1)
+            reach_flat = self._replicate(level_reach.reshape(-1))
             self._maybe_drain(len(t.profiles[L]) * C, reach_flat)
             counts_dev[L] = cnt
         counts = {0: 1}
@@ -1370,7 +1449,7 @@ class DenseSolver:
             C = t.class_size[L]
             encodable_total += P * C
             level_cells = self._backward_level(L, child_flat)
-            child_flat = level_cells.reshape(-1)
+            child_flat = self._replicate(level_cells.reshape(-1))
             drained = self._maybe_drain(P * C, child_flat)
             if self.logger is not None:
                 rec = {

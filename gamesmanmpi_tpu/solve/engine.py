@@ -193,10 +193,13 @@ def _sweep_stale_kernels() -> None:
 
 
 def get_kernel(game: TensorGame, kind: str, shape_key, builder,
-               lowering=()):
+               lowering=(), jit_kwargs=None):
     # Games whose identity is per-instance (TensorizedModule: host callbacks
     # can't be compared) carry their own cache dict, so their kernels are
     # garbage-collected with the game instead of pinning it process-wide.
+    # jit_kwargs (in_shardings/out_shardings for mesh-partitioned kernels)
+    # must be reflected in the caller's shape_key — the cache can't see
+    # inside them.
     _sweep_stale_kernels()
     cache = getattr(game, "_private_kernel_cache", _KERNELS)
     key = _cache_key(game, kind, shape_key, lowering)
@@ -210,12 +213,12 @@ def get_kernel(game: TensorGame, kind: str, shape_key, builder,
             if compiled is not None:
                 cache[key] = compiled
                 return compiled
-        fn = cache[key] = jax.jit(builder(game))
+        fn = cache[key] = jax.jit(builder(game), **(jit_kwargs or {}))
     return fn
 
 
 def schedule_kernel(game: TensorGame, kind: str, shape_key, builder, avals,
-                    heavy: bool = False, lowering=()):
+                    heavy: bool = False, lowering=(), jit_kwargs=None):
     """Queue a background compile of a kernel (idempotent, never blocks).
 
     avals must match the call signature get_kernel's users will invoke the
@@ -238,7 +241,8 @@ def schedule_kernel(game: TensorGame, kind: str, shape_key, builder, avals,
     pre = global_precompiler()
     if pre.scheduled(key):
         return
-    pre.schedule(key, jax.jit(builder(game)), tuple(avals), heavy=heavy)
+    pre.schedule(key, jax.jit(builder(game), **(jit_kwargs or {})),
+                 tuple(avals), heavy=heavy)
 
 
 def canonical_scalar(game: TensorGame, state):

@@ -63,6 +63,37 @@ def test_dense_full_parity_3x3c3():
     assert checked == rc.num_positions
 
 
+def test_dense_sharded_parity_3x3c3():
+    """devices=4 partitions every level kernel's rank axis over the mesh;
+    cells must be BIT-identical to the single-device engine (the same
+    programs, just partitioned — any drift means the sharding changed
+    semantics, not layout)."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 fake devices")
+    g = get_game("connect4:w=3,h=3,connect=3")
+    r1 = DenseSolver(g).solve()
+    r4 = DenseSolver(g, devices=4).solve()
+    assert (r4.value, r4.remoteness, r4.num_positions) == (
+        r1.value, r1.remoteness, r1.num_positions
+    )
+    for L in r1.cells:
+        assert np.array_equal(
+            np.asarray(r1.cells[L]), np.asarray(r4.cells[L])
+        ), L
+    # Uneven split: a mesh width that does NOT divide the class sizes
+    # exercises the rank-axis round-up padding.
+    r3 = DenseSolver(g, devices=3).solve()
+    assert (r3.value, r3.remoteness, r3.num_positions) == (
+        r1.value, r1.remoteness, r1.num_positions
+    )
+    for L in r1.cells:
+        assert np.array_equal(
+            np.asarray(r1.cells[L]), np.asarray(r3.cells[L])
+        ), L
+
+
 @pytest.mark.slow
 def test_dense_parity_4x4():
     g = get_game("connect4:w=4,h=4")
