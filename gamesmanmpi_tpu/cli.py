@@ -414,20 +414,23 @@ def _main(args) -> int:
             return 2
     from gamesmanmpi_tpu.games.connect4 import Connect4
 
-    family_ok = (
+    family_base = (
         isinstance(game, Connect4) and not game.sym
-        and not args.checkpoint_dir and not args.paranoid
-        and not args.table_out
+        and not args.paranoid and not args.table_out
     )
+    family_ok = family_base and not args.checkpoint_dir
     # devices > 1 partitions the dense level kernels over the mesh by rank
     # (DenseSolver devices=N); the hybrid's dense region stays
-    # single-device while its BFS region shards.
-    dense_eligible = family_ok
+    # single-device while its BFS region shards. An EXPLICIT --engine
+    # dense also accepts --checkpoint-dir (per-level cell restart); auto
+    # keeps routing checkpointed runs to the classic engine, whose
+    # checkpoints don't pay a per-level device download.
+    dense_eligible = family_base if args.engine == "dense" else family_ok
     if args.engine == "dense" and not dense_eligible:
         print(
             "error: --engine dense needs a Connect-4-family game "
-            "with sym=0 and no --checkpoint-dir/--paranoid/"
-            "--table-out (those live in the classic engine)",
+            "with sym=0 and no --paranoid/--table-out "
+            "(those live in the classic engine)",
             file=sys.stderr,
         )
         return 2
@@ -478,6 +481,7 @@ def _main(args) -> int:
                 store_tables=not args.no_tables,
                 logger=logger,
                 devices=args.devices,
+                checkpointer=checkpointer,
             )
         except ValueError as e:  # bad --devices: CLI misuse exits 2
             print(f"error: {e}", file=sys.stderr)

@@ -1011,7 +1011,8 @@ class DenseSolver:
 
     def __init__(self, game: Connect4, store_tables: bool = True,
                  block_elems: Optional[int] = None, logger=None,
-                 count_positions="auto", devices: int = 1):
+                 count_positions="auto", devices: int = 1,
+                 checkpointer=None):
         if not isinstance(game, Connect4):
             raise TypeError("DenseSolver requires a Connect4-family game")
         if game.sym:
@@ -1024,6 +1025,12 @@ class DenseSolver:
         self.store_tables = store_tables
         self.logger = logger
         self.count_positions = count_positions
+        #: Restart-from-level for the backward sweep: each level's flat
+        #: cells go to disk as computed (one forced download per level —
+        #: through a slow host link this roughly doubles wall time, which
+        #: is why it is opt-in), and a resumed solve skips the deepest
+        #: CONTIGUOUS completed prefix, rechaining from its last level.
+        self.checkpointer = checkpointer
         self.devices = int(devices)
         if self.devices < 1:
             raise ValueError(f"devices must be >= 1, got {devices}")
@@ -1434,20 +1441,61 @@ class DenseSolver:
     def solve(self) -> DenseSolveResult:
         g, t = self.game, self.tables
         nc = t.ncells
-        self.schedule_compiles()
         t0 = time.perf_counter()
         encodable_total = 0
         saved: Optional[Dict[int, np.ndarray]] = (
             {} if self.store_tables else None
         )
         child_flat = jnp.zeros((1,), jnp.uint8)  # dummy for the top level
+        start_L = nc
+        if self.checkpointer is not None:
+            # ":dense" namespaces the binding: these files are flat cell
+            # arrays, not the classic engine's LevelTables — a directory
+            # must never serve both.
+            self.checkpointer.bind_game(g.name + ":dense")
+            completed = set(self.checkpointer.dense_levels())
+            K = nc + 1
+            while K - 1 in completed:
+                K -= 1
+            if K <= nc:
+                # Levels K..nc are on disk; rechain from K's cells.
+                for L in range(K, nc + 1):
+                    P = len(t.profiles[L])
+                    C = t.class_size[L]
+                    encodable_total += P * C
+                    cells = self.checkpointer.load_dense_level(L)
+                    if cells.shape[0] != P * C:
+                        raise ValueError(
+                            f"checkpointed dense level {L} has "
+                            f"{cells.shape[0]} cells, expected {P * C} — "
+                            "stale checkpoint directory?"
+                        )
+                    if saved is not None:
+                        saved[L] = cells.reshape(P, C)
+                    if L == K:
+                        child_flat = self._replicate(jnp.asarray(cells))
+                if self.logger is not None:
+                    self.logger.log({
+                        "phase": "dense_backward_resume",
+                        "levels_resumed": nc - K + 1, "from_level": K,
+                    })
+                start_L = K - 1
+        levels_resumed = nc - start_L
+        # After binding/resume: a refused directory or a fully-resumed run
+        # must not have queued (then abandoned) a whole board's background
+        # compiles; a partial resume bounds the dense_step set to what it
+        # will actually run.
+        if start_L >= 0:
+            self.schedule_compiles(last_level=start_L)
+        computed_encodable = 0
         self._undrained = 0
         last_drain = t0  # drains are the only real sync points, so they
         # are the only honest per-segment timestamps (dispatch is async)
-        for L in range(nc, -1, -1):
+        for L in range(start_L, -1, -1):
             P = len(t.profiles[L])
             C = t.class_size[L]
             encodable_total += P * C
+            computed_encodable += P * C
             level_cells = self._backward_level(L, child_flat)
             child_flat = self._replicate(level_cells.reshape(-1))
             drained = self._maybe_drain(P * C, child_flat)
@@ -1463,6 +1511,10 @@ class DenseSolver:
                 self.logger.log(rec)
             if saved is not None:
                 saved[L] = np.asarray(level_cells).reshape(P, C)
+            if self.checkpointer is not None:
+                self.checkpointer.save_dense_level(
+                    L, np.asarray(level_cells)
+                )
 
         root_cell = int(jnp.reshape(child_flat, (-1,))[0])
         value, remoteness = root_cell & 3, root_cell >> 2
@@ -1489,9 +1541,18 @@ class DenseSolver:
             # a per-board constant, computed once per process, not part of
             # the solve (docs/ARCHITECTURE.md "Dense engine (Connect-4
             # family)").
-            "positions_per_sec": positions / max(solve_secs, 1e-9),
+            # A resumed run's elapsed time covers only the levels it
+            # actually computed — attributing the whole board's positions
+            # to it would overstate measured throughput (this repo
+            # publishes these numbers); report 0 and the resumed count.
+            "positions_per_sec": (
+                positions / max(solve_secs, 1e-9)
+                if levels_resumed == 0 else 0.0
+            ),
+            "levels_resumed": levels_resumed,
             "bytes_sorted": 0,
-            "bytes_gathered": encodable_total * g.max_moves,  # u8 cells
+            # Operand bytes of the gathers this RUN issued (u8 cells).
+            "bytes_gathered": computed_encodable * g.max_moves,
         }
         if counted:
             stats["reachable_per_level"] = counted

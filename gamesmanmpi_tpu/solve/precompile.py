@@ -254,10 +254,37 @@ class Precompiler:
 _GLOBAL: Precompiler | None = None
 
 
+def _atexit_drain() -> None:
+    """Let in-flight compiles retire before interpreter teardown.
+
+    Daemon threads die with the process — but one killed INSIDE an XLA
+    compile aborts teardown (C++ "terminate called ... FATAL: exception
+    not rethrown", observed when a solve scheduled kernels moments
+    before process exit). Closing cancels everything still queued; the
+    bounded join then waits out only compiles already on a worker. A
+    wedged relay compile must not hang exit forever — hence the cap
+    (GAMESMAN_COMPILE_EXIT_GRACE seconds, default 120).
+    """
+    pre = _GLOBAL
+    if pre is None:
+        return
+    pre.close()
+    try:
+        grace = float(os.environ.get("GAMESMAN_COMPILE_EXIT_GRACE", "120"))
+    except ValueError:
+        grace = 120.0
+    deadline = time.time() + grace
+    for t in pre._threads:
+        t.join(timeout=max(0.0, deadline - time.time()))
+
+
 def global_precompiler() -> Precompiler:
     global _GLOBAL
     if _GLOBAL is None:
         _GLOBAL = Precompiler()
+        import atexit
+
+        atexit.register(_atexit_drain)
     return _GLOBAL
 
 

@@ -141,6 +141,28 @@ class LevelCheckpointer:
         levels |= {int(k) for k in manifest.get("sharded_levels", {})}
         return sorted(levels)
 
+    # ---------------------------------------------------- dense (per-level)
+    # The dense engine's unit of persistence is one level's flat u8 cell
+    # array (its entire state — no frontiers exist). The backward sweep
+    # chains deepest-first, so only a CONTIGUOUS completed prefix from the
+    # top is resumable; the engine computes that prefix itself.
+
+    def save_dense_level(self, level: int, cells) -> None:
+        _savez(self.dir / f"dense_{level:04d}.npz",
+               cells=np.asarray(cells).reshape(-1))
+        manifest = self.load_manifest()
+        manifest["dense_levels"] = sorted(
+            set(manifest.get("dense_levels", [])) | {level}
+        )
+        self._write_manifest(manifest)
+
+    def dense_levels(self) -> list:
+        return sorted(self.load_manifest().get("dense_levels", []))
+
+    def load_dense_level(self, level: int) -> np.ndarray:
+        with np.load(self.dir / f"dense_{level:04d}.npz") as z:
+            return z["cells"]
+
     # ------------------------------------------------- sharded (per-shard)
     # One file per (level, shard) and per (frontier snapshot, shard): no
     # global array is ever assembled on one host to WRITE a checkpoint —

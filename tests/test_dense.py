@@ -63,6 +63,56 @@ def test_dense_full_parity_3x3c3():
     assert checked == rc.num_positions
 
 
+def test_dense_checkpoint_resume(tmp_path):
+    """Restart-from-level for the backward sweep: a run that died after
+    saving levels K..nc must rechain from K's cells without recomputing
+    them, and a fully-checkpointed rerun must compute nothing."""
+    from gamesmanmpi_tpu.utils import LevelCheckpointer
+
+    g = get_game("connect4:w=3,h=3,connect=3")
+    full = DenseSolver(g).solve()
+    nc = full._tables.ncells
+
+    # Simulate an interrupted run: persist only the top 4 levels.
+    d = str(tmp_path / "dense_ck")
+    ck = LevelCheckpointer(d)
+    ck.bind_game(g.name + ":dense")
+    for L in range(nc - 3, nc + 1):
+        ck.save_dense_level(L, full.cells[L])
+
+    resumed_solver = DenseSolver(g, checkpointer=LevelCheckpointer(d))
+    orig = resumed_solver._backward_level
+
+    def guarded(L, child_flat):
+        assert L <= nc - 4, f"resume recomputed checkpointed level {L}"
+        return orig(L, child_flat)
+
+    resumed_solver._backward_level = guarded
+    resumed = resumed_solver.solve()
+    assert (resumed.value, resumed.remoteness, resumed.num_positions) == (
+        full.value, full.remoteness, full.num_positions
+    )
+    for L in full.cells:
+        assert np.array_equal(
+            np.asarray(full.cells[L]), np.asarray(resumed.cells[L])
+        ), L
+
+    # Everything is now on disk: a second resume computes NOTHING.
+    final_solver = DenseSolver(g, checkpointer=LevelCheckpointer(d))
+
+    def poisoned(L, child_flat):
+        raise AssertionError(f"fully-resumed solve recomputed level {L}")
+
+    final_solver._backward_level = poisoned
+    final = final_solver.solve()
+    assert (final.value, final.remoteness) == (full.value, full.remoteness)
+
+    # A different game must be refused loudly.
+    with pytest.raises(ValueError, match="belongs to game"):
+        DenseSolver(get_game("connect4:w=4,h=4"),
+                    checkpointer=LevelCheckpointer(d)).solve()
+
+
 def test_dense_sharded_parity_3x3c3():
     """devices=4 partitions every level kernel's rank axis over the mesh;
     cells must be BIT-identical to the single-device engine (the same
