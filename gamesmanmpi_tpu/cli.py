@@ -163,16 +163,40 @@ def build_parser() -> argparse.ArgumentParser:
 
 def _lookup_checkpoint(game, checkpointer, state):
     """(value, remoteness) of one position from a checkpoint directory, or
-    None. Canonicalizes and levels the query exactly like the engine, then
-    reads one (level, shard) npz (LevelCheckpointer.lookup_level_state).
+    None. Dense directories (manifest "dense_levels") locate the cell by
+    perfect index in one dense_NNNN.npz; classic directories canonicalize
+    and level the query exactly like the engine, then read one
+    (level, shard) npz (LevelCheckpointer.lookup_level_state).
 
     Never raises: the solve already succeeded, so a missing shard file (a
     multi-host run's remote shard, a torn write) degrades this one query
     to unanswerable — it must not abort the report or the remaining
     queries."""
-    from gamesmanmpi_tpu.solve.engine import canonical_scalar
-
     try:
+        dense_levels = checkpointer.load_manifest().get("dense_levels")
+        if dense_levels:
+            from gamesmanmpi_tpu.solve.dense import tables_for
+
+            t = tables_for(game.width, game.height, game.connect)
+            level, row, rank = t.locate(int(state))
+            if (level not in dense_levels
+                    or t.current_player_has_line(level, row, rank)):
+                # Never solved (interrupted run) / fabricated class (the
+                # player to move already has a line: its cell is a
+                # placeholder, same refusal as DenseSolveResult.lookup).
+                return None
+            cache = getattr(checkpointer, "_dense_query_cache", None)
+            if cache is not None and cache[0] == level:
+                cells = cache[1]
+            else:
+                # Memoize the last-loaded level: batched queries cluster,
+                # and at big-run scale one level file is a large read.
+                cells = checkpointer.load_dense_level(level)
+                checkpointer._dense_query_cache = (level, cells)
+            cell = int(cells[row * t.class_size[level] + rank])
+            return cell & 3, cell >> 2
+        from gamesmanmpi_tpu.solve.engine import canonical_scalar
+
         canon, level = canonical_scalar(game, state)
         return checkpointer.lookup_level_state(level, int(canon))
     except Exception as e:  # noqa: BLE001 - per-query degradation

@@ -1458,11 +1458,19 @@ class DenseSolver:
             while K - 1 in completed:
                 K -= 1
             if K <= nc:
-                # Levels K..nc are on disk; rechain from K's cells.
+                # Levels K..nc are on disk; rechain from K's cells. Only
+                # K's file must actually be READ (plus all of them when
+                # tables are materialized) — in --no-tables mode a resume
+                # near level 0 of a big board would otherwise re-read the
+                # whole multi-GB checkpoint just for shape checks. The
+                # save-then-manifest ordering guarantees a LISTED level's
+                # file is complete.
                 for L in range(K, nc + 1):
                     P = len(t.profiles[L])
                     C = t.class_size[L]
                     encodable_total += P * C
+                    if saved is None and L != K:
+                        continue
                     cells = self.checkpointer.load_dense_level(L)
                     if cells.shape[0] != P * C:
                         raise ValueError(

@@ -60,12 +60,16 @@ class LevelCheckpointer:
 
     def _write_manifest(self, manifest: dict) -> None:
         """Atomic replace, never truncate-in-place: under multi-host, only
-        process 0 writes the manifest, but PEERS read it concurrently
-        (completed_levels at backward start races the post-barrier seals)
-        — a torn read crashed a two-process run with JSONDecodeError
-        (round 4). os.replace guarantees readers see old-or-new, never
-        partial."""
-        tmp = self.manifest_path.with_suffix(".json.tmp")
+        process 0 writes the manifest AFTER bind — but PEERS read it
+        concurrently (completed_levels at backward start races the
+        post-barrier seals), and bind_game itself writes from EVERY
+        process at solve start. A torn read crashed a two-process run
+        with JSONDecodeError (round 4); os.replace guarantees readers
+        see old-or-new, never partial. The temp name is per-writer
+        (pid): concurrent binders sharing one .tmp consumed each other's
+        rename (FileNotFoundError — same lesson as the counts cache's
+        private-per-writer tmp)."""
+        tmp = self.manifest_path.with_suffix(f".json.{os.getpid()}.tmp")
         tmp.write_text(json.dumps(manifest))
         os.replace(tmp, self.manifest_path)
 
