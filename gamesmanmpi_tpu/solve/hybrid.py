@@ -360,13 +360,16 @@ class HybridSolver:
     cutover: last dense level K (0 <= K < ncells). None reads
     GAMESMAN_HYBRID_CUTOVER, else default_cutover(ncells).
 
-    devices: 1 = single-device BFS side (solve.Solver); >1 = the
-    owner-routed ShardedSolver over a devices-wide mesh — the sweep,
-    extraction and the dense region stay single-device (dense arrays are
-    closed-form and 1 byte/position; at cutovers where they would not
-    fit one chip the cutover is wrong, see the ARCHITECTURE table), while
-    the BFS region — where the reachable set and the sort work live —
-    scales across the mesh.
+    devices: 1 = fully single-device; >1 = BOTH regions use the mesh —
+    the BFS region (where the reachable set and the sort work live) runs
+    the owner-routed ShardedSolver, and the dense region's sweep and
+    backward rank-partition their level kernels over the same mesh
+    (DenseSolver devices=N; docs/ARCHITECTURE.md "Mesh-partitioned
+    dense"). Only the boundary join and frontier extraction stay
+    single-device: they are one level's worth of work at the cutover,
+    which the HBM-pair bound already forces to be small (a cutover whose
+    boundary does not fit one chip is the wrong cutover — see the
+    ARCHITECTURE capacity table).
     """
 
     def __init__(self, game: Connect4, cutover: Optional[int] = None,
@@ -394,9 +397,14 @@ class HybridSolver:
                                            2048)
         self.wblock = _env_int_strict("GAMESMAN_HYBRID_WBLOCK", 1 << 22)
         # The dense half (kernels, consts, tables); its reach sweep is run
-        # partially by this class, so disable its own full sweep.
+        # partially by this class, so disable its own full sweep. devices
+        # passes through: the dense region's level kernels rank-partition
+        # over the same mesh the BFS region shards over (the capacity-plan
+        # composition for 6x6 — docs/ARCHITECTURE.md "Mesh-partitioned
+        # dense"); the boundary join stays single-device.
         self.dense = DenseSolver(game, store_tables=store_tables,
-                                 logger=logger, count_positions=False)
+                                 logger=logger, count_positions=False,
+                                 devices=self.devices)
         self.tables = self.dense.tables
         nc = self.tables.ncells
         if cutover is None:
@@ -646,13 +654,17 @@ class HybridSolver:
         saved = {} if self.store_tables else None
         if saved is not None:
             saved[K] = np.asarray(boundary_cells)
-        child_flat = boundary_cells.reshape(-1)
+        # _replicate: the boundary kernel's output (and each chained
+        # level's sharded cells) must be mesh-replicated before feeding
+        # the next rank-partitioned level kernel (same chaining rule as
+        # DenseSolver.solve; no-op at devices=1).
+        child_flat = d._replicate(boundary_cells.reshape(-1))
         d._undrained = 0
         for L in range(K - 1, -1, -1):
             P = len(t.profiles[L])
             C = t.class_size[L]
             cells = d._backward_level(L, child_flat)
-            child_flat = cells.reshape(-1)
+            child_flat = d._replicate(cells.reshape(-1))
             d._maybe_drain(P * C, child_flat)
             if saved is not None:
                 saved[L] = np.asarray(cells).reshape(P, C)
