@@ -1539,6 +1539,7 @@ class DenseSolver:
         stats = {
             "game": g.name,
             "engine": "dense",
+            "devices": self.devices,
             "positions": positions,
             "encodable_positions": encodable_total,
             "levels": nc + 1,

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Cross-validate the uint64 dense path end-to-end on a full board (CPU).
+
+The 6x5 chip target is the first uint64 board (w*(h+1) = 36 state bits)
+any engine will solve on silicon — but until this script, NO uint64
+board had been solved end-to-end anywhere: the u64 kernel path was
+pinned only by rank/unrank roundtrip tests (tests/test_dense.py). 4x7
+(32 bits — the uint64 cutoff) exercises that path at a CPU-tractable
+size; this solves it with BOTH engines and requires bit-exact agreement
+on the root, the per-level reachable counts, and a sampled cell set —
+the same parity axes the 6x5 run will be judged by, executed where a
+failure is debuggable.
+
+Run CPU-pinned (GAMESMAN_PLATFORM=cpu); takes ~1-2 h on one core.
+Prints one JSON line at the end for the artifacts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from gamesmanmpi_tpu.utils.platform import apply_platform_env
+
+apply_platform_env()
+
+import numpy as np
+
+from gamesmanmpi_tpu.games import get_game
+from gamesmanmpi_tpu.solve import Solver
+from gamesmanmpi_tpu.solve.dense import DenseSolver
+
+
+def main() -> int:
+    spec = sys.argv[1] if len(sys.argv) > 1 else "connect4:w=4,h=7"
+    g = get_game(spec)
+    assert np.dtype(g.state_dtype) == np.uint64, (
+        f"{spec} is not a uint64 board ({g.state_dtype})"
+    )
+
+    t0 = time.perf_counter()
+    rc = Solver(g).solve()
+    t_classic = time.perf_counter() - t0
+    print(f"classic: {rc.value}/{rc.remoteness} "
+          f"{rc.num_positions} positions in {t_classic:.1f}s", flush=True)
+
+    t0 = time.perf_counter()
+    rd = DenseSolver(g).solve()
+    t_dense = time.perf_counter() - t0
+    print(f"dense:   {rd.value}/{rd.remoteness} "
+          f"{rd.num_positions} positions in {t_dense:.1f}s", flush=True)
+
+    ok = (rd.value, rd.remoteness) == (rc.value, rc.remoteness)
+    ok &= rd.num_positions == rc.num_positions
+    per_level_ok = True
+    for L, n in rd.stats["reachable_per_level"].items():
+        tab = rc.levels.get(L)
+        classic_n = tab.states.shape[0] if tab is not None else 0
+        if n != classic_n:
+            # A level-set disagreement IS the divergence this tool
+            # exists to catch — report it, never crash on it.
+            per_level_ok = False
+            print(f"LEVEL COUNT MISMATCH at {L}: dense {n} vs "
+                  f"classic {classic_n}", flush=True)
+    ok &= per_level_ok
+
+    rng = np.random.default_rng(11)
+    sampled = mismatches = 0
+    for L, tab in rc.levels.items():
+        n = tab.states.shape[0]
+        if not n:
+            continue
+        for i in rng.choice(n, size=min(500, n), replace=False):
+            s = int(tab.states[i])
+            got = rd.lookup(s)
+            want = (int(tab.values[i]), int(tab.remoteness[i]))
+            sampled += 1
+            if got != want:
+                mismatches += 1
+                if mismatches <= 5:
+                    print(f"CELL MISMATCH {s:#x}: dense {got} vs "
+                          f"classic {want}", flush=True)
+    ok &= mismatches == 0
+
+    print(json.dumps({
+        "check": "u64_crosscheck", "board": spec,
+        "value": rd.value, "remoteness": rd.remoteness,
+        "positions": rd.num_positions,
+        "per_level_counts_match": per_level_ok,
+        "cells_sampled": sampled, "cell_mismatches": mismatches,
+        "secs_classic": round(t_classic, 1),
+        "secs_dense": round(t_dense, 1),
+        "ok": ok,
+    }), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
