@@ -140,6 +140,10 @@ def main() -> int:
                     help="run only what the r04 SECOND relay death left: "
                          "the fixed pallas kernel's chip check + 5x5 A/B, "
                          "the 6x5 board, the full bench")
+    ap.add_argument("--pallas-only", action="store_true",
+                    help="the ~15-minute tail of phase3 for short windows "
+                         "(late revival near a round boundary): just the "
+                         "fixed pallas kernel's chip check + its 5x5 A/B")
     args = ap.parse_args()
     s = Session(args.out)
     py = sys.executable
@@ -153,6 +157,16 @@ def main() -> int:
     bench = [py, os.path.join(REPO, "bench.py")]
     b55 = {"BENCH_SYM": "0", "BENCH_LADDER": "0",
            "BENCH_GAME": "connect4:w=5,h=5", "BENCH_REPEATS": "2"}
+
+    if args.pallas_only:
+        s.step("pallas_chip_check",
+               [py, os.path.join(REPO, "tools", "pallas_chip_check.py")],
+               timeout=900, parse_json=False)
+        s.step("dense_gather_pallas", bench,
+               env={**b55, "GAMESMAN_DENSE_GATHER": "pallas"},
+               timeout=900)
+        s.record(step="done", status="aborted" if s.aborted else "complete")
+        return 1 if s.aborted else 0
 
     if args.phase3:
         # Second relay death landed mid-6x5; the pallas kernel was ALSO

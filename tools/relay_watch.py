@@ -40,6 +40,7 @@ def relay_up() -> bool:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--phase3", action="store_true")
+    ap.add_argument("--pallas-only", action="store_true")
     ap.add_argument("--max-hours", type=float, default=10.0)
     ap.add_argument("--poll-secs", type=float, default=60.0)
     ap.add_argument("--settle-secs", type=float, default=45.0)
@@ -57,7 +58,9 @@ def main() -> int:
                         "--out",
                         os.path.join(REPO, "artifacts",
                                      "chip_session_r04.jsonl")]
-                if args.phase3:
+                if args.pallas_only:
+                    argv.append("--pallas-only")
+                elif args.phase3:
                     argv.append("--phase3")
                 print(f"[relay_watch] relay live; launching {argv}",
                       flush=True)
