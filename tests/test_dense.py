@@ -113,6 +113,32 @@ def test_dense_checkpoint_resume(tmp_path):
                     checkpointer=LevelCheckpointer(d)).solve()
 
 
+def test_pallas_mesh_falls_back_until_chip_proven(monkeypatch):
+    """devices>1 + gather_mode=pallas is exercised only in CPU interpret
+    mode; on a real accelerator the Mosaic custom call's behaviour under
+    auto-SPMD is chip-unproven (ADVICE r4), so the constructor must fall
+    back to the plain XLA gather — with an env escape hatch for the
+    chip-session step that will prove it."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 fake devices")
+    import gamesmanmpi_tpu.solve.dense as dense_mod
+
+    g = get_game("connect4:w=3,h=3,connect=3")
+    monkeypatch.setenv("GAMESMAN_DENSE_GATHER", "pallas")
+    monkeypatch.setattr(dense_mod.jax, "default_backend", lambda: "tpu")
+    with pytest.warns(UserWarning, match="not yet chip-proven"):
+        s = DenseSolver(g, devices=2)
+    assert s.gather_mode == "plain"
+    monkeypatch.setenv("GAMESMAN_DENSE_GATHER_PALLAS_MESH", "1")
+    s2 = DenseSolver(g, devices=2)
+    assert s2.gather_mode == "pallas"
+    # Single-device pallas is chip-provable independently; no fallback.
+    monkeypatch.delenv("GAMESMAN_DENSE_GATHER_PALLAS_MESH")
+    assert DenseSolver(g).gather_mode == "pallas"
+
+
 def test_dense_sharded_parity_3x3c3():
     """devices=4 partitions every level kernel's rank axis over the mesh;
     cells must be BIT-identical to the single-device engine (the same

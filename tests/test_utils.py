@@ -269,6 +269,62 @@ def test_checkpoint_resume_sharded(tmp_path):
     assert (result.value, result.remoteness) == (first.value, first.remoteness)
 
 
+def test_forward_level_shards_torn_dir_keeps_intact_prefix(tmp_path):
+    """A manifest that seals a level whose shard files are gone (torn
+    directory) must not abort the resumed solve with FileNotFoundError
+    (ADVICE r4); it degrades to the intact contiguous-from-root prefix —
+    at big-run scale that prefix is hours of re-discovery."""
+    ckpt = LevelCheckpointer(str(tmp_path / "torn"))
+    for level in range(3):
+        for s in range(2):
+            ckpt.save_forward_level_shard(
+                level, s, np.arange(4, dtype=np.uint32))
+        ckpt.finish_forward_level(level, 2)
+    assert set(ckpt.load_forward_level_shards(2)) == {0, 1, 2}
+    (ckpt.dir / "frontier_0001.shard_0000.npz").unlink()
+    # Torn at level 1: level 0 survives, 1+ (and anything above the tear)
+    # re-run. The result stays contiguous-from-root — _forward_fast's
+    # resume contract.
+    assert set(ckpt.load_forward_level_shards(2)) == {0}
+    (ckpt.dir / "frontier_0000.shard_0001.npz").unlink()
+    assert ckpt.load_forward_level_shards(2) == {}
+
+
+def test_drop_forward_level_shards_manifest_before_unlink(tmp_path):
+    """drop must pop the manifest entries and persist BEFORE unlinking:
+    a death in between leaves orphan files (harmless), never sealed
+    entries pointing at deleted files (ADVICE r4). Simulated by making
+    the first unlink die."""
+    from pathlib import Path
+
+    ckpt = LevelCheckpointer(str(tmp_path / "drop_order"))
+    for s in range(2):
+        ckpt.save_forward_level_shard(0, s, np.arange(4, dtype=np.uint32))
+    ckpt.finish_forward_level(0, 2)
+
+    class _Die(Exception):
+        pass
+
+    orig_unlink = Path.unlink
+
+    def dying_unlink(self, *a, **k):
+        if self.name.startswith("frontier_"):
+            raise _Die()
+        return orig_unlink(self, *a, **k)
+
+    Path.unlink = dying_unlink
+    try:
+        with pytest.raises(_Die):
+            ckpt.drop_forward_level_shards()
+    finally:
+        Path.unlink = orig_unlink
+    fresh = LevelCheckpointer(str(tmp_path / "drop_order"))
+    # Manifest entries are gone even though the files survive; a resumed
+    # run re-runs forward instead of crashing on the sealed entries.
+    assert "forward_level_shards" not in fresh.load_manifest()
+    assert fresh.load_forward_level_shards(2) == {}
+
+
 def test_paranoid_catches_zero_move_undecided():
     """A non-primitive position with no legal moves must trip --paranoid."""
     import pytest

@@ -91,6 +91,29 @@ class Session:
             if full_env.get("PYTHONPATH") else ""
         )
         full_env.update(env or {})
+        if (any(a.endswith("bench.py") for a in argv)
+                and "GAMESMAN_BENCH_DEADLINE" not in full_env):
+            # The parent bench salvages its inner child's partial stdout
+            # when ITS deadline fires — but only if this step's kill
+            # arrives later. The parent's clock is probe (default 600s,
+            # and it always runs here because GAMESMAN_PLATFORM was
+            # popped) THEN the deadline-clocked inner child; cap both so
+            # probe + deadline + margin < this step's timeout and every
+            # timeout path ends with the parent printing
+            # best-of-completed-runs instead of this step discarding all
+            # measured repeats. Probe 300s is generous: this script
+            # TCP-probed the relay seconds ago.
+            probe = min(300, max(60, int(timeout) // 4))
+            full_env.setdefault("GAMESMAN_PROBE_TIMEOUT", str(probe))
+            try:
+                probe = int(float(full_env["GAMESMAN_PROBE_TIMEOUT"]))
+            except ValueError:
+                # bench warns and falls back to ITS default (600s) — the
+                # deadline must budget for the probe bench will actually
+                # run, not the value we failed to parse.
+                probe = 600
+            full_env["GAMESMAN_BENCH_DEADLINE"] = str(
+                max(300, int(timeout) - probe - 120))
         t0 = time.time()
         try:
             proc = subprocess.run(
@@ -155,8 +178,10 @@ def main() -> int:
     s.record(step="probe", status="ok")
 
     bench = [py, os.path.join(REPO, "bench.py")]
+    # REPEATS=3 + bench's runs.median_pps: r04's 6x4 was best-of-2 with an
+    # unexplained 5x spread — three runs make an outlier self-evident.
     b55 = {"BENCH_SYM": "0", "BENCH_LADDER": "0",
-           "BENCH_GAME": "connect4:w=5,h=5", "BENCH_REPEATS": "2"}
+           "BENCH_GAME": "connect4:w=5,h=5", "BENCH_REPEATS": "3"}
 
     if args.pallas_only:
         s.step("pallas_chip_check",
@@ -177,8 +202,16 @@ def main() -> int:
                timeout=1200, parse_json=False)
         s.step("dense_gather_pallas", bench,
                env={**b55, "GAMESMAN_DENSE_GATHER": "pallas"})
+        # Re-prove the provisional 10.97M 6x4 headline: 3 runs, best AND
+        # median land in the record (VERDICT r4 weak #1).
+        s.step("dense_6x4", bench,
+               env={**b55, "BENCH_GAME": "connect4:w=6,h=4"}, timeout=3000)
+        # 6x5 run 0 alone ran 50 min in r04 before the relay died: two
+        # repeats (cold+warm) is the most a realistic window holds, and
+        # bench's provisional records salvage run 0 if run 1 never lands.
         s.step("dense_6x5", bench,
-               env={**b55, "BENCH_GAME": "connect4:w=6,h=5"}, timeout=5400)
+               env={**b55, "BENCH_GAME": "connect4:w=6,h=5",
+                    "BENCH_REPEATS": "2"}, timeout=5400)
         s.step("bench_full", bench, env={}, timeout=3600)
         s.record(step="done", status="aborted" if s.aborted else "complete")
         return 1 if s.aborted else 0

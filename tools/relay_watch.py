@@ -42,6 +42,8 @@ def main() -> int:
     ap.add_argument("--phase3", action="store_true")
     ap.add_argument("--pallas-only", action="store_true")
     ap.add_argument("--max-hours", type=float, default=10.0)
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "artifacts", "chip_session_r05.jsonl"))
     ap.add_argument("--poll-secs", type=float, default=60.0)
     ap.add_argument("--settle-secs", type=float, default=45.0)
     args = ap.parse_args()
@@ -55,9 +57,7 @@ def main() -> int:
             if relay_up():
                 argv = [sys.executable,
                         os.path.join(REPO, "tools", "chip_session.py"),
-                        "--out",
-                        os.path.join(REPO, "artifacts",
-                                     "chip_session_r04.jsonl")]
+                        "--out", args.out]
                 if args.pallas_only:
                     argv.append("--pallas-only")
                 elif args.phase3:
