@@ -458,10 +458,18 @@ def _main(args) -> int:
             file=sys.stderr,
         )
         return 2
-    if args.engine == "hybrid" and not family_ok:
+    # The hybrid accepts sym=1 (r5): its BFS region keeps the mirror
+    # reduction and the dense region runs a sym-free twin — see
+    # solve/hybrid.py.
+    hybrid_ok = (
+        isinstance(game, Connect4)
+        and not args.paranoid and not args.table_out
+        and not args.checkpoint_dir
+    )
+    if args.engine == "hybrid" and not hybrid_ok:
         print(
-            "error: --engine hybrid needs a Connect-4-family game with "
-            "sym=0 and no --checkpoint-dir/--paranoid/--table-out "
+            "error: --engine hybrid needs a Connect-4-family game "
+            "and no --checkpoint-dir/--paranoid/--table-out "
             "(those live in the classic engine)",
             file=sys.stderr,
         )

@@ -95,7 +95,7 @@ def default_cutover(ncells: int) -> int:
 
 
 def build_extract_step(tables, level: int, cblock: int, rank_dtype,
-                       use_onehot: bool):
+                       use_onehot: bool, canon_fn=None):
     """Level-B frontier extraction: (row, rank) reach cells -> packed states.
 
     Returned fn:
@@ -106,6 +106,12 @@ def build_extract_step(tables, level: int, cblock: int, rank_dtype,
 
     packed = current-player stones | guards (games/connect4.py encoding);
     at level B the player to move is p1 iff B is even.
+
+    canon_fn (sym=1 only): the game's canonicalize, applied to the kept
+    packed states so the handed-off frontier is mirror representatives —
+    the BFS engines' tables are canonical, and a non-canonical frontier
+    would seed them with both class members. Applied BEFORE sentinel
+    fill: canonicalizing the sentinel would corrupt the padding.
     """
     ncells = tables.ncells
     dt = jnp.uint64 if tables.bits_dtype == np.uint64 else jnp.uint32
@@ -124,6 +130,8 @@ def build_extract_step(tables, level: int, cblock: int, rank_dtype,
                           use_onehot)
         current = p1 if current_is_p1 else filled[:, None] ^ p1
         packed = current | guards[:, None]
+        if canon_fn is not None:
+            packed = canon_fn(packed)
         keep = (reach != 0) & in_range
         return jnp.where(keep, packed, dt(sentinel))
 
@@ -131,7 +139,8 @@ def build_extract_step(tables, level: int, cblock: int, rank_dtype,
 
 
 def build_boundary_step(tables, level: int, cblock: int, wcap: int,
-                        rank_dtype, use_onehot: bool, method: str):
+                        rank_dtype, use_onehot: bool, method: str,
+                        canon_fn=None):
     """Dense resolve of cutover level K against the sparse level-B table.
 
     Identical to build_dense_step except the child value source: instead
@@ -142,6 +151,12 @@ def build_boundary_step(tables, level: int, cblock: int, wcap: int,
     [wcap] dense-format u8 cells). Misses yield UNDECIDED — impossible
     for reachable parents (their children are reachable by construction),
     garbage-quarantined otherwise (module docstring).
+
+    canon_fn (sym=1 only): children are canonicalized before the search —
+    the level-B table holds mirror representatives, and the mirror
+    preserves value and remoteness, so the representative's cell IS the
+    child's (the same rule canonical_children applies inside the BFS
+    backward).
 
     Returned fn:
       (rank0, kstates [wcap], kcells [wcap] u8,
@@ -174,6 +189,8 @@ def build_boundary_step(tables, level: int, cblock: int, wcap: int,
         child_vals, child_rems, masks = [], [], []
         for c in range(w):
             child = opponent | (guards[:, None] + newbit[:, c : c + 1])
+            if canon_fn is not None:
+                child = canon_fn(child)
             idx = jnp.searchsorted(
                 kstates, child.reshape(-1), method=method
             )
@@ -202,7 +219,8 @@ def build_boundary_step(tables, level: int, cblock: int, wcap: int,
 
 
 def build_boundary_children_step(tables, level: int, cblock: int,
-                                 rank_dtype, use_onehot: bool):
+                                 rank_dtype, use_onehot: bool,
+                                 canon_fn=None):
     """Streamed boundary, phase 1: one rank block's packed children.
 
     Returned fn:
@@ -212,6 +230,8 @@ def build_boundary_children_step(tables, level: int, cblock: int,
     Same unrank/line/drop algebra as build_boundary_step, but the children
     are EMITTED so the per-window-block lookups (phase 2) never repeat the
     unrank walks — the dense engine's whole economy is amortizing them.
+    canon_fn (sym=1): children are emitted as mirror representatives, so
+    phase 2's searches hit the canonical level-B blocks.
     """
     w, h, connect = tables.width, tables.height, tables.connect
     dt = jnp.uint64 if tables.bits_dtype == np.uint64 else jnp.uint32
@@ -237,6 +257,8 @@ def build_boundary_children_step(tables, level: int, cblock: int,
              for c in range(w)],
             axis=-1,
         )
+        if canon_fn is not None:
+            children = canon_fn(children)
         return children, prim_mask
 
     return step
@@ -345,6 +367,13 @@ class HybridSolveResult:
             return cell & 3, cell >> 2
         if self.levels is None:
             raise KeyError("solved in no-tables mode")
+        if self.game.sym:
+            # BFS-side tables hold mirror representatives; canonicalize
+            # the query so either class member answers (the dense side
+            # above needs no such step — it indexes the full space).
+            from gamesmanmpi_tpu.solve.engine import canonical_scalar
+
+            state, level = canonical_scalar(self.game, state)
         table = self.levels.get(level)
         if table is not None:
             i = int(np.searchsorted(table.states, state))
@@ -377,10 +406,21 @@ class HybridSolver:
                  devices: int = 1):
         if not isinstance(game, Connect4):
             raise TypeError("HybridSolver requires a Connect4-family game")
-        if game.sym:
-            raise ValueError("HybridSolver requires sym=False (the dense "
-                             "side indexes the full space)")
         self.game = game
+        # sym=1: the BFS region keeps the mirror reduction (it is where
+        # the reachable-set cost lives — the v4-16 6x6 plan budgets its
+        # per-chip peak level WITH sym), while the dense region indexes
+        # the FULL space through a sym-free twin: dense perfect indexing
+        # enumerates (row, rank) classes and cannot skip mirror
+        # duplicates, and its low levels are the cheap side of the
+        # cutover. The seam canonicalizes in both directions (extracted
+        # frontier -> representatives; boundary-join children ->
+        # representatives before the level-B search), mirroring what
+        # canonical_children does inside both BFS engines.
+        self.dense_game = (
+            Connect4(game.width, game.height, game.connect, sym=False)
+            if game.sym else game
+        )
         self.store_tables = store_tables
         self.logger = logger
         self.devices = int(devices)
@@ -402,7 +442,8 @@ class HybridSolver:
         # over the same mesh the BFS region shards over (the capacity-plan
         # composition for 6x6 — docs/ARCHITECTURE.md "Mesh-partitioned
         # dense"); the boundary join stays single-device.
-        self.dense = DenseSolver(game, store_tables=store_tables,
+        self.dense = DenseSolver(self.dense_game,
+                                 store_tables=store_tables,
                                  logger=logger, count_positions=False,
                                  devices=self.devices)
         self.tables = self.dense.tables
@@ -453,10 +494,14 @@ class HybridSolver:
             return (kind, self.tables.width, self.tables.height,
                     self.tables.connect, B, cblock, d.use_onehot)
 
+        # Keyed on the SYM game (g.cache_key embeds the _sym name): the
+        # canonicalizing and plain extract programs must never share a
+        # cache entry.
+        canon = g.canonicalize if g.sym else None
         step = get_kernel(
             g, "hyx", key("hyx"),
             lambda _g: build_extract_step(
-                t, B, cblock, d._rank_dtype, d.use_onehot
+                t, B, cblock, d._rank_dtype, d.use_onehot, canon_fn=canon
             ),
         )
         pieces = []
@@ -475,9 +520,9 @@ class HybridSolver:
                 consts["binom"], consts["cellidx"], consts["filled"],
                 guards,
             )
-            # Distinct (row, rank) are distinct positions, so this is pure
-            # compaction; sort_unique also sorts, giving per-block sorted
-            # prefixes the host merge below concatenates.
+            # Distinct (row, rank) are distinct positions, so without sym
+            # this is pure compaction; with sym two cells can share a
+            # representative, making the per-block unique a real dedup.
             uniq, count = sort_unique(packed.reshape(-1))
             n = int(count)
             if n:
@@ -485,6 +530,10 @@ class HybridSolver:
         if not pieces:
             return np.empty(0, dtype=g.state_dtype)
         frontier = np.concatenate(pieces)
+        if g.sym:
+            # Mirror pairs can fall in different rank blocks; the host
+            # merge must dedup ACROSS blocks too, not just sort.
+            return np.unique(frontier)
         frontier.sort()
         return frontier
 
@@ -536,12 +585,14 @@ class HybridSolver:
             return (kind, t.width, t.height, t.connect, K, cblock,
                     d.use_onehot) + extra
 
+        canon = g.canonicalize if g.sym else None
         table_bytes = wcap * (kstates.dtype.itemsize + 1)
         if table_bytes <= self.resident_mb << 20:
             step = get_kernel(
                 g, "hyb", kkey("hyb", wcap, sm),
                 lambda _g: build_boundary_step(
-                    t, K, cblock, wcap, d._rank_dtype, d.use_onehot, sm
+                    t, K, cblock, wcap, d._rank_dtype, d.use_onehot, sm,
+                    canon_fn=canon,
                 ),
             )
             ks_dev, kc_dev = jnp.asarray(kstates), jnp.asarray(kcells)
@@ -559,7 +610,7 @@ class HybridSolver:
         children_step = get_kernel(
             g, "hybc", kkey("hybc"),
             lambda _g: build_boundary_children_step(
-                t, K, cblock, d._rank_dtype, d.use_onehot
+                t, K, cblock, d._rank_dtype, d.use_onehot, canon_fn=canon
             ),
         )
         acc_step = get_kernel(
@@ -605,14 +656,27 @@ class HybridSolver:
         # Phase 1-2: dense sweep to the boundary, extract the BFS frontier.
         counts, reach_flat = self._sweep_to_boundary()
         frontier = self._extract_frontier(reach_flat)
-        if frontier.shape[0] != counts[B]:
+        if g.sym:
+            # The sweep counts the FULL reachable set at B; extraction
+            # canonicalizes, so representatives number between half and
+            # all of it (self-mirror positions keep the count above N/2).
+            ok = (counts[B] == 0 and frontier.shape[0] == 0) or (
+                counts[B] // 2 <= frontier.shape[0] <= counts[B]
+            )
+        else:
+            ok = frontier.shape[0] == counts[B]
+        if not ok:
             raise RuntimeError(
                 f"hybrid seam: extracted {frontier.shape[0]} level-{B} "
-                f"states but the sweep counted {counts[B]} — "
-                "extraction/sweep disagree"
+                f"states but the sweep counted {counts[B]} "
+                f"(sym={int(g.sym)}) — extraction/sweep disagree"
             )
         t_sweep = time.perf_counter() - t0
-        self._log(phase="hybrid_sweep", boundary=B, frontier=counts[B],
+        # frontier = what is HANDED to the BFS region (representatives
+        # under sym=1); reachable = the sweep's full-space count. Equal
+        # without sym; both logged so the ~2x sym gap is auditable.
+        self._log(phase="hybrid_sweep", boundary=B,
+                  frontier=int(frontier.shape[0]), reachable=counts[B],
                   secs=round(t_sweep, 3))
 
         # Phase 3: BFS over levels B..N from the extracted frontier —
@@ -679,7 +743,14 @@ class HybridSolver:
             "game": g.name,
             "engine": "hybrid",
             "cutover": K,
+            # With sym=1 the two regions count DIFFERENT things: the
+            # dense region the full reachable set (it indexes the full
+            # space), the BFS region mirror representatives — the
+            # breakdown keys make the mixed total auditable.
             "positions": positions,
+            "positions_dense_region": sum(
+                v for L, v in counts.items() if L <= K),
+            "positions_bfs_region": sum(bfs_counts.values()),
             "positions_per_sec": positions / max(t_total, 1e-9),
             # Discovery = sweep + extraction; everything after is resolve.
             "secs_forward": t_sweep,
@@ -688,7 +759,10 @@ class HybridSolver:
             "secs_bfs": t_bfs,
             "bytes_sorted": bfs.bytes_sorted,
             "bytes_gathered": bfs.bytes_gathered,
-            "frontier_at_boundary": counts[B],
+            # Canonical size actually seeded into the BFS region; the
+            # full-space sweep count sits alongside (equal when sym=0).
+            "frontier_at_boundary": int(frontier.shape[0]),
+            "reachable_at_boundary": counts[B],
         }
         self._log(phase="done", **{k: v for k, v in stats.items()
                                    if k != "game"})
