@@ -29,13 +29,24 @@ installed lowering, jax/_src/pallas/mosaic/lowering.py):
   (row*/lane* broadcast to the [R, 128] operand shape), processing R
   outputs per step so every gather operand/index shape matches.
 
-Contract: monotone_window_gather(table_u32, idx_i32) == table[idx] for
+Contract: monotone_window_gather(table_u32, idx) == table[idx] for
 non-decreasing idx, EXCEPT for elements whose block spans more than one
 window width — those are miss-flagged (out undefined there) and counted;
 the caller sizes `window` so misses are structurally rare and falls back
 to a plain gather when nmiss > 0. The dense child gathers have expansion
 ratio C(L+1,n1')/C(L,n1) <= 2, so window = 4*block covers them with
 margin.
+
+idx may be int32 OR int64 (round 5): the kernel never sees the absolute
+indices — BLOCK-LOCAL offsets (idx - block's window-aligned base, in
+[0, 2*window)) are computed outside in one fused elementwise XLA pass
+and enter Mosaic as int32. int64 inside a Mosaic kernel is a hard
+no-go (the int64->int32 convert lowering recurses forever — r04 chip
+session), but an int64 FLAT INDEX SPACE only needs 64-bit arithmetic
+outside: the per-block window base (window units) stays under 2^31 for
+any table XLA can allocate, and offsets are bounded by 2*window. This
+is what unlocks gather_mode=pallas for int64-flat boards (6x6+), where
+the gather win matters most (solve/dense.py:~1103, VERDICT r4 #3).
 """
 
 from __future__ import annotations
@@ -79,8 +90,8 @@ def padded_table_len(m: int, window: int) -> int:
 
 def monotone_window_gather(table, idx, block: int = 2048,
                            window: int = 8192, interpret: bool = False):
-    """table [M] uint32, idx [N] int32 non-decreasing ->
-    (out [N] uint32, nmiss scalar int32).
+    """table [M], idx [N] int32/int64 non-decreasing ->
+    (out [N] table.dtype, nmiss scalar int32).
 
     Misses (a block spanning past its 2-window view) leave garbage in
     `out` at those positions and are counted (when nonzero, the count may
@@ -117,8 +128,11 @@ def monotone_window_gather(table, idx, block: int = 2048,
             [table, jnp.zeros((tpad,), table.dtype)]
         )
     starts = idx[:: block]  # [nblk] first index of each block
+    # All absolute-index arithmetic happens HERE, in idx's own dtype
+    # (int64 for 6x6+ flat spaces): only window-unit bases (< 2^31 for
+    # any allocatable table) and 2*window-bounded offsets reach Mosaic.
     base_win = jnp.clip(starts // window, 0, nwin - 2).astype(jnp.int32)
-    aligned = base_win * window
+    aligned = base_win.astype(idx.dtype) * idx.dtype.type(window)
 
     # The table reaches the kernel as a [padded/128, 128] matrix, reshaped
     # ONCE outside (a free XLA relayout): an in-kernel rank-1 -> rank-2
@@ -131,22 +145,32 @@ def monotone_window_gather(table, idx, block: int = 2048,
     wrows = window // 128
     table2d = table.reshape(padded // 128, 128)
 
+    # Block-local offsets, computed OUTSIDE the kernel (one fused
+    # elementwise XLA pass in idx's dtype) and clamped into the tile:
+    # the kernel receives only these int32 offsets, so an int64 flat
+    # index space never enters Mosaic (module docstring). The miss count
+    # shares the same pass — misses depend only on idx and the window
+    # bases (Mosaic's rank-1 output block rule keeps it out of the
+    # kernel regardless).
+    off_all = idx - jnp.repeat(aligned, block)
+    miss = jnp.sum(((off_all < 0) | (off_all >= 2 * window))
+                   .astype(jnp.int32))
+    off_i32 = jnp.clip(off_all, 0, 2 * window - 1).astype(jnp.int32)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,  # aligned bases (element units + window units)
+        num_scalar_prefetch=1,  # window-unit tile bases
         grid=(nblk,),
         in_specs=[
-            pl.BlockSpec((block,), lambda i, al, bw: (i,)),
-            pl.BlockSpec((wrows, 128), lambda i, al, bw: (bw[i], 0)),
-            pl.BlockSpec((wrows, 128), lambda i, al, bw: (bw[i] + 1, 0)),
+            pl.BlockSpec((block,), lambda i, bw: (i,)),
+            pl.BlockSpec((wrows, 128), lambda i, bw: (bw[i], 0)),
+            pl.BlockSpec((wrows, 128), lambda i, bw: (bw[i] + 1, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((block,), lambda i, al, bw: (i,)),
+            pl.BlockSpec((block,), lambda i, bw: (i,)),
         ],
     )
 
-    def kernel(al_ref, bw_ref, idx_ref, t0_ref, t1_ref, out_ref):
-        i = pl.program_id(0)
-        base = al_ref[i]
+    def kernel(bw_ref, off_ref, t0_ref, t1_ref, out_ref):
         # [rows, 128] view of the two window tiles. Sub-32-bit tables (the
         # dense engine's u8 cells) gather as i32 — Mosaic's dynamic_gather
         # targets 32-bit lanes; the cast back on store is exact for
@@ -158,13 +182,11 @@ def monotone_window_gather(table, idx, block: int = 2048,
         # Python ints trace as weak int64 scalars, and ANY int64 in a
         # Mosaic kernel hits the infinitely-recursing int64->int32
         # convert lowering (see _dyn_gather's docstring). Chunks are
-        # STATIC rank-1 slices of idx_ref — a [nchunk, rows] reshape
+        # STATIC rank-1 slices of off_ref — a [nchunk, rows] reshape
         # would be another Mosaic shape cast (see the tile note above).
-        zero, c128 = jnp.int32(0), jnp.int32(128)
-        hi = jnp.int32(2 * window - 1)
+        c128 = jnp.int32(128)
         for k in range(nchunk):
-            off = idx_ref[k * rows:(k + 1) * rows] - base   # [rows]
-            off = lax.max(lax.min(off, hi), zero)
+            off = off_ref[k * rows:(k + 1) * rows]          # [rows]
             r = lax.div(off, c128)
             c = lax.rem(off, c128)
             v = _dyn_gather(
@@ -180,13 +202,7 @@ def monotone_window_gather(table, idx, block: int = 2048,
         ],
         grid_spec=grid_spec,
         interpret=interpret,
-    )(aligned, base_win, idx, table2d, table2d)
-    # Misses depend only on idx and the precomputed window bases, so the
-    # count lives OUTSIDE the kernel as one fused elementwise XLA pass
-    # (see module docstring: Mosaic's rank-1 output block rule).
-    off_all = idx - jnp.repeat(aligned, block)
-    miss = jnp.sum(((off_all < 0) | (off_all >= 2 * window))
-                   .astype(jnp.int32))
+    )(base_win, off_i32, table2d, table2d)
     # Padding lanes replicate idx[-1]; they miss iff the real tail element
     # misses, so nmiss stays 0 exactly when every real element hit (the
     # contract callers check). When nonzero it may count tail replicas.

@@ -1,5 +1,6 @@
 """core/: value algebra, codec, hashing, bit ops."""
 
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -19,6 +20,9 @@ from gamesmanmpi_tpu.core import (
 )
 from gamesmanmpi_tpu.core.hashing import owner_shard_np
 from gamesmanmpi_tpu.core.values import MAX_REMOTENESS
+
+# Smoke tier: fast, compile-light, single-process-safe (see pyproject).
+pytestmark = pytest.mark.smoke
 
 
 def test_negate_involution():

@@ -14,6 +14,9 @@ from gamesmanmpi_tpu.solve.oracle import normalize_value
 
 from helpers import REF_GAMES, load_module
 
+# Smoke tier: fast, compile-light, single-process-safe (see pyproject).
+pytestmark = pytest.mark.smoke
+
 
 def _random_walk_positions(module, rng, n_walks=60):
     """Sample reachable positions by random playouts of the scalar module."""

@@ -1,10 +1,14 @@
 """ops/: padding, dedup, lookup, combine kernels."""
 
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
 from gamesmanmpi_tpu.core.bitops import SENTINEL64 as SENTINEL
 from gamesmanmpi_tpu.core.values import WIN, LOSE, TIE, UNDECIDED
+
+# Smoke tier: fast, compile-light, single-process-safe (see pyproject).
+pytestmark = pytest.mark.smoke
 from gamesmanmpi_tpu.ops import (
     bucket_size,
     pad_to_bucket,

@@ -27,6 +27,9 @@ from gamesmanmpi_tpu.ops.combine import combine_children
 from gamesmanmpi_tpu.ops.dedup import sort_unique
 from gamesmanmpi_tpu.solve.oracle import combine_host
 
+# Smoke tier: fast, compile-light, single-process-safe (see pyproject).
+pytestmark = pytest.mark.smoke
+
 VALUES = st.sampled_from([WIN, LOSE, TIE])
 _SETTINGS = dict(max_examples=50, deadline=None)
 

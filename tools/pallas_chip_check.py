@@ -25,10 +25,21 @@ import numpy as np
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
-import jax
-import jax.numpy as jnp
+from gamesmanmpi_tpu.utils.platform import apply_platform_env
 
-from gamesmanmpi_tpu.ops.pallas_gather import monotone_window_gather
+# --smoke is by definition an off-chip run: force CPU ourselves rather
+# than requiring the operator to remember GAMESMAN_PLATFORM=cpu — the
+# container pins jax_platforms="axon,cpu", so a bare run with the relay
+# down hangs dialing the dead backend.
+if "--smoke" in sys.argv:
+    os.environ.setdefault("GAMESMAN_PLATFORM", "cpu")
+# Honor GAMESMAN_PLATFORM before the first backend touch.
+apply_platform_env()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from gamesmanmpi_tpu.ops.pallas_gather import monotone_window_gather  # noqa: E402
 
 
 def timeit(fn, *args, n=3, warmup=2):
@@ -45,11 +56,17 @@ def timeit(fn, *args, n=3, warmup=2):
 
 
 def main() -> int:
+    # --smoke: the r4 session lost its only window slot to an untested
+    # launcher (ModuleNotFoundError) — this flag runs the EXACT same
+    # entrypoints off-relay (CPU interpret, tiny sizes) so the tool is
+    # provably runnable before it ever costs chip time.
+    smoke = "--smoke" in sys.argv
     dev = jax.devices()[0]
-    print(f"device: {dev.platform} ({dev})", flush=True)
+    print(f"device: {dev.platform} ({dev})"
+          + (" [SMOKE: interpret, tiny]" if smoke else ""), flush=True)
     rng = np.random.default_rng(0)
-    N = 32 * 1024 * 1024
-    M = 8 * 1024 * 1024
+    N = 64 * 1024 if smoke else 32 * 1024 * 1024
+    M = 16 * 1024 if smoke else 8 * 1024 * 1024
     idx_np = np.sort(rng.integers(0, M, size=N)).astype(np.int32)
     idx = jnp.asarray(idx_np)
 
@@ -61,15 +78,15 @@ def main() -> int:
         name = np.dtype(dtype).name
 
         secs_x, ref = timeit(lambda t, i: t[i], tb, idx)
-        print(f"xla gather {name} [32M from 8M]      {secs_x*1e3:9.2f} ms",
-              flush=True)
+        print(f"xla gather {name} [{N//1024}K from {M//1024}K]"
+              f"      {secs_x*1e3:9.2f} ms", flush=True)
         ref_np = np.asarray(ref)
 
         for block, window in ((2048, 8192), (4096, 16384), (8192, 32768)):
             label = f"pallas monotone {name} b={block} w={window}"
             try:
                 fn = jax.jit(lambda t, i: monotone_window_gather(
-                    t, i, block=block, window=window))
+                    t, i, block=block, window=window, interpret=smoke))
                 secs, (out, nmiss) = timeit(fn, tb, idx)
             except Exception as e:  # Mosaic rejection or runtime failure
                 kernel_ok = False
@@ -88,8 +105,32 @@ def main() -> int:
                             "xla_secs": round(secs_x, 4),
                             "speedup": round(secs_x / secs, 2)})
 
+    # int64-idx leg (6x6+ flat spaces): same data, idx widened — must be
+    # bit-identical and Mosaic-accepted (the kernel sees only block-local
+    # int32 offsets; this proves the wrapper's claim on silicon).
+    tb = jnp.asarray(rng.integers(0, 1 << 30, size=M, dtype=np.uint32))
+    ref64 = np.asarray(tb[idx])
+    try:
+        fn64 = jax.jit(lambda t, i: monotone_window_gather(
+            t, i, block=2048, window=8192, interpret=smoke))
+        secs64, (out64, nm64) = timeit(fn64, tb, idx.astype(jnp.int64))
+        good64 = (bool((np.asarray(out64) == ref64).all())
+                  and int(nm64) == 0)
+        print(f"pallas monotone uint32 i64-idx b=2048 w=8192  "
+              f"{secs64*1e3:9.2f} ms   miss={int(nm64)} correct={good64}",
+              flush=True)
+        if not good64:
+            kernel_ok = False
+        results.append({"dtype": "uint32_i64idx", "block": 2048,
+                        "window": 8192, "secs": round(secs64, 4),
+                        "nmiss": int(nm64), "correct": good64})
+    except Exception as e:
+        kernel_ok = False
+        print(f"pallas i64-idx leg FAILED: {type(e).__name__}: {e}"[:220],
+              flush=True)
+
     best = max((r for r in results if r["correct"]),
-               key=lambda r: r["speedup"], default=None)
+               key=lambda r: r.get("speedup", 0.0), default=None)
     print(json.dumps({"kernel_ok": kernel_ok, "device": dev.platform,
                       "best": best}), flush=True)
     return 0 if kernel_ok else 1
