@@ -147,6 +147,24 @@ def test_cli_engine_hybrid(capsys):
     assert rc == 2
 
 
+def test_cli_engine_hybrid_sym(capsys):
+    """sym=1 is hybrid-eligible at the CLI (r5): the mirror-reduced BFS
+    region rides behind the same flag surface."""
+    from gamesmanmpi_tpu.cli import main as cli_main
+
+    rc = cli_main(["connect4:w=3,h=3,connect=3,sym=1", "--engine",
+                   "hybrid", "--hybrid-cutover", "5"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "value: TIE" in out
+    assert "remoteness: 9" in out
+    # 453 = full-space dense levels 0..5 + mirror representatives 6..9:
+    # the mixed count unique to THIS composition (non-sym hybrid/classic
+    # print 694), so a CLI regression silently dropping sym, or an
+    # engine fallback that still exits 0, cannot pass on TIE/r9 alone.
+    assert "positions: 453" in out
+
+
 def test_cli_hybrid_bad_cutover_exits_cleanly(capsys, monkeypatch):
     from gamesmanmpi_tpu.cli import main as cli_main
 
