@@ -56,6 +56,7 @@ replacing the reference's `mpirun -np 1` vs `-np N` (SURVEY.md §4.2).
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, List
 
@@ -437,6 +438,24 @@ class ShardedSolver:
         self.fast = bool(game.uniform_level_jump) and not force_generic
         self.device_store_bytes = _device_store_bytes()
         self.backward_block = _backward_block()
+        # Route-capacity headroom (strict parse, fail-fast like the other
+        # capacity knobs): see _initial_route_cap.
+        raw = os.environ.get("GAMESMAN_ROUTE_HEADROOM")
+        try:
+            self.route_headroom = float(raw) if raw else 2.0
+        except ValueError:
+            raise SolverError(
+                f"GAMESMAN_ROUTE_HEADROOM={raw!r} is not a number"
+            ) from None
+        import math
+
+        if not math.isfinite(self.route_headroom) or self.route_headroom <= 0:
+            # nan/inf parse as floats but would crash mid-solve inside
+            # _initial_route_cap's int() — fail here, at construction.
+            raise SolverError(
+                f"GAMESMAN_ROUTE_HEADROOM must be a finite number > 0, "
+                f"got {self.route_headroom}"
+            )
         #: number of capacity-overflow retries taken (forward + backward);
         #: the observable for the spill-path tests.
         self.spill_retries = 0
@@ -730,12 +749,21 @@ class ShardedSolver:
     def _initial_route_cap(self, cap: int) -> int:
         """First-try per-(src,dst) all_to_all capacity for a level of `cap`.
 
-        Expected bucket load is cap*max_moves/S; 2x headroom absorbs skew.
-        Overflow is detected exactly (per-destination counts) and retried —
-        tests shrink this estimate to force the spill path deterministically.
+        Expected bucket load is cap*max_moves/S; the headroom factor
+        (GAMESMAN_ROUTE_HEADROOM, default 2.0) absorbs owner skew.
+        Overflow is detected exactly (per-destination counts) and retried
+        at the exact size — tests shrink this estimate to force the spill
+        path deterministically. At 1e8+ frontiers the route/sort buffers
+        scale with S*S*route_cap, so on a fake mesh (all shards in ONE
+        host's RAM) headroom 1.0 halves peak memory for the price of an
+        occasional one-step retry: the r5 8-shard 5x6 witness was
+        OOM-killed at its peak level with the 2x default (130 GB RSS on
+        a 125 GB box) and fits with 1.0.
         """
         return bucket_size(
-            max(64, 2 * cap * self.game.max_moves // self.S), self.min_bucket
+            max(64, int(self.route_headroom * cap * self.game.max_moves)
+                // self.S),
+            self.min_bucket,
         )
 
     # ----------------------------------------------------------------- phases

@@ -13,6 +13,7 @@ from gamesmanmpi_tpu.core.values import TIE, WIN
 from gamesmanmpi_tpu.games import get_game
 from gamesmanmpi_tpu.parallel import ShardedSolver
 from gamesmanmpi_tpu.solve import Solver
+from gamesmanmpi_tpu.solve.engine import SolverError
 
 from helpers import full_table
 
@@ -70,6 +71,35 @@ def test_route_capacity_spill_path(spec):
     assert result.value == single.value
     assert result.remoteness == single.remoteness
     assert full_table(result) == full_table(single)
+
+
+def test_route_headroom_knob(monkeypatch):
+    """GAMESMAN_ROUTE_HEADROOM scales the first-try route capacity (the
+    peak-memory lever on fake meshes — the r5 8-shard 5x6 witness was
+    OOM-killed under the 2x default); tight headroom must still solve
+    exactly, leaning on the exact overflow retry."""
+    single = Solver(get_game("tictactoe")).solve()
+    # The knob's whole point is to be exported in memory-constrained
+    # shells; don't let an ambient setting fail the default assertion.
+    monkeypatch.delenv("GAMESMAN_ROUTE_HEADROOM", raising=False)
+    default = ShardedSolver(get_game("tictactoe"), num_shards=4)
+    assert default.route_headroom == 2.0
+    monkeypatch.setenv("GAMESMAN_ROUTE_HEADROOM", "1.0")
+    lean = ShardedSolver(get_game("tictactoe"), num_shards=4)
+    assert lean.route_headroom == 1.0
+    assert (lean._initial_route_cap(4096)
+            <= default._initial_route_cap(4096) // 2)
+    r = lean.solve()
+    assert (r.value, r.remoteness) == (single.value, single.remoteness)
+    monkeypatch.setenv("GAMESMAN_ROUTE_HEADROOM", "zero")
+    with pytest.raises(SolverError, match="ROUTE_HEADROOM"):
+        ShardedSolver(get_game("tictactoe"), num_shards=4)
+    monkeypatch.setenv("GAMESMAN_ROUTE_HEADROOM", "-1")
+    with pytest.raises(SolverError, match="ROUTE_HEADROOM"):
+        ShardedSolver(get_game("tictactoe"), num_shards=4)
+    monkeypatch.setenv("GAMESMAN_ROUTE_HEADROOM", "nan")
+    with pytest.raises(SolverError, match="finite"):
+        ShardedSolver(get_game("tictactoe"), num_shards=4)
 
 
 def test_sharded_blocked_backward_parity():
