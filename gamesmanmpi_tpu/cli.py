@@ -13,6 +13,19 @@ A file path argument is the reference's dynamic game-module import: the module
 is loaded, validated for the 4-function API, and solved unmodified via the
 compat layer. Built-in tensorized games are selected by spec string
 (gamesmanmpi_tpu.games.get_game).
+
+Three serving subcommands ride in front of the flat solve CLI (which is
+unchanged — any first argument that is not a subcommand name parses exactly
+as before):
+
+    python -m gamesmanmpi_tpu.cli export-db GAME --out DB [--from-checkpoint D]
+    python -m gamesmanmpi_tpu.cli serve DB [--port N] [--batch-window-ms MS]
+    python -m gamesmanmpi_tpu.cli query DB POS [POS ...]
+
+export-db builds the immutable solved-position database (db/) from a fresh
+solve (streamed level-by-level through the engine's level_sink hook) or from
+an existing --checkpoint-dir; serve answers batched POST /query over it
+(serve/); query probes it offline. docs/SERVING.md is the full spec.
 """
 
 from __future__ import annotations
@@ -204,7 +217,7 @@ def _lookup_checkpoint(game, checkpointer, state):
         return None
 
 
-def _report(result, devices: int, elapsed: float, args, logger) -> None:
+def _report(result, devices: int, elapsed: float, args) -> None:
     """The rank-0 output block (SURVEY.md §2.1.4), shared by every engine
     path: value + remoteness + elapsed, optional table dump."""
     from gamesmanmpi_tpu.core.values import value_name
@@ -254,11 +267,18 @@ def _report(result, devices: int, elapsed: float, args, logger) -> None:
             # Bad literal / doesn't fit the game's state dtype — report per
             # query; the solve itself already succeeded.
             print(f"query {q}: invalid position ({e})")
-    if logger is not None:
-        logger.close()
+
+
+#: Serving subcommands dispatched ahead of the flat solve parser. A game
+#: spec can never collide: specs are lowercase single tokens already taken
+#: by the registry, and module paths contain a '.' or '/'.
+_DB_COMMANDS = ("export-db", "serve", "query")
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in _DB_COMMANDS:
+        return _db_main(argv)
     args = build_parser().parse_args(argv)
     # Capacity flags are CLI spellings of the env knobs the engines read at
     # construction; set them before any solver is built, and restore on
@@ -307,18 +327,19 @@ def _main(args) -> int:
         )
     t0 = time.perf_counter()
 
+    logger = _build_logger(args)
+    # Loggers are context managers: the JSONL handle closes even when a
+    # solve aborts mid-level (partial metrics beat a lost buffered tail).
+    with _logger_scope(logger):
+        return _solve_main(args, t0, logger)
+
+
+def _solve_main(args, t0: float, logger) -> int:
     import pathlib
 
     from gamesmanmpi_tpu.core.values import value_name
-    from gamesmanmpi_tpu.utils.metrics import JsonlLogger, StdoutLogger, TeeLogger
     from gamesmanmpi_tpu.utils.profiling import maybe_profile
 
-    logger = None
-    if args.jsonl or args.verbose:
-        logger = TeeLogger(
-            JsonlLogger(args.jsonl) if args.jsonl else None,
-            StdoutLogger() if args.verbose else None,
-        )
     checkpointer = None
     if args.checkpoint_dir:
         from gamesmanmpi_tpu.utils.checkpoint import LevelCheckpointer
@@ -378,8 +399,7 @@ def _main(args) -> int:
                     checkpointer=checkpointer,
                     store_tables=not args.no_tables,
                 )
-            _report(result, args.devices, time.perf_counter() - t0, args,
-                    logger)
+            _report(result, args.devices, time.perf_counter() - t0, args)
             return 0
         else:
             with maybe_profile(args.profile_dir):
@@ -418,7 +438,6 @@ def _main(args) -> int:
                         "secs_total": elapsed,
                     }
                 )
-                logger.close()
             return 0
     else:
         from gamesmanmpi_tpu.games import get_game
@@ -541,8 +560,253 @@ def _main(args) -> int:
         )
     with maybe_profile(args.profile_dir):
         result = solver.solve()
-    _report(result, args.devices, time.perf_counter() - t0, args, logger)
+    _report(result, args.devices, time.perf_counter() - t0, args)
     return 0
+
+
+# --------------------------------------------------------------- serving CLI
+
+
+def _db_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="gamesman-db",
+        description="Solved-position database: export, serve, query "
+        "(docs/SERVING.md).",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pe = sub.add_parser(
+        "export-db",
+        help="build an immutable DB from a fresh solve or a checkpoint dir",
+    )
+    pe.add_argument("game", help="built-in game spec (registry specs only — "
+                    "the DB manifest must be able to reconstruct the game)")
+    pe.add_argument("--out", required=True, help="DB output directory")
+    pe.add_argument(
+        "--from-checkpoint",
+        default=None,
+        metavar="DIR",
+        help="convert an existing --checkpoint-dir instead of re-solving "
+        "(classic-engine checkpoints, global or sharded)",
+    )
+    pe.add_argument("--overwrite", action="store_true",
+                    help="replace an existing DB in --out")
+    pe.add_argument("--jsonl", default=None,
+                    help="write per-level export metrics to this JSONL file")
+    pe.add_argument("-v", "--verbose", action="store_true",
+                    help="print per-level progress to stderr")
+
+    ps = sub.add_parser(
+        "serve", help="serve POST /query, GET /healthz, GET /metrics"
+    )
+    ps.add_argument("db", help="DB directory (from export-db)")
+    ps.add_argument("--host", default="127.0.0.1")
+    ps.add_argument("--port", type=int, default=8947,
+                    help="0 = ephemeral (the bound port is printed)")
+    ps.add_argument(
+        "--batch-window-ms",
+        type=float,
+        default=2.0,
+        help="micro-batch coalescing window: concurrent requests arriving "
+        "within it flush as ONE vectorized DB probe",
+    )
+    ps.add_argument("--cache-size", type=int, default=65536,
+                    help="LRU hot-position cache entries (0 disables)")
+    ps.add_argument("--jsonl", default=None,
+                    help="write per-batch serving metrics to this JSONL file")
+    ps.add_argument("-v", "--verbose", action="store_true")
+
+    pq = sub.add_parser("query", help="probe a DB offline (no server)")
+    pq.add_argument("db", help="DB directory (from export-db)")
+    pq.add_argument("positions", nargs="+",
+                    help="packed positions, decimal or 0x-hex")
+    return p
+
+
+def _build_logger(args):
+    """The --jsonl/--verbose TeeLogger every command shares (solve path
+    and serving subcommands build it identically; one place to wire a
+    new sink). None when neither flag is set."""
+    from gamesmanmpi_tpu.utils.metrics import JsonlLogger, StdoutLogger, TeeLogger
+
+    if not (args.jsonl or args.verbose):
+        return None
+    return TeeLogger(
+        JsonlLogger(args.jsonl) if args.jsonl else None,
+        StdoutLogger() if args.verbose else None,
+    )
+
+
+def _logger_scope(logger):
+    """Context that closes `logger` on exit (loggers are context
+    managers), or a no-op when logging is off."""
+    import contextlib
+
+    return logger if logger is not None else contextlib.nullcontext()
+
+
+def _cmd_export_db(args) -> int:
+    from gamesmanmpi_tpu.db import DbFormatError, DbWriter, export_checkpoint
+    from gamesmanmpi_tpu.games import get_game
+
+    try:
+        game = get_game(args.game)
+    except (KeyError, ValueError) as e:
+        print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
+        return 2
+    t0 = time.time()
+    logger = _build_logger(args)
+    with _logger_scope(logger):
+        try:
+            if args.from_checkpoint:
+                import pathlib
+
+                from gamesmanmpi_tpu.utils.checkpoint import LevelCheckpointer
+
+                if not pathlib.Path(args.from_checkpoint).is_dir():
+                    # Check BEFORE LevelCheckpointer: its constructor
+                    # mkdirs, so a typo'd path would be created on disk
+                    # and misreported as "no completed levels".
+                    print(
+                        f"error: no such checkpoint directory: "
+                        f"{args.from_checkpoint}",
+                        file=sys.stderr,
+                    )
+                    return 2
+                manifest = export_checkpoint(
+                    LevelCheckpointer(args.from_checkpoint),
+                    game,
+                    args.game,
+                    args.out,
+                    overwrite=args.overwrite,
+                    logger=logger,
+                )
+            else:
+                # Fresh solve, streamed: each level flows into the writer as
+                # the backward pass resolves it (level_sink), so the export
+                # never holds the full table in host memory.
+                from gamesmanmpi_tpu.solve import Solver
+
+                writer = DbWriter(
+                    args.out, game, args.game, overwrite=args.overwrite
+                )
+                try:
+                    Solver(
+                        game,
+                        logger=logger,
+                        store_tables=False,
+                        level_sink=writer.add_level_table,
+                    ).solve()
+                    manifest = writer.finalize()
+                except BaseException:  # incl. Ctrl-C mid-solve: the old
+                    writer.abort()     # DB keeps serving, staging is gone
+                    raise
+        except (DbFormatError, FileNotFoundError) as e:
+            # FileNotFoundError: a torn checkpoint (manifest-listed shard
+            # file deleted) — a usage-visible input problem, not a crash.
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    print(f"database written: {args.out}")
+    print(f"game: {manifest['game']}")
+    print(f"levels: {len(manifest['levels'])}")
+    print(f"positions: {manifest['num_positions']}")
+    print(f"elapsed: {time.time() - t0:.3f}s")
+    return 0
+
+
+def _cmd_serve(args) -> int:
+    from gamesmanmpi_tpu.db import DbFormatError, DbReader
+    from gamesmanmpi_tpu.serve import QueryServer
+
+    try:
+        reader = DbReader(args.db)
+    except DbFormatError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    logger = _build_logger(args)
+    with _logger_scope(logger):
+        try:
+            server = QueryServer(
+                reader,
+                host=args.host,
+                port=args.port,
+                window=args.batch_window_ms / 1e3,
+                cache_size=args.cache_size,
+                logger=logger,
+            )
+        except OSError as e:  # port in use / unbindable host
+            print(
+                f"error: cannot bind {args.host}:{args.port} ({e})",
+                file=sys.stderr,
+            )
+            return 2
+        print(
+            f"serving {reader.game.name} ({reader.num_positions} positions) "
+            f"on http://{args.host}:{server.port} "
+            f"(POST /query, GET /healthz, GET /metrics)"
+        )
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.stop()
+    return 0
+
+
+def _cmd_query(args) -> int:
+    from gamesmanmpi_tpu.core.values import value_name
+    from gamesmanmpi_tpu.db import DbFormatError, DbReader
+
+    try:
+        reader = DbReader(args.db)
+    except DbFormatError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    from gamesmanmpi_tpu.db.format import parse_position
+
+    states = []
+    order = []  # (query string, packed state or None)
+    for q in args.positions:
+        try:
+            state = parse_position(reader.game, q)
+            order.append((q, len(states)))
+            states.append(state)
+        except ValueError as e:
+            order.append((q, None))
+            print(f"query {q}: invalid position ({e})")
+    if states:
+        values, rem, found, best = reader.lookup_best(states)
+        sentinel = int(reader.game.sentinel)
+        for q, i in order:
+            if i is None:
+                continue
+            if not found[i]:
+                print(f"query {q}: not in database")
+                continue
+            line = (
+                f"query {q}: value={value_name(values[i])} "
+                f"remoteness={int(rem[i])}"
+            )
+            if int(best[i]) != sentinel:
+                line += f" best={hex(int(best[i]))}"
+            print(line)
+    return 0
+
+
+def _db_main(argv) -> int:
+    from gamesmanmpi_tpu.utils.platform import apply_platform_env
+
+    args = _db_parser().parse_args(argv)
+    # Same platform policy as the solve path: honor GAMESMAN_PLATFORM
+    # before the first backend touch (serving wants the CPU backend — the
+    # reader's canonicalize kernels are host-side by design).
+    apply_platform_env(default_fake_devices=1)
+    if args.cmd == "export-db":
+        return _cmd_export_db(args)
+    if args.cmd == "serve":
+        return _cmd_serve(args)
+    return _cmd_query(args)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,31 @@
+"""Host-side sorted-table probe: the one canonicalize→probe search.
+
+The NumPy twin of ops/lookup.py's sorted-level search, shared by every
+host query route — the solved-position DB reader (db/reader.py),
+in-process point queries (solve/engine.SolveResult.lookup), and
+checkpoint point queries (utils/checkpoint.py). It lives in core/ because
+it depends only on numpy and everything above it probes through it; the
+db package re-exports it (db/format.py) as part of the DB format's API.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def probe_sorted_np(keys: np.ndarray, queries: np.ndarray):
+    """Vectorized binary search of canonical queries in one sorted level.
+
+    keys: [N] sorted strictly-ascending states (no sentinel entries —
+    DbWriter enforces that, unlike the device tables in ops/lookup.py
+    which carry sentinel tails). queries: [K] same dtype.
+    Returns (idx [K] int64 clipped in-range, hit [K] bool).
+    """
+    queries = np.asarray(queries)
+    n = int(np.asarray(keys).shape[0])
+    if n == 0:
+        shape = queries.shape
+        return np.zeros(shape, dtype=np.int64), np.zeros(shape, dtype=bool)
+    idx = np.minimum(np.searchsorted(keys, queries), n - 1).astype(np.int64)
+    hit = np.asarray(keys[idx]) == queries
+    return idx, hit

@@ -1,0 +1,50 @@
+"""db: the persistent, immutable solved-position database.
+
+The missing half of "a solve is only useful as a queryable database"
+(PAPERS.md: Pentago's served lookup DB): per-level shards of (sorted
+canonical keys, packed value+remoteness cells via core/codec), a JSON
+manifest with per-shard checksums, a strict writer fed from a live solve
+(engine level_sink hook) or an existing checkpoint directory, and a
+mmap-backed reader whose batched lookup canonicalizes through the game's
+symmetry before probing. Served over HTTP by gamesmanmpi_tpu.serve.
+
+Reader/writer are loaded lazily (PEP 562): they pull in JAX (the reader
+builds canonicalize kernels; the writer packs cells), while the
+format helpers and the integrity checker deliberately do not — so
+`tools/check_db.py` validates a DB in seconds without paying backend
+bring-up, even where that is expensive (see check.py's docstring).
+"""
+
+from gamesmanmpi_tpu.db.check import check_db
+from gamesmanmpi_tpu.db.format import (
+    DbFormatError,
+    parse_position,
+    probe_sorted_np,
+)
+
+_LAZY = {
+    "DbReader": "gamesmanmpi_tpu.db.reader",
+    "DbWriter": "gamesmanmpi_tpu.db.writer",
+    "export_checkpoint": "gamesmanmpi_tpu.db.writer",
+    "export_result": "gamesmanmpi_tpu.db.writer",
+}
+
+__all__ = [
+    "DbFormatError",
+    "DbReader",
+    "DbWriter",
+    "check_db",
+    "export_checkpoint",
+    "export_result",
+    "parse_position",
+    "probe_sorted_np",
+]
+
+
+def __getattr__(name):
+    module = _LAZY.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(module), name)

@@ -1,0 +1,106 @@
+"""Database integrity validation (CI-runnable, see tools/check_db.py).
+
+Structural checks only — no game construction, no kernels, no backend
+initialization (the package root's `import jax` runs, but nothing here
+touches a device) — so the checker runs in seconds even where backend
+bring-up is expensive or wedged, and a corrupted DB is caught before a
+serving process ever mmaps it:
+
+* manifest parses, format/version/fields are right (db/format.read_manifest)
+* every level's shard files exist and match their sha256 checksums
+* keys are strictly ascending (sorted + unique, the probe's contract),
+  hold no padding sentinel, and match the manifest dtype and count
+* cells are uint32, parallel to the keys, and every cell decodes to a
+  DECIDED value (an UNDECIDED cell in a solved DB is a solver bug —
+  lookups would report found-but-valueless)
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+import numpy as np
+
+from gamesmanmpi_tpu.core.bitops import sentinel_for
+from gamesmanmpi_tpu.core.codec import unpack_cells_np
+from gamesmanmpi_tpu.core.values import UNDECIDED
+from gamesmanmpi_tpu.db.format import (
+    DbFormatError,
+    file_sha256,
+    read_manifest,
+)
+
+
+def check_db(directory, verbose=None) -> list[str]:
+    """Validate one DB directory; returns a list of problems (empty = OK).
+
+    verbose: optional callable taking one progress line per level.
+    """
+    directory = pathlib.Path(directory)
+    problems: list[str] = []
+    try:
+        manifest = read_manifest(directory)
+    except DbFormatError as e:
+        return [str(e)]
+    try:
+        dt = np.dtype(manifest["state_dtype"])
+        sentinel = sentinel_for(dt)
+    except TypeError as e:
+        return [f"manifest state_dtype: {e}"]
+    total = 0
+    for key in sorted(manifest["levels"], key=int):
+        rec = manifest["levels"][key]
+        tag = f"level {key}"
+        ok = True
+        for kind in ("keys", "cells"):
+            path = directory / rec[kind]
+            if not path.exists():
+                problems.append(f"{tag}: missing file {rec[kind]}")
+                ok = False
+                continue
+            digest = file_sha256(path)
+            if digest != rec[f"{kind}_sha256"]:
+                problems.append(
+                    f"{tag}: {kind} checksum mismatch "
+                    f"({digest[:12]}… != {rec[f'{kind}_sha256'][:12]}…)"
+                )
+                ok = False
+        if not ok:
+            continue
+        keys = np.load(directory / rec["keys"], mmap_mode="r")
+        cells = np.load(directory / rec["cells"], mmap_mode="r")
+        if keys.dtype != dt:
+            problems.append(
+                f"{tag}: keys dtype {keys.dtype}, manifest says {dt}"
+            )
+            continue
+        if keys.shape[0] != rec["count"]:
+            problems.append(
+                f"{tag}: {keys.shape[0]} keys, manifest says {rec['count']}"
+            )
+        if cells.dtype != np.uint32 or cells.shape != keys.shape:
+            problems.append(
+                f"{tag}: cells are {cells.dtype}{list(cells.shape)}, "
+                f"expected uint32[{keys.shape[0]}]"
+            )
+            continue
+        if keys.shape[0]:
+            if not np.all(keys[1:] > keys[:-1]):
+                problems.append(f"{tag}: keys not strictly ascending")
+            if keys[-1] == sentinel:
+                problems.append(f"{tag}: keys contain the padding sentinel")
+        # Decode through the one codec (not a private mask copy): a cell
+        # layout change must not silently let the gate validate old bits.
+        cell_values, _ = unpack_cells_np(np.asarray(cells))
+        undecided = int(np.count_nonzero(cell_values == UNDECIDED))
+        if undecided:
+            problems.append(f"{tag}: {undecided} UNDECIDED cells")
+        total += int(keys.shape[0])
+        if verbose is not None:
+            verbose(f"{tag}: {keys.shape[0]} positions OK")
+    declared = manifest.get("num_positions")
+    if declared is not None and declared != total:
+        problems.append(
+            f"manifest num_positions {declared} != shard total {total}"
+        )
+    return problems

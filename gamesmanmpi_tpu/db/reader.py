@@ -1,0 +1,284 @@
+"""DbReader: mmap-backed vectorized lookup into a solved-position DB.
+
+The read side of db/format.py: open the manifest, reconstruct the game
+from its registry spec, memory-map each level's (keys, cells) pair
+lazily, and answer batches of raw positions with (value, remoteness).
+Queries are canonicalized through the game's symmetry before probing —
+exactly the contract of SolveResult.lookup — so a sym=1 database answers
+for every member of a stored class. The per-level search is the same
+searchsorted-confirm shape as ops/lookup.py, in its NumPy form
+(db/format.probe_sorted_np): on host, against a memory-mapped level, a
+binary search touches O(log n) pages, which is what makes a multi-GB
+level servable from disk without loading it.
+
+Canonicalize + level_of run as one batched kernel on the host CPU
+backend (same policy as solve/engine.canonical_scalar: a query batch
+gains nothing from the accelerator, and on the relay every accelerator
+compile costs ~15 s), padded to power-of-two buckets so the serving
+process compiles O(log max-batch) programs, not one per batch size.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gamesmanmpi_tpu.core.codec import unpack_cells_np
+from gamesmanmpi_tpu.core.values import LOSE, TIE, UNDECIDED, WIN
+from gamesmanmpi_tpu.db.format import (
+    DbFormatError,
+    probe_sorted_np,
+    read_manifest,
+)
+from gamesmanmpi_tpu.ops.padding import bucket_size, pad_to
+from gamesmanmpi_tpu.solve.engine import get_kernel, undecided_mask
+
+# Smallest query-kernel capacity: batches are tiny next to frontiers, and
+# every distinct capacity is a compiled program.
+_MIN_QUERY_BUCKET = 256
+
+
+def _canon_builder(game):
+    def f(states):
+        c = game.canonicalize(states)
+        return c, game.level_of(c)
+
+    return f
+
+
+def _expand_builder(game):
+    # Expands the RAW queried positions and returns both the raw children
+    # (the legal moves of the position the client actually holds — a
+    # sym=1 best-move answer must be playable from it, not from its class
+    # representative) and their canonical twins for probing; value and
+    # remoteness are sym-invariant, so the canonical probe scores the raw
+    # move exactly. Children of padding/terminal lanes become sentinel, so
+    # a junk lane can never accidentally probe a real state. Child levels
+    # come out of the same program — no second canonicalize/level pass
+    # over the k*max_moves expansion set (the biggest serving kernel).
+    def f(states):
+        children, mask = game.expand(states)
+        mask = mask & undecided_mask(game, states)[:, None]
+        raw = jnp.where(mask, children, game.sentinel)
+        canon = jnp.where(mask, game.canonicalize(children), game.sentinel)
+        return raw, canon, mask, game.level_of(canon.reshape(-1))
+
+    return f
+
+
+class DbReader:
+    """Read-only handle on a finalized solved-position database."""
+
+    def __init__(self, directory, game=None, *, verify: bool = False):
+        self.dir = pathlib.Path(directory)
+        self.manifest = read_manifest(self.dir)
+        if game is None:
+            from gamesmanmpi_tpu.games import get_game
+
+            try:
+                game = get_game(self.manifest["spec"])
+            except (KeyError, ValueError) as e:
+                raise DbFormatError(
+                    f"{self.dir}: manifest spec "
+                    f"{self.manifest['spec']!r} is not constructible: {e}"
+                )
+        if game.name != self.manifest["game"]:
+            raise DbFormatError(
+                f"{self.dir} belongs to game {self.manifest['game']!r}, "
+                f"not {game.name!r}"
+            )
+        if np.dtype(game.state_dtype).name != self.manifest["state_dtype"]:
+            raise DbFormatError(
+                f"{self.dir}: state dtype {self.manifest['state_dtype']} "
+                f"!= game's {np.dtype(game.state_dtype).name}"
+            )
+        self.game = game
+        self._levels = {
+            int(k): rec for k, rec in self.manifest["levels"].items()
+        }
+        self._arrays: dict = {}
+        if verify:
+            from gamesmanmpi_tpu.db.check import check_db
+
+            problems = check_db(self.dir)
+            if problems:
+                raise DbFormatError(
+                    f"{self.dir}: integrity check failed: {problems[0]}"
+                    + (f" (+{len(problems) - 1} more)"
+                       if len(problems) > 1 else "")
+                )
+
+    # ------------------------------------------------------------- plumbing
+
+    @property
+    def num_positions(self) -> int:
+        return int(self.manifest.get(
+            "num_positions",
+            sum(rec["count"] for rec in self._levels.values()),
+        ))
+
+    @property
+    def levels(self) -> list[int]:
+        return sorted(self._levels)
+
+    def _level_arrays(self, level: int):
+        """(keys, cells) of one level, memory-mapped on first touch."""
+        pair = self._arrays.get(level)
+        if pair is None:
+            rec = self._levels[level]
+            keys = np.load(self.dir / rec["keys"], mmap_mode="r")
+            cells = np.load(self.dir / rec["cells"], mmap_mode="r")
+            pair = self._arrays[level] = (keys, cells)
+        return pair
+
+    def close(self) -> None:
+        """Drop the mmaps (they also die with the reader)."""
+        self._arrays.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def _cpu_kernel(self, kind: str, cap: int, builder, arg):
+        """Run a cached batched kernel on the host CPU backend."""
+        try:
+            cpu = jax.local_devices(backend="cpu")[0]
+        except RuntimeError:
+            cpu = None
+        if cpu is not None:
+            with jax.default_device(cpu):
+                fn = get_kernel(self.game, f"{kind}_cpu", cap, builder)
+                return fn(jnp.asarray(arg))
+        fn = get_kernel(self.game, kind, cap, builder)
+        return fn(jnp.asarray(arg))
+
+    def _canon_levels(self, q: np.ndarray):
+        """Batched canonicalize + level_of: [K] -> (canon [K], levels [K])."""
+        cap = bucket_size(q.shape[0], _MIN_QUERY_BUCKET)
+        c, lv = self._cpu_kernel(
+            "dbcanon", cap, _canon_builder, pad_to(q, cap)
+        )
+        n = q.shape[0]
+        return (
+            np.asarray(c)[:n].astype(self.game.state_dtype),
+            np.asarray(lv)[:n],
+        )
+
+    # -------------------------------------------------------------- queries
+
+    def lookup(self, queries) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Batched probe: raw positions -> (values, remoteness, found).
+
+        queries: array-like of packed positions (any symmetry-class
+        member). Returns (values [K] uint8 — UNDECIDED on miss,
+        remoteness [K] int32 — 0 on miss, found [K] bool). One
+        searchsorted per distinct level present in the batch.
+        """
+        q = np.ascontiguousarray(
+            np.asarray(queries, dtype=self.game.state_dtype)
+        )
+        if q.shape[0] == 0:
+            return (
+                np.zeros(0, dtype=np.uint8),
+                np.zeros(0, dtype=np.int32),
+                np.zeros(0, dtype=bool),
+            )
+        return self._probe(*self._canon_levels(q))
+
+    def _probe(self, canon: np.ndarray, levels: np.ndarray):
+        """Probe ALREADY-CANONICAL states with known levels (the second
+        half of lookup; split out so lookup_best canonicalizes a batch
+        once and reuses it for both the probe and the expansion)."""
+        k = canon.shape[0]
+        values = np.full(k, UNDECIDED, dtype=np.uint8)
+        remoteness = np.zeros(k, dtype=np.int32)
+        found = np.zeros(k, dtype=bool)
+        real = canon != self.game.sentinel
+        for lv in np.unique(levels[real]):
+            rec = self._levels.get(int(lv))
+            if rec is None:
+                continue
+            keys, cells = self._level_arrays(int(lv))
+            sel = np.nonzero(real & (levels == lv))[0]
+            idx, hit = probe_sorted_np(keys, canon[sel])
+            hsel = sel[hit]
+            if hsel.size:
+                v, r = unpack_cells_np(np.asarray(cells[idx[hit]]))
+                values[hsel] = v
+                remoteness[hsel] = r
+                found[hsel] = True
+        return values, remoteness, found
+
+    def lookup_best(self, queries):
+        """lookup + the optimal child of each decided, non-terminal query.
+
+        Returns (values, remoteness, found, best [K] state_dtype) where
+        best is a packed child of the QUERIED position — a legal move the
+        client can actually play, even against a sym=1 database —
+        realizing the parent's value (WIN -> a LOSE child of minimum
+        remoteness; LOSE -> a WIN child of maximum remoteness, delaying;
+        TIE -> a TIE child of maximum remoteness), or the sentinel when
+        there is no move (terminal positions, misses). Children are scored
+        through their canonical twins in the same probe path.
+        """
+        q = np.ascontiguousarray(
+            np.asarray(queries, dtype=self.game.state_dtype)
+        )
+        k = q.shape[0]
+        sentinel = self.game.sentinel
+        best = np.full(k, sentinel, dtype=self.game.state_dtype)
+        if k == 0:
+            return (
+                np.zeros(0, dtype=np.uint8),
+                np.zeros(0, dtype=np.int32),
+                np.zeros(0, dtype=bool),
+                best,
+            )
+        values, remoteness, found = self._probe(*self._canon_levels(q))
+        if not found.any():
+            return values, remoteness, found, best
+        cap = bucket_size(k, _MIN_QUERY_BUCKET)
+        # Expand the RAW queries (see _expand_builder: best must be a legal
+        # move of the queried position, while the probe goes through the
+        # canonical twins — value/remoteness are sym-invariant).
+        raw_children, canon_children, mask, clevels = self._cpu_kernel(
+            "dbexpand", cap, _expand_builder, pad_to(q, cap)
+        )
+        m = raw_children.shape[1]
+        children = np.asarray(raw_children).reshape(-1)[: k * m].reshape(k, m)
+        mask = np.asarray(mask)[:k]
+        cv, cr, cfound = self._probe(
+            np.asarray(canon_children)
+            .reshape(-1)[: k * m]
+            .astype(self.game.state_dtype),
+            np.asarray(clevels)[: k * m],
+        )
+        cv = cv.reshape(k, m)
+        cr = cr.reshape(k, m)
+        cand_ok = mask & cfound.reshape(k, m)
+        big = np.int64(1) << 40  # past any packable remoteness
+        for want, prefer_min in ((WIN, True), (LOSE, False), (TIE, False)):
+            rows = found & (values == want) & cand_ok.any(axis=1)
+            if not rows.any():
+                continue
+            # WIN wants a LOSE child; LOSE has only WIN children; TIE wants
+            # a TIE child (combine_host, solve/oracle.py).
+            child_want = {WIN: LOSE, LOSE: WIN, TIE: TIE}[want]
+            cand = cand_ok & (cv == child_want)
+            rows &= cand.any(axis=1)
+            if not rows.any():
+                continue
+            score = np.where(
+                cand, cr.astype(np.int64), big if prefer_min else -big
+            )
+            pick = (
+                score.argmin(axis=1) if prefer_min else score.argmax(axis=1)
+            )
+            best[rows] = children[np.arange(k), pick][rows]
+        return values, remoteness, found, best

@@ -1,0 +1,263 @@
+"""DbWriter: build an immutable solved-position database.
+
+Two feeds, one format (db/format.py):
+
+* **Live solve** — `Solver(game, level_sink=writer.add_level_table,
+  store_tables=False)` streams each resolved level into the writer the
+  moment the backward pass finishes it, so an export never holds more
+  than one level in host memory (the big-run contract).
+* **Existing checkpoint** — `export_checkpoint` converts a
+  `--checkpoint-dir` produced by any BFS engine (global per-level files
+  or per-(level, shard) sets; `load_level` assembles + sorts the shards)
+  so past solves become servable without re-solving.
+
+The writer is strict where the reader is fast: keys must be strictly
+ascending (sorted AND unique — the probe's contract), must not contain
+the padding sentinel, and remoteness must fit the 30-bit cell field
+un-clipped (a clipped remoteness would round-trip as the wrong answer;
+better to refuse the export).
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+
+import numpy as np
+
+from gamesmanmpi_tpu.core.bitops import sentinel_for
+from gamesmanmpi_tpu.core.codec import pack_cells_np
+from gamesmanmpi_tpu.core.values import MAX_REMOTENESS
+from gamesmanmpi_tpu.db.format import (
+    FORMAT_NAME,
+    FORMAT_VERSION,
+    DbFormatError,
+    level_cell_name,
+    level_key_name,
+    save_npy_hashed,
+    write_manifest,
+)
+
+
+class DbWriter:
+    """Writes per-level shards, then seals the DB with a manifest.
+
+    The manifest lands last (atomically): a crash mid-export leaves a
+    directory the reader refuses, never a torn database.
+    """
+
+    def __init__(self, directory, game, spec: str, *,
+                 overwrite: bool = False):
+        self.final_dir = pathlib.Path(directory)
+        self.dir = self.final_dir
+        if (self.final_dir / "manifest.json").exists():
+            if not overwrite:
+                raise DbFormatError(
+                    f"{self.final_dir} already holds a finalized database "
+                    "(pass overwrite=True to replace it)"
+                )
+            # Re-exports STAGE into a sibling directory and swap at
+            # finalize: the export behind --overwrite may be an hours-long
+            # solve, and a crash mid-way must leave the old database
+            # serving, not a destroyed directory. The swap (rmtree + rename
+            # at finalize) is the only window where neither DB exists, and
+            # it is milliseconds, not the solve. The staging name is FIXED
+            # (no pid): a rerun after a crash reclaims the leftover
+            # instead of stranding one multi-GB orphan per attempt —
+            # concurrent exports into one --out were never coherent anyway
+            # (they would race the swap itself).
+            import shutil
+
+            self.dir = self.final_dir.with_name(
+                f"{self.final_dir.name}.staging"
+            )
+            if self.dir.exists():
+                shutil.rmtree(self.dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.game = game
+        self.spec = spec
+        self._levels: dict = {}
+        self._finalized = False
+
+    def add_level(self, level: int, states, values=None, remoteness=None,
+                  *, cells=None) -> None:
+        """Write one level's (sorted states, packed cells) shard pair.
+
+        Pass values+remoteness (packed here via pack_cells_np) or
+        pre-packed cells. Validates the probe invariants at write time —
+        a served wrong answer is far costlier than a failed export.
+        """
+        if self._finalized:
+            raise DbFormatError("database already finalized")
+        level = int(level)
+        if level in self._levels:
+            raise DbFormatError(f"level {level} written twice")
+        states = np.asarray(states)
+        dt = np.dtype(self.game.state_dtype)
+        if states.dtype != dt:
+            raise DbFormatError(
+                f"level {level}: keys dtype {states.dtype} != game state "
+                f"dtype {dt}"
+            )
+        if states.ndim != 1:
+            raise DbFormatError(f"level {level}: keys must be 1-D")
+        if states.shape[0] and states[-1] == sentinel_for(dt):
+            raise DbFormatError(
+                f"level {level}: keys contain the padding sentinel — "
+                "pass only real states"
+            )
+        if not np.all(states[1:] > states[:-1]):
+            raise DbFormatError(
+                f"level {level}: keys must be strictly ascending "
+                "(sorted and unique)"
+            )
+        if cells is None:
+            remoteness = np.asarray(remoteness)
+            if remoteness.size and (
+                int(remoteness.min()) < 0
+                or int(remoteness.max()) > MAX_REMOTENESS
+            ):
+                raise DbFormatError(
+                    f"level {level}: remoteness outside [0, "
+                    f"{MAX_REMOTENESS}] would not survive the cell packing"
+                )
+            cells = pack_cells_np(np.asarray(values), remoteness)
+        cells = np.asarray(cells, dtype=np.uint32)
+        if cells.shape != states.shape:
+            raise DbFormatError(
+                f"level {level}: {cells.shape[0]} cells for "
+                f"{states.shape[0]} keys"
+            )
+        keys_name = level_key_name(level)
+        cells_name = level_cell_name(level)
+        self._levels[level] = {
+            "count": int(states.shape[0]),
+            "keys": keys_name,
+            "cells": cells_name,
+            # One-pass save+hash: add_level runs synchronously inside the
+            # solver's backward loop (level_sink), so a post-save re-read
+            # would double export I/O per level.
+            "keys_sha256": save_npy_hashed(self.dir / keys_name, states),
+            "cells_sha256": save_npy_hashed(self.dir / cells_name, cells),
+        }
+
+    def add_level_table(self, level: int, table) -> None:
+        """Engine hook adapter: consumes a solve/engine.LevelTable."""
+        self.add_level(level, table.states, table.values, table.remoteness)
+
+    def abort(self) -> None:
+        """Discard an unfinalized export: removes the staging directory
+        (overwrite path) so a failed re-export leaves no orphan; a
+        fresh-directory export keeps its partial files (unreadable — no
+        manifest — and possibly useful for debugging)."""
+        if self._finalized or self.dir == self.final_dir:
+            return
+        import shutil
+
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+    def finalize(self, extra: dict | None = None) -> dict:
+        """Seal the DB: write the manifest (atomically, last). -> manifest."""
+        if not self._levels:
+            raise DbFormatError("no levels written — refusing an empty DB")
+        manifest = {
+            "format": FORMAT_NAME,
+            "version": FORMAT_VERSION,
+            "game": self.game.name,
+            "spec": self.spec,
+            "state_dtype": np.dtype(self.game.state_dtype).name,
+            "sym": bool(getattr(self.game, "sym", False)),
+            "num_positions": sum(
+                rec["count"] for rec in self._levels.values()
+            ),
+            "levels": {
+                str(k): self._levels[k] for k in sorted(self._levels)
+            },
+        }
+        if extra:
+            manifest.update(extra)
+        write_manifest(self.dir, manifest)
+        if self.dir != self.final_dir:
+            # Overwrite swap: the staged DB is complete (manifest and all),
+            # so replace the old directory wholesale.
+            import shutil
+
+            shutil.rmtree(self.final_dir)
+            os.rename(self.dir, self.final_dir)
+            self.dir = self.final_dir
+        self._finalized = True
+        return manifest
+
+
+def export_result(result, directory, spec: str, *,
+                  overwrite: bool = False) -> dict:
+    """One-shot export of an in-memory SolveResult's tables. -> manifest.
+
+    For memory-bounded exports of big solves, prefer the streaming hook:
+    Solver(game, level_sink=DbWriter(...).add_level_table,
+    store_tables=False) — see solve/engine.py.
+    """
+    writer = DbWriter(directory, result.game, spec, overwrite=overwrite)
+    try:
+        for level in sorted(result.levels):
+            writer.add_level_table(level, result.levels[level])
+        return writer.finalize()
+    except BaseException:  # incl. KeyboardInterrupt: drop the staging dir
+        writer.abort()
+        raise
+
+
+def export_checkpoint(checkpointer, game, spec: str, directory, *,
+                      overwrite: bool = False, logger=None) -> dict:
+    """Convert an existing --checkpoint-dir into a servable DB. -> manifest.
+
+    Consumes classic-engine checkpoints (global level files or sharded
+    sets — `load_level` assembles and sorts shards, so multi-host big-run
+    checkpoints convert without the solve ever assembling them). Dense
+    checkpoints are refused: their flat per-index cell arrays cover the
+    encodable superset, including fabricated classes the engine itself
+    refuses to answer for.
+    """
+    manifest = checkpointer.load_manifest()
+    if manifest.get("dense_levels"):
+        raise DbFormatError(
+            "dense checkpoint directories hold encodable-superset cells by "
+            "perfect index, not reachable sorted states — serve those via "
+            "the solver's --query path, or re-solve with the classic engine"
+        )
+    bound = manifest.get("game")
+    if bound is not None and bound != game.name:
+        raise DbFormatError(
+            f"checkpoint directory belongs to game {bound!r}, not "
+            f"{game.name!r}"
+        )
+    levels = checkpointer.completed_levels()
+    if not levels:
+        raise DbFormatError(
+            f"{checkpointer.dir}: no completed levels to convert"
+        )
+    if levels != list(range(min(levels), max(levels) + 1)):
+        import sys
+
+        print(
+            f"warning: checkpoint levels {levels} are not contiguous — "
+            "the DB will answer 'not found' for the gaps",
+            file=sys.stderr,
+        )
+    writer = DbWriter(directory, game, spec, overwrite=overwrite)
+    try:
+        for level in levels:
+            table = checkpointer.load_level(level)
+            writer.add_level_table(level, table)
+            if logger is not None:
+                logger.log(
+                    {
+                        "phase": "export_db",
+                        "level": level,
+                        "n": int(table.states.shape[0]),
+                    }
+                )
+        return writer.finalize()
+    except BaseException:  # incl. KeyboardInterrupt: drop the staging dir
+        writer.abort()
+        raise

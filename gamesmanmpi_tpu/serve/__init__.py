@@ -1,0 +1,12 @@
+"""serve: batched HTTP query serving over a solved-position database.
+
+The traffic-facing half of the ROADMAP north star: `db/` makes a solve
+persistent, this package makes it servable — a stdlib ThreadingHTTPServer
+whose concurrent requests coalesce through a micro-batching queue (with
+an LRU hot-position cache) into single vectorized DbReader probes.
+"""
+
+from gamesmanmpi_tpu.serve.batcher import Batcher
+from gamesmanmpi_tpu.serve.server import QueryServer
+
+__all__ = ["Batcher", "QueryServer"]

@@ -1,0 +1,248 @@
+"""Coalescing micro-batcher + LRU hot-position cache for query serving.
+
+The DbReader's probe is vectorized: one searchsorted over a whole batch
+costs barely more than over one key, and the canonicalize kernel is a
+fixed-capacity program either way. So the server never probes per
+request — concurrent requests park in a queue for a short coalescing
+window (default 2 ms) and flush as ONE `DbReader.lookup_best` call. The
+same shape as ML inference micro-batching, and the serving twin of the
+engine's own design rule (bulk kernels, never per-position work).
+
+In front of the batch sits an LRU cache keyed on the raw queried
+position: real traffic is Zipf-ish (openings and famous positions
+repeat), and a cache hit answers without touching the batcher at all.
+Raw — not canonical — keys mean symmetric duplicates occupy separate
+entries; that costs cache capacity, never correctness, and avoids paying
+a canonicalize kernel call before the cache.
+
+Counters are plain ints mutated under the one lock and snapshotted by
+`metrics()`; per-batch records go to the shared utils/metrics JSONL
+logger so serving latency lands in the same stream as solve phases.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+
+class BatcherClosed(RuntimeError):
+    """submit() after close(): the one *transient* failure (server
+    shutdown). A distinct type so the HTTP layer can answer 503 here and
+    500 for real reader faults — jaxlib's runtime errors subclass
+    RuntimeError, so matching on RuntimeError would misclassify a broken
+    DB as a recovering server."""
+
+
+class _Request:
+    """One submitter's slice of a coalesced batch."""
+
+    __slots__ = ("states", "event", "out", "error")
+
+    def __init__(self, states: np.ndarray):
+        self.states = states
+        self.event = threading.Event()
+        self.out = None
+        self.error = None
+
+
+class Batcher:
+    """Thread-safe coalescing front-end over one DbReader.
+
+    submit() blocks its calling thread until the worker flushes the
+    window's batch; results come back per position as
+    (value, remoteness, found, best) tuples of Python scalars.
+    """
+
+    def __init__(self, reader, *, window: float = 0.002,
+                 cache_size: int = 65536, max_batch: int = 1 << 16,
+                 logger=None):
+        self.reader = reader
+        self.window = float(window)
+        #: Flush threshold: a burst larger than this splits into several
+        #: probes instead of one giant one — an unbounded coalesce would
+        #: pad to a huge (possibly freshly-compiled) kernel capacity and
+        #: stall every parked request behind a single oversized batch.
+        self.max_batch = int(max_batch)
+        self.logger = logger
+        self._cache: OrderedDict = OrderedDict()
+        # Clamp: a negative size (the conventional "unlimited" spelling
+        # elsewhere) would make the eviction loop pop an empty dict.
+        self._cache_size = max(0, int(cache_size))
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: list[_Request] = []
+        self._closed = False
+        self.counters = {
+            "requests": 0,
+            "queries": 0,
+            "cache_hits": 0,
+            "cache_misses": 0,
+            "batches": 0,
+            "batched_queries": 0,
+            "max_batch_size": 0,
+            "batch_secs_total": 0.0,
+        }
+        self._worker = threading.Thread(
+            target=self._loop, name="gamesman-batcher", daemon=True
+        )
+        self._worker.start()
+
+    # ------------------------------------------------------------ client API
+
+    def submit(self, positions) -> list[tuple[int, int, bool, int | None]]:
+        """Resolve a request's positions; blocks until the batch flushes.
+
+        positions: iterable of ints (already range-validated by the
+        caller). Returns one (value, remoteness, found, best_or_None)
+        tuple per position, in order.
+        """
+        positions = [int(p) for p in positions]
+        results: list = [None] * len(positions)
+        miss_idx: list[int] = []
+        miss_pos: list[int] = []
+        with self._lock:
+            if self._closed:
+                raise BatcherClosed("batcher is closed")
+            self.counters["requests"] += 1
+            self.counters["queries"] += len(positions)
+            for i, p in enumerate(positions):
+                hit = self._cache.get(p)
+                if hit is not None:
+                    self._cache.move_to_end(p)
+                    self.counters["cache_hits"] += 1
+                    results[i] = hit
+                else:
+                    self.counters["cache_misses"] += 1
+                    miss_idx.append(i)
+                    miss_pos.append(p)
+        if not miss_idx:
+            return results
+        req = _Request(
+            np.asarray(miss_pos, dtype=self.reader.game.state_dtype)
+        )
+        with self._cond:
+            if self._closed:  # close() may have landed since the cache pass
+                raise BatcherClosed("batcher is closed")
+            self._pending.append(req)
+            self._cond.notify_all()
+        req.event.wait()
+        if req.error is not None:
+            raise req.error
+        with self._lock:
+            for j, i in enumerate(miss_idx):
+                results[i] = req.out[j]
+                self._cache[miss_pos[j]] = req.out[j]
+                self._cache.move_to_end(miss_pos[j])
+            while len(self._cache) > self._cache_size:
+                self._cache.popitem(last=False)
+        return results
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._worker.join(timeout=5)
+
+    def metrics(self) -> dict:
+        """Snapshot of the coalescing/cache counters (+ derived means)."""
+        with self._lock:
+            c = dict(self.counters)
+        batches = max(c["batches"], 1)
+        lookups = c["cache_hits"] + c["cache_misses"]
+        return {
+            **c,
+            "mean_batch_size": c["batched_queries"] / batches,
+            "mean_batch_secs": c["batch_secs_total"] / batches,
+            "cache_hit_rate": c["cache_hits"] / max(lookups, 1),
+        }
+
+    # ---------------------------------------------------------------- worker
+
+    def _drain_window(self) -> list[_Request]:
+        """Wait for work, then collect what arrives in the window — up to
+        max_batch queries; the remainder stays queued and the worker loops
+        straight back into the next flush without waiting."""
+        with self._cond:
+            while not self._pending and not self._closed:
+                self._cond.wait()
+            if not self._pending:
+                return []
+            deadline = time.monotonic() + self.window
+            while not self._closed:
+                if (
+                    sum(r.states.shape[0] for r in self._pending)
+                    >= self.max_batch
+                ):
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            batch: list[_Request] = []
+            total = 0
+            while self._pending:
+                n = self._pending[0].states.shape[0]
+                if batch and total + n > self.max_batch:
+                    break
+                batch.append(self._pending.pop(0))
+                total += n
+            return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._drain_window()
+            if not batch:
+                with self._lock:
+                    if self._closed and not self._pending:
+                        return
+                continue
+            t0 = time.perf_counter()
+            try:
+                # Everything that can fail lives inside this try: an escape
+                # would kill the worker and leave every parked submitter
+                # (and all future ones) blocked on events nobody will set.
+                states = np.concatenate([r.states for r in batch])
+                values, rem, found, best = self.reader.lookup_best(states)
+            except Exception as e:  # noqa: BLE001 - must unblock submitters
+                for r in batch:
+                    r.error = e
+                    r.event.set()
+                continue
+            secs = time.perf_counter() - t0
+            sentinel = int(self.reader.game.sentinel)
+            with self._lock:
+                self.counters["batches"] += 1
+                self.counters["batched_queries"] += int(states.shape[0])
+                self.counters["max_batch_size"] = max(
+                    self.counters["max_batch_size"], int(states.shape[0])
+                )
+                self.counters["batch_secs_total"] += secs
+            if self.logger is not None:
+                self.logger.log(
+                    {
+                        "phase": "serve_batch",
+                        "batch_size": int(states.shape[0]),
+                        "requests": len(batch),
+                        "secs": secs,
+                    }
+                )
+            off = 0
+            for r in batch:
+                n = r.states.shape[0]
+                r.out = [
+                    (
+                        int(values[off + j]),
+                        int(rem[off + j]),
+                        bool(found[off + j]),
+                        None
+                        if int(best[off + j]) == sentinel
+                        else int(best[off + j]),
+                    )
+                    for j in range(n)
+                ]
+                off += n
+                r.event.set()

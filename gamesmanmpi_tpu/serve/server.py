@@ -1,0 +1,268 @@
+"""Stdlib HTTP front-end over a solved-position database.
+
+A `ThreadingHTTPServer` (one thread per connection — the stdlib answer,
+no framework dependency, matching the repo's plain-npz/no-deps stance)
+exposing:
+
+    POST /query    {"positions": ["0x1b", 42, ...]} ->
+                   per-position value / remoteness / best child
+    GET  /healthz  liveness + DB identity
+    GET  /metrics  request, micro-batching and cache counters (JSON)
+
+Every request thread funnels through one serve/batcher.Batcher, so
+concurrent requests coalesce into single vectorized DbReader probes; the
+HTTP layer only parses, delegates, and formats. Positions echo back in
+hex (the CLI's --query spelling) so responses are copy-pasteable into
+`cli query` / `--query` for cross-checking.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from gamesmanmpi_tpu.core.values import value_name
+from gamesmanmpi_tpu.db.format import parse_position
+from gamesmanmpi_tpu.serve.batcher import Batcher, BatcherClosed
+
+# Refuse absurd request bodies before json.loads allocates for them.
+_MAX_BODY_BYTES = 16 << 20
+_MAX_POSITIONS_PER_REQUEST = 1 << 16
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "gamesman-serve/1"
+    protocol_version = "HTTP/1.1"
+    # Socket timeout for blocking reads: a client that promises
+    # Content-Length N and sends fewer bytes must not pin a handler
+    # thread forever (slowloris); on timeout the connection is reaped.
+    timeout = 30
+
+    # self.server is the _QueryHTTPServer below.
+
+    def _send_json(self, code: int, payload: dict) -> int:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if self.close_connection:
+            # HTTP/1.1 defaults to keep-alive: a client must be TOLD the
+            # connection is closing, or its next request hits a dead
+            # socket (the early-400 path closes without draining).
+            self.send_header("Connection", "close")
+        self.end_headers()
+        self.wfile.write(body)
+        return code
+
+    def log_message(self, fmt, *args):  # quiet by default; JSONL has it
+        pass
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        srv = self.server
+        if self.path == "/healthz":
+            self._send_json(
+                200,
+                {
+                    "status": "ok",
+                    "game": srv.reader.game.name,
+                    "spec": srv.reader.manifest["spec"],
+                    "positions": srv.reader.num_positions,
+                    "levels": len(srv.reader.levels),
+                },
+            )
+        elif self.path == "/metrics":
+            self._send_json(200, srv.metrics())
+        else:
+            self._send_json(404, {"error": f"no such path {self.path!r}"})
+
+    def do_POST(self):  # noqa: N802 - http.server API
+        # Every POST counts in /metrics, rejects included — an operator
+        # watching the counters must see a server busy answering 400s as
+        # busy, and http_errors makes the reject rate derivable.
+        t0 = time.perf_counter()
+        code = 500
+        try:
+            code = self._handle_post()
+        finally:
+            self.server.note_request(time.perf_counter() - t0, code)
+
+    def _handle_post(self) -> int:
+        srv = self.server
+        if self.path != "/query":
+            # The body (if any) is never read on this branch; its bytes
+            # would desync the keep-alive socket (same guard as below).
+            self.close_connection = True
+            return self._send_json(
+                404, {"error": f"no such path {self.path!r}"}
+            )
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            length = -1
+        if self.headers.get("Transfer-Encoding"):
+            # Chunked bodies are not read; their bytes would desync the
+            # keep-alive socket exactly like an undrained oversize body.
+            length = -1
+        if not 0 <= length <= _MAX_BODY_BYTES:
+            # Refusing without reading the body leaves its bytes on the
+            # keep-alive socket, where they would parse as the next
+            # request line — drop the connection instead.
+            self.close_connection = True
+            return self._send_json(400, {"error": "bad Content-Length"})
+        try:
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            positions = payload["positions"]
+            if not isinstance(positions, list):
+                raise TypeError
+        except (ValueError, KeyError, TypeError):
+            # ValueError covers JSONDecodeError AND CPython's int-digit
+            # limit on absurd JSON number literals — either way a 400,
+            # never a handler traceback.
+            return self._send_json(
+                400,
+                {"error": 'body must be {"positions": [int|"0x..", ...]}'},
+            )
+        if len(positions) > _MAX_POSITIONS_PER_REQUEST:
+            return self._send_json(
+                400,
+                {"error": f"at most {_MAX_POSITIONS_PER_REQUEST} positions "
+                          "per request"},
+            )
+        parsed: list = []  # (echo, packed int) or (echo, error string)
+        for p in positions:
+            try:
+                parsed.append((p, parse_position(srv.reader.game, p)))
+            except (ValueError, TypeError) as e:
+                parsed.append((p, f"invalid position ({e})"))
+        states = [s for _, s in parsed if isinstance(s, int)]
+        try:
+            answers = iter(srv.batcher.submit(states))
+        except BatcherClosed as e:  # shutting down: genuinely transient
+            return self._send_json(503, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 - reader faults re-raise in
+            # submit (a truncated shard, an unreadable mmap): answer 500
+            # rather than dropping the connection mid-response.
+            return self._send_json(500, {"error": f"lookup failed: {e}"})
+        results = []
+        for echo, s in parsed:
+            if not isinstance(s, int):
+                results.append({"position": echo, "error": s})
+                continue
+            value, rem, found, best = next(answers)
+            rec = {"position": hex(s), "found": found}
+            if found:
+                rec["value"] = value_name(value)
+                rec["remoteness"] = rem
+                rec["best"] = None if best is None else hex(best)
+            results.append(rec)
+        return self._send_json(
+            200, {"game": srv.reader.game.name, "results": results}
+        )
+
+
+class _QueryHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    # The stdlib default accept backlog is 5; a barrier burst of clients
+    # (exactly the traffic the micro-batcher coalesces) overflows it and
+    # the overflow sees ECONNRESET. Observed under 8 synchronized clients.
+    request_queue_size = 128
+
+    def __init__(self, addr, reader):
+        super().__init__(addr, _Handler)
+        self.reader = reader
+        self.batcher = None  # attached by QueryServer AFTER the bind
+        self._stats_lock = threading.Lock()
+        self._t0 = time.time()
+        self._http_requests = 0
+        self._http_errors = 0
+        self._latency_total = 0.0
+        self._latency_max = 0.0
+
+    def note_request(self, secs: float, code: int) -> None:
+        with self._stats_lock:
+            self._http_requests += 1
+            if code >= 400:
+                self._http_errors += 1
+            self._latency_total += secs
+            self._latency_max = max(self._latency_max, secs)
+
+    def metrics(self) -> dict:
+        with self._stats_lock:
+            n = self._http_requests
+            errors = self._http_errors
+            mean = self._latency_total / max(n, 1)
+            peak = self._latency_max
+            uptime = time.time() - self._t0
+        return {
+            "uptime_secs": uptime,
+            "http_requests": n,
+            "http_errors": errors,
+            "latency_mean_ms": mean * 1e3,
+            "latency_max_ms": peak * 1e3,
+            **self.batcher.metrics(),
+        }
+
+
+class QueryServer:
+    """Owns the HTTP server + batcher lifecycle.
+
+    port=0 binds an ephemeral port (tests); `.port` reports the bound one.
+    Use `.start()` for a background thread (in-process tests) or
+    `.serve_forever()` to block (the CLI `serve` subcommand).
+    """
+
+    def __init__(self, reader, *, host: str = "127.0.0.1", port: int = 0,
+                 window: float = 0.002, cache_size: int = 65536,
+                 logger=None):
+        self.reader = reader
+        self.logger = logger
+        # Bind FIRST: a bind failure (port in use) must raise before the
+        # batcher spawns its worker thread, or every failed construction
+        # would leak an unjoinable daemon thread.
+        self._httpd = _QueryHTTPServer((host, port), reader)
+        self.batcher = Batcher(
+            reader, window=window, cache_size=cache_size, logger=logger
+        )
+        self._httpd.batcher = self.batcher
+        self._thread: threading.Thread | None = None
+
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="gamesman-serve",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def serve_forever(self) -> None:
+        self._httpd.serve_forever()
+
+    def metrics(self) -> dict:
+        return self._httpd.metrics()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.batcher.close()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

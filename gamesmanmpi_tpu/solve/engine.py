@@ -119,13 +119,21 @@ class SolveResult:
         """(value, remoteness) of any reachable packed state.
 
         Queries are canonicalized, so symmetry-reduced tables answer for
-        every member of a stored class.
+        every member of a stored class. The probe itself is the shared
+        canonicalize→probe search (core/probe.py) — one code path with
+        the solved-position DB and checkpoint point queries.
         """
+        from gamesmanmpi_tpu.core.probe import probe_sorted_np
+
         state, level = canonical_scalar(self.game, state)
         table = self.levels.get(level)
         if table is not None:
-            i = np.searchsorted(table.states, state)
-            if i < table.states.shape[0] and table.states[i] == state:
+            idx, hit = probe_sorted_np(
+                table.states,
+                np.asarray([state], dtype=table.states.dtype),
+            )
+            if hit[0]:
+                i = idx[0]
                 return int(table.values[i]), int(table.remoteness[i])
         raise KeyError(f"state {int(state):#x} not reachable/solved")
 
@@ -492,6 +500,7 @@ class Solver:
         checkpointer=None,
         force_generic: bool = False,
         store_tables: bool = True,
+        level_sink=None,
     ):
         self.game = game
         if min_bucket is None:
@@ -508,6 +517,12 @@ class Solver:
         #: False = big-run mode: only the root level's table is materialized
         #: on host (plus checkpoints); see the sharded solver's docstring.
         self.store_tables = store_tables
+        #: Export hook (db/writer.DbWriter.add_level_table): called with
+        #: (level, LevelTable) for every level as the backward pass
+        #: resolves it, deepest first — so a DB export streams level by
+        #: level and never holds the full table in host memory
+        #: (combine with store_tables=False).
+        self.level_sink = level_sink
         self.fast = bool(game.uniform_level_jump) and not force_generic
         self.device_store_bytes = _device_store_bytes()
         self.backward_block = _backward_block()
@@ -1112,6 +1127,7 @@ class Solver:
                     self.store_tables
                     or k == root_level
                     or self.checkpointer is not None
+                    or self.level_sink is not None
                 ):
                     table = LevelTable(
                         states=rec.host_states(),
@@ -1122,6 +1138,8 @@ class Solver:
                     table = None  # big-run mode: no host materialization
             if table is not None and (self.store_tables or k == root_level):
                 resolved[k] = table
+            if self.level_sink is not None and table is not None:
+                self.level_sink(k, table)
             prev = (states_dev, values_dev, rem_dev)
             rec.dev = None  # release the forward copy
             rec.prim = rec.uidx = None  # release provenance
@@ -1281,6 +1299,8 @@ class Solver:
                                    remoteness=remoteness)
             if self.store_tables or k == root_level:
                 resolved[k] = table
+            if self.level_sink is not None:
+                self.level_sink(k, table)
             cap = padded.shape[0]
             pv = np.full(cap, UNDECIDED, dtype=np.uint8)
             pr = np.zeros(cap, dtype=np.int32)

@@ -233,11 +233,16 @@ class LevelCheckpointer:
         # file is a multi-hundred-MB read.
         self._lookup_cache = (cache_key, (states, cells))
         # Per-shard slices keep the engine's sorted invariant; the global
-        # file is sorted by construction.
-        i = int(np.searchsorted(states, states.dtype.type(state)))
-        if i >= states.shape[0] or int(states[i]) != int(state):
+        # file is sorted by construction. The probe is the shared
+        # canonicalize→probe search every query route uses (core/probe.py).
+        from gamesmanmpi_tpu.core.probe import probe_sorted_np
+
+        idx, hit = probe_sorted_np(
+            states, np.asarray([state], dtype=states.dtype)
+        )
+        if not hit[0]:
             return None
-        values, remoteness = unpack_cells_np(cells[i : i + 1])
+        values, remoteness = unpack_cells_np(cells[idx[0] : idx[0] + 1])
         return int(values[0]), int(remoteness[0])
 
     # Incremental per-(level, shard) forward saves — the sharded analog of

@@ -14,7 +14,21 @@ import sys
 import time
 
 
-class JsonlLogger:
+class _ClosingLogger:
+    """Context-manager protocol shared by every logger: `with` guarantees
+    the file handle closes on exceptions (long-lived consumers — the CLI,
+    the query server — would otherwise leak handles / lose buffered tail
+    records on an aborted solve)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class JsonlLogger(_ClosingLogger):
     """Appends one JSON object per record to a file."""
 
     def __init__(self, path: str):
@@ -30,7 +44,7 @@ class JsonlLogger:
         self._fh.close()
 
 
-class StdoutLogger:
+class StdoutLogger(_ClosingLogger):
     """Human-readable per-level progress lines (debug flag analog)."""
 
     def log(self, record: dict) -> None:
@@ -45,7 +59,7 @@ class StdoutLogger:
         pass
 
 
-class TeeLogger:
+class TeeLogger(_ClosingLogger):
     """Fan a record out to several loggers."""
 
     def __init__(self, *loggers):

@@ -1,0 +1,415 @@
+"""db/: solved-position database round-trip, conversion, integrity.
+
+The DB is the persistence contract of SURVEY.md §1's by-product claim
+("every reachable position is solved"): for each covered game,
+solve → export → DbReader.lookup must reproduce the pure-Python oracle
+exactly, for every reachable position, through the packed-cell codec.
+"""
+
+import json
+import sys
+
+import numpy as np
+import pytest
+
+from gamesmanmpi_tpu.core.values import MAX_REMOTENESS, WIN
+from gamesmanmpi_tpu.db import (
+    DbFormatError,
+    DbReader,
+    DbWriter,
+    check_db,
+    export_checkpoint,
+    export_result,
+)
+from gamesmanmpi_tpu.games import get_game
+from gamesmanmpi_tpu.solve import Solver
+from gamesmanmpi_tpu.solve.oracle import oracle_solve
+from gamesmanmpi_tpu.utils.checkpoint import LevelCheckpointer
+
+from helpers import REF_GAMES, load_module
+
+# (registry spec, reference-style scalar twin) — the oracle-parity axis.
+CASES = [
+    ("tictactoe", "tictactoe.py"),
+    ("nim:heaps=3-4-5", "nim_345.py"),
+    ("chomp:w=3,h=3", "chomp_33.py"),
+]
+
+
+@pytest.fixture(scope="module")
+def solved(tmp_path_factory):
+    """Lazy per-spec cache: (SolveResult, DbReader, oracle table, dir)."""
+    built = {}
+
+    def get(spec, ref_file):
+        if spec not in built:
+            d = tmp_path_factory.mktemp("db")
+            result = Solver(get_game(spec)).solve()
+            export_result(result, d, spec)
+            _, _, oracle = oracle_solve(load_module(REF_GAMES / ref_file))
+            built[spec] = (result, DbReader(d), oracle, d)
+        return built[spec]
+
+    yield get
+    for _, reader, _, _ in built.values():
+        reader.close()
+
+
+@pytest.mark.parametrize("spec,ref_file", CASES)
+def test_db_roundtrip_matches_oracle(solved, spec, ref_file):
+    """solve → export-db → lookup == oracle for EVERY reachable position,
+    remoteness included (the full range each game produces round-trips
+    through pack_cells/unpack_cells)."""
+    _, reader, oracle, _ = solved(spec, ref_file)
+    positions = np.array(sorted(oracle), dtype=np.uint64)
+    values, rem, found = reader.lookup(positions)
+    assert found.all(), "reachable positions missing from the DB"
+    for i, pos in enumerate(positions):
+        assert (int(values[i]), int(rem[i])) == oracle[int(pos)], (
+            f"{spec}: mismatch at {int(pos):#x}"
+        )
+    assert reader.num_positions == len(oracle)
+
+
+@pytest.mark.parametrize("spec,ref_file", [CASES[0], CASES[1]])
+def test_inprocess_query_and_db_agree(solved, spec, ref_file):
+    """Regression for the unified canonicalize→probe path: the in-process
+    --query route (SolveResult.lookup) and the DB route answer identically
+    for every reachable position."""
+    result, reader, oracle, _ = solved(spec, ref_file)
+    positions = np.array(sorted(oracle), dtype=np.uint64)
+    values, rem, found = reader.lookup(positions)
+    assert found.all()
+    for i, pos in enumerate(positions):
+        assert result.lookup(int(pos)) == (int(values[i]), int(rem[i]))
+
+
+def test_db_lookup_misses_and_empty(solved):
+    _, reader, oracle, _ = solved(*CASES[0])
+    # Unreachable (overlapping X/O planes) and out-of-table patterns miss.
+    values, rem, found = reader.lookup(
+        np.array([0b1_000000001, (1 << 18) - 1], dtype=np.uint64)
+    )
+    assert not found.any()
+    assert (values == 0).all() and (rem == 0).all()
+    v, r, f = reader.lookup(np.array([], dtype=np.uint64))
+    assert v.shape == (0,) and r.shape == (0,) and f.shape == (0,)
+
+
+def test_db_sym_reduced_answers_all_members(tmp_path):
+    """A sym=1 DB stores only class representatives but must answer for
+    every raw position: queries canonicalize before probing."""
+    spec = "tictactoe:sym=1"
+    result = Solver(get_game(spec)).solve()
+    export_result(result, tmp_path / "db", spec)
+    _, _, oracle = oracle_solve(load_module(REF_GAMES / "tictactoe.py"))
+    module = load_module(REF_GAMES / "tictactoe.py")
+    with DbReader(tmp_path / "db") as reader:
+        assert reader.num_positions < len(oracle)  # genuinely reduced
+        positions = np.array(sorted(oracle), dtype=np.uint64)
+        values, rem, found = reader.lookup(positions)
+        assert found.all()
+        for i, pos in enumerate(positions):
+            assert (int(values[i]), int(rem[i])) == oracle[int(pos)]
+        # Best moves must be LEGAL from the raw queried position (not its
+        # class representative) and optimal: remoteness steps down by 1.
+        bvals, brem, bfound, best = reader.lookup_best(positions[:512])
+        sentinel = int(reader.game.sentinel)
+        legal_checked = 0
+        for i, pos in enumerate(positions[:512]):
+            b = int(best[i])
+            if b == sentinel:
+                assert oracle[int(pos)][1] == 0  # terminal: no move
+                continue
+            legal = {
+                module.do_move(int(pos), mv)
+                for mv in module.gen_moves(int(pos))
+            }
+            assert b in legal, f"best {b:#x} illegal from {int(pos):#x}"
+            assert oracle[b][1] == oracle[int(pos)][1] - 1
+            legal_checked += 1
+        assert legal_checked > 100
+
+
+def test_db_best_move_is_optimal(solved):
+    """lookup_best returns a child realizing the parent's value/remoteness
+    per the combine rules (WIN -> LOSE child at rem-1; LOSE/TIE -> max-
+    remoteness child of the right value at rem-1)."""
+    _, reader, oracle, _ = solved(*CASES[0])
+    positions = np.array(sorted(oracle), dtype=np.uint64)
+    values, rem, found, best = reader.lookup_best(positions)
+    sentinel = int(reader.game.sentinel)
+    checked = 0
+    for i, pos in enumerate(positions):
+        v, r = oracle[int(pos)]
+        if r == 0:  # terminal: no move
+            assert int(best[i]) == sentinel
+            continue
+        b = int(best[i])
+        assert b != sentinel
+        bv, br, bf = reader.lookup(np.array([b], dtype=np.uint64))
+        assert bf[0]
+        want_child = {1: 2, 2: 1, 3: 3}[v]  # WIN->LOSE, LOSE->WIN, TIE->TIE
+        assert int(bv[0]) == want_child
+        assert int(br[0]) == r - 1
+        checked += 1
+    assert checked > 100
+
+
+def test_boundary_remoteness_roundtrip(tmp_path):
+    """MAX_REMOTENESS survives the packed cell (30-bit field) bit-exactly;
+    one past it is refused at write time rather than clipped."""
+    game = get_game("tictactoe")
+    w = DbWriter(tmp_path / "db", game, "tictactoe")
+    states = np.array([0], dtype=game.state_dtype)  # level_of(0) == 0
+    w.add_level(
+        0,
+        states,
+        np.array([WIN], dtype=np.uint8),
+        np.array([MAX_REMOTENESS], dtype=np.int32),
+    )
+    w.finalize()
+    with DbReader(tmp_path / "db") as reader:
+        values, rem, found = reader.lookup(states)
+    assert found[0] and int(values[0]) == WIN
+    assert int(rem[0]) == MAX_REMOTENESS
+
+    w2 = DbWriter(tmp_path / "db2", game, "tictactoe")
+    with pytest.raises(DbFormatError, match="remoteness"):
+        w2.add_level(
+            0,
+            states,
+            np.array([WIN], dtype=np.uint8),
+            np.array([MAX_REMOTENESS + 1], dtype=np.int64),
+        )
+
+
+def test_writer_enforces_probe_invariants(tmp_path):
+    game = get_game("tictactoe")
+    w = DbWriter(tmp_path / "db", game, "tictactoe")
+    with pytest.raises(DbFormatError, match="ascending"):
+        w.add_level(
+            1,
+            np.array([2, 1], dtype=game.state_dtype),
+            np.zeros(2, np.uint8) + 1,
+            np.zeros(2, np.int32),
+        )
+    with pytest.raises(DbFormatError, match="dtype"):
+        w.add_level(
+            1,
+            np.array([1], dtype=np.uint64),  # game is uint32
+            np.ones(1, np.uint8),
+            np.zeros(1, np.int32),
+        )
+    with pytest.raises(DbFormatError, match="sentinel"):
+        w.add_level(
+            1,
+            np.array([0xFFFF_FFFF], dtype=np.uint32),
+            np.ones(1, np.uint8),
+            np.zeros(1, np.int32),
+        )
+    with pytest.raises(DbFormatError, match="empty"):
+        w.finalize()
+    # A real level seals it; a second writer refuses without overwrite.
+    w.add_level(
+        0, np.array([0], dtype=game.state_dtype),
+        np.ones(1, np.uint8), np.zeros(1, np.int32),
+    )
+    w.finalize()
+    with pytest.raises(DbFormatError, match="finalized"):
+        DbWriter(tmp_path / "db", game, "tictactoe")
+    # Overwrite stages into a sibling dir: until the new export FINALIZES,
+    # the old database keeps serving (a crash mid-re-solve must not
+    # destroy it); the swap replaces it wholesale, stale shards included.
+    w3 = DbWriter(tmp_path / "db", game, "tictactoe", overwrite=True)
+    assert (tmp_path / "db" / "manifest.json").exists()  # old DB intact
+    w3.add_level(
+        0, np.array([0], dtype=game.state_dtype),
+        np.full(1, 3, np.uint8), np.zeros(1, np.int32),
+    )
+    w3.finalize()
+    assert not list(tmp_path.glob("db.staging*"))  # swap cleaned up
+    with DbReader(tmp_path / "db") as r:
+        values, _, found = r.lookup(np.array([0], dtype=np.uint64))
+    assert found[0] and int(values[0]) == 3  # the NEW cells serve
+    # A FAILED overwrite export (abort before finalize) leaves the old
+    # DB serving and no staging orphan.
+    w4 = DbWriter(tmp_path / "db", game, "tictactoe", overwrite=True)
+    w4.add_level(
+        0, np.array([0], dtype=game.state_dtype),
+        np.ones(1, np.uint8), np.zeros(1, np.int32),
+    )
+    w4.abort()
+    assert not list(tmp_path.glob("db.staging*"))
+    with DbReader(tmp_path / "db") as r:
+        values, _, found = r.lookup(np.array([0], dtype=np.uint64))
+    assert found[0] and int(values[0]) == 3  # still the w3 export
+
+
+def test_reader_rejects_wrong_game_and_missing_manifest(solved, tmp_path):
+    _, _, _, d = solved(*CASES[0])
+    with pytest.raises(DbFormatError, match="belongs to game"):
+        DbReader(d, game=get_game("tictactoe:m=4,n=4,k=4"))
+    with pytest.raises(DbFormatError, match="manifest"):
+        DbReader(tmp_path / "empty")
+
+
+def test_export_checkpoint_conversion(tmp_path):
+    """A past solve's --checkpoint-dir becomes a servable DB without
+    re-solving, via the standalone tool; answers match the live result."""
+    sys.path.insert(0, str(REF_GAMES.parent.parent / "tools"))
+    try:
+        import ckpt_to_db
+    finally:
+        sys.path.pop(0)
+    ckpt_dir = tmp_path / "ckpt"
+    result = Solver(
+        get_game("tictactoe"), checkpointer=LevelCheckpointer(str(ckpt_dir))
+    ).solve()
+    rc = ckpt_to_db.main(
+        [str(ckpt_dir), str(tmp_path / "db"), "--game", "tictactoe"]
+    )
+    assert rc == 0
+    with DbReader(tmp_path / "db") as reader:
+        assert reader.num_positions == result.num_positions
+        for level, table in result.levels.items():
+            values, rem, found = reader.lookup(table.states)
+            assert found.all()
+            assert (values == table.values).all()
+            assert (rem == table.remoteness).all()
+    # Wrong spec must be refused (the bound game name disagrees).
+    rc = ckpt_to_db.main(
+        [str(ckpt_dir), str(tmp_path / "db2"), "--game", "nim:heaps=3-4-5"]
+    )
+    assert rc == 2
+
+
+def test_export_checkpoint_refuses_dense(tmp_path):
+    ckpt = LevelCheckpointer(str(tmp_path / "dense"))
+    ckpt.save_dense_level(0, np.zeros(4, dtype=np.uint8))
+    with pytest.raises(DbFormatError, match="dense"):
+        export_checkpoint(
+            ckpt, get_game("connect4:w=3,h=3,k=3"),
+            "connect4:w=3,h=3,k=3", tmp_path / "db",
+        )
+
+
+def test_cli_export_db_and_query(tmp_path, capsys):
+    from gamesmanmpi_tpu.cli import main as cli_main
+
+    d = str(tmp_path / "db")
+    rc = cli_main(
+        ["export-db", "subtract:total=10,moves=1-2", "--out", d]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "database written" in out
+    assert "positions: 11" in out
+    rc = cli_main(["query", d, "9", "0x3", "77", "zz"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "query 9: value=LOSE remoteness=6 best=0x8" in out
+    assert "query 0x3: value=LOSE" in out
+    assert "query 77: invalid position" in out  # outside 4-bit state space
+    assert "query zz: invalid position" in out
+    # Existing DB refused without --overwrite, replaced with it.
+    rc = cli_main(
+        ["export-db", "subtract:total=10,moves=1-2", "--out", d]
+    )
+    assert rc == 2
+    assert "already holds" in capsys.readouterr().err
+    rc = cli_main(
+        ["export-db", "subtract:total=10,moves=1-2", "--out", d,
+         "--overwrite"]
+    )
+    assert rc == 0
+
+
+def test_cli_export_db_from_checkpoint(tmp_path, capsys):
+    from gamesmanmpi_tpu.cli import main as cli_main
+
+    ckpt = str(tmp_path / "ckpt")
+    rc = cli_main(
+        ["subtract:total=10,moves=1-2", "--checkpoint-dir", ckpt]
+    )
+    assert rc == 0
+    capsys.readouterr()
+    rc = cli_main(
+        ["export-db", "subtract:total=10,moves=1-2",
+         "--out", str(tmp_path / "db"), "--from-checkpoint", ckpt]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "positions: 11" in out
+    rc = cli_main(["query", str(tmp_path / "db"), "10"])
+    assert rc == 0
+    assert "value=WIN remoteness=7" in capsys.readouterr().out
+
+
+def test_flat_cli_unchanged_by_subcommands(capsys):
+    """The flat solve CLI parses exactly as before the subcommands."""
+    from gamesmanmpi_tpu.cli import main as cli_main
+
+    rc = cli_main(["subtract:total=10,moves=1-2", "--query", "9"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "value: WIN" in out
+    assert "query 9: value=LOSE" in out
+
+
+def test_check_db_catches_corruption(solved, tmp_path, capsys):
+    sys.path.insert(0, str(REF_GAMES.parent.parent / "tools"))
+    try:
+        import check_db as check_db_tool
+    finally:
+        sys.path.pop(0)
+    _, _, _, good = solved(*CASES[0])
+    assert check_db(good) == []
+    assert check_db_tool.main([str(good), "--quiet"]) == 0
+
+    # Copy then corrupt one cells byte: the checksum must catch it.
+    import shutil
+
+    bad = tmp_path / "bad"
+    shutil.copytree(good, bad)
+    manifest = json.loads((bad / "manifest.json").read_text())
+    victim = bad / next(iter(manifest["levels"].values()))["cells"]
+    raw = bytearray(victim.read_bytes())
+    raw[-1] ^= 0xFF
+    victim.write_bytes(raw)
+    problems = check_db(bad)
+    assert problems and "checksum" in problems[0]
+    assert check_db_tool.main([str(bad), "--quiet"]) == 1
+
+    # Unsorted keys (with refreshed checksum) caught by the sort check.
+    bad2 = tmp_path / "bad2"
+    shutil.copytree(good, bad2)
+    manifest = json.loads((bad2 / "manifest.json").read_text())
+    rec = manifest["levels"]["1"]
+    keys_path = bad2 / rec["keys"]
+    keys = np.load(keys_path)
+    np.save(keys_path, keys[::-1].copy())
+    from gamesmanmpi_tpu.db.format import file_sha256, write_manifest
+
+    rec["keys_sha256"] = file_sha256(keys_path)
+    write_manifest(bad2, manifest)
+    assert any("ascending" in p for p in check_db(bad2))
+
+
+def test_jsonl_logger_context_manager(tmp_path):
+    """The logger closes its handle on exceptions (satellite: context
+    manager), and TeeLogger propagates the close."""
+    from gamesmanmpi_tpu.utils.metrics import JsonlLogger, TeeLogger
+
+    path = tmp_path / "m.jsonl"
+    with pytest.raises(RuntimeError):
+        with JsonlLogger(str(path)) as logger:
+            logger.log({"phase": "x"})
+            raise RuntimeError("boom")
+    assert logger._fh.closed
+    assert "x" in path.read_text()
+
+    inner = JsonlLogger(str(tmp_path / "t.jsonl"))
+    with TeeLogger(inner, None) as tee:
+        tee.log({"phase": "y"})
+    assert inner._fh.closed

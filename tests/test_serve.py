@@ -1,0 +1,277 @@
+"""serve/: HTTP query serving over a solved-position DB.
+
+Acceptance axis: concurrent batched POST /query traffic answers with
+oracle-exact value/remoteness, /healthz is live, and /metrics proves the
+micro-batching actually coalesced (mean batch size > 1 under concurrent
+load) and the LRU cache hit on repeats.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gamesmanmpi_tpu.core.values import value_name
+from gamesmanmpi_tpu.db import DbReader, export_result
+from gamesmanmpi_tpu.games import get_game
+from gamesmanmpi_tpu.serve import Batcher, QueryServer
+from gamesmanmpi_tpu.solve import Solver
+from gamesmanmpi_tpu.solve.oracle import oracle_solve
+
+from helpers import REF_GAMES, load_module
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+@pytest.fixture(scope="module")
+def nim_db(tmp_path_factory):
+    spec = "nim:heaps=3-4-5"
+    d = tmp_path_factory.mktemp("nimdb")
+    export_result(Solver(get_game(spec)).solve(), d, spec)
+    _, _, oracle = oracle_solve(load_module(REF_GAMES / "nim_345.py"))
+    with DbReader(d) as reader:
+        yield reader, oracle
+
+
+@pytest.fixture(scope="module")
+def ttt_db(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tttdb")
+    export_result(Solver(get_game("tictactoe")).solve(), d, "tictactoe")
+    _, _, oracle = oracle_solve(load_module(REF_GAMES / "tictactoe.py"))
+    with DbReader(d) as reader:
+        yield reader, oracle
+
+
+def _fire_concurrent(server, chunks):
+    """POST each chunk from its own thread, barrier-synchronized so they
+    land inside one coalescing window. Returns the per-chunk bodies."""
+    url = f"http://127.0.0.1:{server.port}/query"
+    barrier = threading.Barrier(len(chunks))
+    out = [None] * len(chunks)
+    errors = []
+
+    def worker(i, chunk):
+        try:
+            barrier.wait()
+            status, body = _post(url, {"positions": chunk})
+            assert status == 200
+            out[i] = body
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(i, c))
+        for i, c in enumerate(chunks)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    return out
+
+
+def test_concurrent_queries_match_oracle_and_batch(nim_db):
+    """Every reachable nim_345 position served concurrently matches the
+    oracle; /metrics shows real coalescing and cache hits on repeats."""
+    reader, oracle = nim_db
+    positions = sorted(oracle)
+    with QueryServer(reader, window=0.05) as server:
+        base = f"http://127.0.0.1:{server.port}"
+        status, health = _get(base + "/healthz")
+        assert status == 200
+        assert health["status"] == "ok"
+        assert health["game"] == reader.game.name
+        assert health["positions"] == reader.num_positions
+
+        n_threads = 6
+        chunks = [
+            [hex(p) for p in positions[i::n_threads]]
+            for i in range(n_threads)
+        ]
+        bodies = _fire_concurrent(server, chunks)
+        for chunk, body in zip(chunks, bodies):
+            assert len(body["results"]) == len(chunk)
+            for q, rec in zip(chunk, body["results"]):
+                v, r = oracle[int(q, 0)]
+                assert rec["found"], q
+                assert rec["value"] == value_name(v), q
+                assert rec["remoteness"] == r, q
+
+        # Repeat the same traffic: answers now come from the LRU cache.
+        _fire_concurrent(server, chunks)
+
+        status, metrics = _get(base + "/metrics")
+        assert status == 200
+        assert metrics["batches"] >= 1
+        assert metrics["mean_batch_size"] > 1  # coalescing happened
+        assert metrics["cache_hits"] >= len(positions)
+        assert metrics["http_requests"] >= 2 * n_threads
+        assert metrics["latency_mean_ms"] > 0
+
+
+def test_serve_full_tictactoe_oracle(ttt_db):
+    """The acceptance game: all 5478 tictactoe positions, served in
+    concurrent chunks, match the oracle exactly."""
+    reader, oracle = ttt_db
+    positions = sorted(oracle)
+    with QueryServer(reader, window=0.02) as server:
+        chunks = [[hex(p) for p in positions[i::8]] for i in range(8)]
+        bodies = _fire_concurrent(server, chunks)
+        for chunk, body in zip(chunks, bodies):
+            for q, rec in zip(chunk, body["results"]):
+                v, r = oracle[int(q, 0)]
+                assert (rec["found"], rec["value"], rec["remoteness"]) == (
+                    True, value_name(v), r,
+                ), q
+
+
+def test_best_move_chain_reaches_terminal(nim_db):
+    """Following served best moves from the root plays a full optimal
+    game: remoteness decreases by exactly 1 per ply to 0."""
+    reader, oracle = nim_db
+    with QueryServer(reader) as server:
+        url = f"http://127.0.0.1:{server.port}/query"
+        pos = int(reader.game.initial_state())
+        _, body = _post(url, {"positions": [pos]})
+        rec = body["results"][0]
+        seen = 0
+        while rec["best"] is not None:
+            nxt = int(rec["best"], 0)
+            _, body = _post(url, {"positions": [nxt]})
+            nrec = body["results"][0]
+            assert nrec["remoteness"] == rec["remoteness"] - 1
+            rec = nrec
+            seen += 1
+        assert rec["remoteness"] == 0
+        assert seen > 0
+
+
+def test_http_error_paths(nim_db):
+    reader, _ = nim_db
+    with QueryServer(reader) as server:
+        base = f"http://127.0.0.1:{server.port}"
+        status, body = _post(
+            base + "/query", {"positions": [1, "zz", -3, 4.2, True]}
+        )
+        assert status == 200
+        ok, bad, neg, flt, boolean = body["results"]
+        assert "error" in bad and "error" in neg
+        # Non-integer numbers and booleans are refused, never truncated to
+        # a neighboring position's answer.
+        assert "error" in flt and "error" in boolean
+        assert "found" in ok
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(base + "/query", {"wrong": []})
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(base + "/nope")
+        assert e.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(base + "/nope", {})
+        assert e.value.code == 404
+        # Rejects are visible in the counters: every POST lands in
+        # http_requests, errors in http_errors.
+        _, metrics = _get(base + "/metrics")
+        assert metrics["http_errors"] >= 2
+        assert metrics["http_requests"] >= 3
+
+
+def test_batcher_coalesces_and_caches(nim_db):
+    """Batcher unit semantics without HTTP: concurrent submits coalesce
+    into fewer lookup_best calls; repeats hit the LRU."""
+    reader, oracle = nim_db
+    positions = sorted(oracle)[:30]
+    batcher = Batcher(reader, window=0.05, cache_size=1024)
+    try:
+        barrier = threading.Barrier(5)
+        outs = [None] * 5
+
+        def worker(i):
+            barrier.wait()
+            outs[i] = batcher.submit(positions[i::5])
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(5)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i in range(5):
+            for pos, (v, r, found, _) in zip(positions[i::5], outs[i]):
+                assert found and (v, r) == oracle[pos]
+        m = batcher.metrics()
+        assert m["batches"] < m["requests"]  # coalescing, not per-request
+        assert m["mean_batch_size"] > 1
+        assert m["cache_hits"] == 0
+        again = batcher.submit(positions)
+        for pos, (v, r, found, _) in zip(positions, again):
+            assert found and (v, r) == oracle[pos]
+        assert batcher.metrics()["cache_hits"] == len(positions)
+    finally:
+        batcher.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        batcher.submit([0])
+
+
+def test_serve_jsonl_metrics(nim_db, tmp_path):
+    """Per-batch serving records land in the shared JSONL stream."""
+    from gamesmanmpi_tpu.utils.metrics import JsonlLogger
+
+    reader, oracle = nim_db
+    path = tmp_path / "serve.jsonl"
+    with JsonlLogger(str(path)) as logger:
+        with QueryServer(reader, logger=logger) as server:
+            _post(
+                f"http://127.0.0.1:{server.port}/query",
+                {"positions": sorted(oracle)[:5]},
+            )
+    records = [json.loads(line) for line in path.read_text().splitlines()]
+    batch = [r for r in records if r["phase"] == "serve_batch"]
+    assert batch and batch[0]["batch_size"] == 5
+
+
+@pytest.mark.slow
+def test_serve_sustained_load(ttt_db):
+    """Sustained mixed load (repeats + misses) stays oracle-exact; marked
+    slow: many serial HTTP rounds."""
+    reader, oracle = ttt_db
+    rng = np.random.default_rng(11)
+    positions = sorted(oracle)
+    # Zipf-ish traffic: most queries land in a small hot set (openings),
+    # the rest spread over the whole table.
+    hot = positions[:256]
+    with QueryServer(reader, window=0.005) as server:
+        url = f"http://127.0.0.1:{server.port}/query"
+        for _ in range(40):
+            chunk = [
+                hex(hot[i]) for i in rng.choice(len(hot), size=48)
+            ] + [
+                hex(positions[i]) for i in rng.choice(len(positions), size=16)
+            ]
+            _, body = _post(url, {"positions": chunk})
+            for q, rec in zip(chunk, body["results"]):
+                v, r = oracle[int(q, 0)]
+                assert (rec["value"], rec["remoteness"]) == (
+                    value_name(v), r,
+                )
+        metrics = server.metrics()
+        assert metrics["cache_hit_rate"] > 0.5  # Zipf-ish repeats hit

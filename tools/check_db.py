@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""Solved-position DB integrity checker (CI-runnable).
+
+    python tools/check_db.py DB_DIR [--quiet]
+
+Validates the manifest, per-shard sha256 checksums, key sortedness/
+uniqueness/sentinel-freedom, cell dtypes and decided-ness — everything a
+serving process assumes but never re-verifies on the hot path (see
+gamesmanmpi_tpu/db/check.py for the full list). Exit 0 = clean, 1 =
+problems (printed one per line), 2 = usage error. Pure numpy file reads
+— no game construction, no kernels, no backend init — so it runs in
+seconds even where accelerator bring-up is expensive or wedged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("db_dir", help="database directory (from export-db)")
+    p.add_argument("--quiet", action="store_true",
+                   help="print problems only, no per-level OK lines")
+    args = p.parse_args(argv)
+
+    from gamesmanmpi_tpu.db.check import check_db
+
+    problems = check_db(
+        args.db_dir, verbose=None if args.quiet else print
+    )
+    for problem in problems:
+        print(f"PROBLEM: {problem}", file=sys.stderr)
+    if problems:
+        print(f"{args.db_dir}: {len(problems)} problem(s)", file=sys.stderr)
+        return 1
+    if not args.quiet:
+        print(f"{args.db_dir}: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Convert an existing --checkpoint-dir into a servable solved-position DB.
+
+Past solves (including big-run --no-tables solves, whose only durable
+output IS the checkpoint directory) become queryable databases without
+re-solving:
+
+    python tools/ckpt_to_db.py CKPT_DIR OUT_DIR --game 'connect4:w=5,h=4'
+
+Consumes classic-engine checkpoints — global per-level files or sharded
+per-(level, shard) sets (shards are assembled and sorted per level, one
+level at a time, so conversion memory is one level, not the table).
+Dense-engine checkpoints are refused (see db/writer.export_checkpoint).
+The --game spec must name the exact configuration the checkpoint was
+solved with; the bound game name in the checkpoint manifest is validated
+against it, so a sym=0 DB can never be built from a sym=1 checkpoint.
+
+This tool is a positional-argument spelling of
+`python -m gamesmanmpi_tpu.cli export-db GAME --out OUT --from-checkpoint
+CKPT` and delegates to it — one conversion code path, two front doors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("checkpoint_dir", help="existing --checkpoint-dir")
+    p.add_argument("out_dir", help="DB output directory")
+    p.add_argument(
+        "--game",
+        required=True,
+        help="built-in game spec the checkpoint was solved with "
+        "(e.g. tictactoe, 'connect4:w=5,h=4,sym=1')",
+    )
+    p.add_argument("--overwrite", action="store_true",
+                   help="replace an existing DB in out_dir")
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="print per-level progress to stderr")
+    args = p.parse_args(argv)
+
+    from gamesmanmpi_tpu.cli import main as cli_main
+
+    forward = [
+        "export-db", args.game,
+        "--out", args.out_dir,
+        "--from-checkpoint", args.checkpoint_dir,
+    ]
+    if args.overwrite:
+        forward.append("--overwrite")
+    if args.verbose:
+        forward.append("--verbose")
+    return cli_main(forward)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
