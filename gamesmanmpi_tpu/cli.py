@@ -80,6 +80,32 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="capture a jax.profiler trace of the solve into this dir (§5.1)",
     )
+    # Observability layer (docs/OBSERVABILITY.md): phase spans + metrics
+    # registry, alongside the low-level JAX trace above.
+    p.add_argument(
+        "--trace-events",
+        default=None,
+        metavar="OUT.json",
+        help="dump Chrome trace-event JSON of the solver's phase spans "
+        "(forward/dedup/backward/checkpoint/db_export) — loads in "
+        "chrome://tracing / Perfetto",
+    )
+    p.add_argument(
+        "--metrics-out",
+        default=None,
+        metavar="OUT.json",
+        help="dump the process metrics-registry snapshot (span histograms, "
+        "solve counters) as JSON when the solve finishes",
+    )
+    p.add_argument(
+        "--heartbeat-secs",
+        type=float,
+        default=None,
+        metavar="S",
+        help="emit a heartbeat record (phase/level progress, RSS, device "
+        "memory) every S seconds so long solves are diagnosable "
+        "mid-flight (env GAMESMAN_HEARTBEAT_SECS; 0 = off)",
+    )
     p.add_argument(
         "--table-out",
         default=None,
@@ -288,6 +314,7 @@ def main(argv=None) -> int:
         (args.backward_block, "GAMESMAN_BACKWARD_BLOCK"),
         (args.window_block, "GAMESMAN_WINDOW_BLOCK"),
         (args.device_store_mb, "GAMESMAN_DEVICE_STORE_MB"),
+        (args.heartbeat_secs, "GAMESMAN_HEARTBEAT_SECS"),
     ):
         if flag is not None:
             saved_env[env] = os.environ.get(env)
@@ -330,8 +357,11 @@ def _main(args) -> int:
     logger = _build_logger(args)
     # Loggers are context managers: the JSONL handle closes even when a
     # solve aborts mid-level (partial metrics beat a lost buffered tail).
+    # The obs scope nests inside so both artifacts (--trace-events,
+    # --metrics-out) are written even when the solve itself raises.
     with _logger_scope(logger):
-        return _solve_main(args, t0, logger)
+        with _obs_scope(args):
+            return _solve_main(args, t0, logger)
 
 
 def _solve_main(args, t0: float, logger) -> int:
@@ -643,6 +673,31 @@ def _logger_scope(logger):
     import contextlib
 
     return logger if logger is not None else contextlib.nullcontext()
+
+
+def _obs_scope(args):
+    """--trace-events / --metrics-out lifetime: install a trace sink for
+    the solve and write both artifacts on exit, aborts included (a
+    partial trace of a dead solve is exactly when it is most wanted)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def scope():
+        from gamesmanmpi_tpu.obs import default_registry
+        from gamesmanmpi_tpu.obs.tracing import trace_events_scope
+
+        with trace_events_scope(getattr(args, "trace_events", None)):
+            try:
+                yield
+            finally:
+                out = getattr(args, "metrics_out", None)
+                if out:
+                    with open(out, "w") as fh:
+                        json.dump(
+                            default_registry().snapshot(), fh, indent=1
+                        )
+
+    return scope()
 
 
 def _cmd_export_db(args) -> int:

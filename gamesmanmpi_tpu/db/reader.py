@@ -20,7 +20,9 @@ process compiles O(log max-batch) programs, not one per batch size.
 
 from __future__ import annotations
 
+import math
 import pathlib
+import time
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +35,7 @@ from gamesmanmpi_tpu.db.format import (
     probe_sorted_np,
     read_manifest,
 )
+from gamesmanmpi_tpu.obs import default_registry
 from gamesmanmpi_tpu.ops.padding import bucket_size, pad_to
 from gamesmanmpi_tpu.solve.engine import get_kernel, undecided_mask
 
@@ -72,9 +75,26 @@ def _expand_builder(game):
 class DbReader:
     """Read-only handle on a finalized solved-position database."""
 
-    def __init__(self, directory, game=None, *, verify: bool = False):
+    def __init__(self, directory, game=None, *, verify: bool = False,
+                 registry=None):
         self.dir = pathlib.Path(directory)
         self.manifest = read_manifest(self.dir)
+        reg = registry or default_registry()
+        self._m_probe_secs = reg.histogram(
+            "gamesman_db_probe_seconds",
+            "wall seconds per batched level probe (searchsorted + "
+            "cell gather)",
+        )
+        self._m_probe_queries = reg.counter(
+            "gamesman_db_probe_queries_total", "positions probed"
+        )
+        self._m_page_touches = reg.counter(
+            "gamesman_db_mmap_page_touches_total",
+            "estimated mmap pages touched: ceil(log2(level keys)) per "
+            "binary-search query plus one cells page per hit — the "
+            "working-set denominator that says whether a level is being "
+            "served from page cache or disk",
+        )
         if game is None:
             from gamesmanmpi_tpu.games import get_game
 
@@ -196,10 +216,12 @@ class DbReader:
         half of lookup; split out so lookup_best canonicalizes a batch
         once and reuses it for both the probe and the expansion)."""
         k = canon.shape[0]
+        t0 = time.perf_counter()
         values = np.full(k, UNDECIDED, dtype=np.uint8)
         remoteness = np.zeros(k, dtype=np.int32)
         found = np.zeros(k, dtype=bool)
         real = canon != self.game.sentinel
+        pages = 0
         for lv in np.unique(levels[real]):
             rec = self._levels.get(int(lv))
             if rec is None:
@@ -213,6 +235,16 @@ class DbReader:
                 values[hsel] = v
                 remoteness[hsel] = r
                 found[hsel] = True
+            # Page-touch model, not a kernel counter: each binary search
+            # descends ~log2(n) key pages (upper levels share pages and
+            # stay cached, so this is a ceiling), each hit reads one
+            # cells page.
+            pages += sel.size * max(
+                1, math.ceil(math.log2(max(int(keys.shape[0]), 2)))
+            ) + int(hsel.size)
+        self._m_probe_queries.inc(k)
+        self._m_page_touches.inc(pages)
+        self._m_probe_secs.observe(time.perf_counter() - t0)
         return values, remoteness, found
 
     def lookup_best(self, queries):

@@ -1,0 +1,52 @@
+"""obs: the unified observability layer (registry + spans + heartbeat).
+
+The reference GamesmanMPI had rank-0 stdout prints; this rebuild's
+north-star metric is positions-solved/sec/chip, which demands knowing
+where level time actually goes (sort vs gather vs comms — the lesson of
+the Pentago strong solve, arXiv:1404.0743, and the consumer-grade 7x6
+Connect-Four solve, arXiv:2507.05267). Three pieces, one subsystem:
+
+* ``MetricsRegistry`` (registry.py): process-wide counters / gauges /
+  bucketed histograms, thread-safe, snapshot-able to a dict and
+  renderable as Prometheus text exposition v0.0.4. ``default_registry()``
+  is the process singleton every component records into unless handed an
+  explicit registry (tests isolate with fresh instances).
+* ``Span`` / ``trace_span`` (tracing.py): wall-time spans around solver
+  phases (forward expand, dedup, backward resolve, checkpoint, db
+  export) and server request/batch stages. Spans land in the registry
+  (``gamesman_span_seconds``), optionally re-emit the existing per-level
+  JSONL records (bench.py parsing unchanged), and stream Chrome
+  trace-event JSON through an installed ``TraceEventSink``
+  (``--trace-events out.json``) alongside the ``maybe_profile`` JAX trace.
+* ``Heartbeat`` (heartbeat.py): a daemon thread that periodically logs
+  level progress, RSS, and device memory stats so a multi-hour solve is
+  diagnosable mid-flight.
+
+docs/OBSERVABILITY.md is the operator guide.
+"""
+
+from gamesmanmpi_tpu.obs.registry import (
+    MetricsRegistry,
+    default_registry,
+    render_prometheus,
+)
+from gamesmanmpi_tpu.obs.tracing import (
+    Span,
+    TraceEventSink,
+    get_trace_sink,
+    set_trace_sink,
+    trace_span,
+)
+from gamesmanmpi_tpu.obs.heartbeat import Heartbeat
+
+__all__ = [
+    "MetricsRegistry",
+    "default_registry",
+    "render_prometheus",
+    "Span",
+    "TraceEventSink",
+    "get_trace_sink",
+    "set_trace_sink",
+    "trace_span",
+    "Heartbeat",
+]

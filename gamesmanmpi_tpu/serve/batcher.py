@@ -16,8 +16,12 @@ entries; that costs cache capacity, never correctness, and avoids paying
 a canonicalize kernel call before the cache.
 
 Counters are plain ints mutated under the one lock and snapshotted by
-`metrics()`; per-batch records go to the shared utils/metrics JSONL
-logger so serving latency lands in the same stream as solve phases.
+`metrics()` (the `/metrics.json` dict); per-batch records go to the
+shared utils/metrics JSONL logger so serving latency lands in the same
+stream as solve phases, and the obs registry carries the Prometheus
+series (`gamesman_batch_queue_depth`, `gamesman_batch_size`,
+`gamesman_batch_seconds`, cache hit/miss counters) that `/metrics`
+exposes.
 """
 
 from __future__ import annotations
@@ -27,6 +31,9 @@ import time
 from collections import OrderedDict
 
 import numpy as np
+
+from gamesmanmpi_tpu.obs import default_registry
+from gamesmanmpi_tpu.obs.registry import DEFAULT_SIZE_BUCKETS
 
 
 class BatcherClosed(RuntimeError):
@@ -59,7 +66,7 @@ class Batcher:
 
     def __init__(self, reader, *, window: float = 0.002,
                  cache_size: int = 65536, max_batch: int = 1 << 16,
-                 logger=None):
+                 logger=None, registry=None):
         self.reader = reader
         self.window = float(window)
         #: Flush threshold: a burst larger than this splits into several
@@ -86,6 +93,24 @@ class Batcher:
             "max_batch_size": 0,
             "batch_secs_total": 0.0,
         }
+        reg = registry or default_registry()
+        self._m_queue_depth = reg.gauge(
+            "gamesman_batch_queue_depth",
+            "requests parked in the coalescing window right now",
+        )
+        self._m_batch_size = reg.histogram(
+            "gamesman_batch_size", "positions per flushed probe batch",
+            buckets=DEFAULT_SIZE_BUCKETS,
+        )
+        self._m_batch_secs = reg.histogram(
+            "gamesman_batch_seconds", "wall seconds per flushed probe batch"
+        )
+        self._m_cache_hits = reg.counter(
+            "gamesman_cache_hits_total", "positions answered from the LRU"
+        )
+        self._m_cache_misses = reg.counter(
+            "gamesman_cache_misses_total", "positions that went to a probe"
+        )
         self._worker = threading.Thread(
             target=self._loop, name="gamesman-batcher", daemon=True
         )
@@ -119,6 +144,10 @@ class Batcher:
                     self.counters["cache_misses"] += 1
                     miss_idx.append(i)
                     miss_pos.append(p)
+        if len(positions) > len(miss_idx):
+            self._m_cache_hits.inc(len(positions) - len(miss_idx))
+        if miss_idx:
+            self._m_cache_misses.inc(len(miss_idx))
         if not miss_idx:
             return results
         req = _Request(
@@ -128,6 +157,7 @@ class Batcher:
             if self._closed:  # close() may have landed since the cache pass
                 raise BatcherClosed("batcher is closed")
             self._pending.append(req)
+            self._m_queue_depth.set(len(self._pending))
             self._cond.notify_all()
         req.event.wait()
         if req.error is not None:
@@ -190,6 +220,7 @@ class Batcher:
                     break
                 batch.append(self._pending.pop(0))
                 total += n
+            self._m_queue_depth.set(len(self._pending))
             return batch
 
     def _loop(self) -> None:
@@ -221,6 +252,8 @@ class Batcher:
                     self.counters["max_batch_size"], int(states.shape[0])
                 )
                 self.counters["batch_secs_total"] += secs
+            self._m_batch_size.observe(int(states.shape[0]))
+            self._m_batch_secs.observe(secs)
             if self.logger is not None:
                 self.logger.log(
                     {

@@ -4,10 +4,15 @@ A `ThreadingHTTPServer` (one thread per connection — the stdlib answer,
 no framework dependency, matching the repo's plain-npz/no-deps stance)
 exposing:
 
-    POST /query    {"positions": ["0x1b", 42, ...]} ->
-                   per-position value / remoteness / best child
-    GET  /healthz  liveness + DB identity
-    GET  /metrics  request, micro-batching and cache counters (JSON)
+    POST /query         {"positions": ["0x1b", 42, ...]} ->
+                        per-position value / remoteness / best child
+    GET  /healthz       liveness + DB identity
+    GET  /metrics       Prometheus text exposition v0.0.4 (the process
+                        metrics registry: request/batch/cache/db series);
+                        answers JSON instead when the Accept header
+                        prefers application/json
+    GET  /metrics.json  the legacy JSON counter dict, retained verbatim
+                        for existing consumers
 
 Every request thread funnels through one serve/batcher.Batcher, so
 concurrent requests coalesce into single vectorized DbReader probes; the
@@ -25,7 +30,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from gamesmanmpi_tpu.core.values import value_name
 from gamesmanmpi_tpu.db.format import parse_position
+from gamesmanmpi_tpu.obs import default_registry
 from gamesmanmpi_tpu.serve.batcher import Batcher, BatcherClosed
+
+#: The exposition format version the /metrics endpoint speaks.
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 # Refuse absurd request bodies before json.loads allocates for them.
 _MAX_BODY_BYTES = 16 << 20
@@ -43,9 +52,12 @@ class _Handler(BaseHTTPRequestHandler):
     # self.server is the _QueryHTTPServer below.
 
     def _send_json(self, code: int, payload: dict) -> int:
-        body = json.dumps(payload).encode()
+        return self._send_text(code, json.dumps(payload), "application/json")
+
+    def _send_text(self, code: int, text: str, content_type: str) -> int:
+        body = text.encode()
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         if self.close_connection:
             # HTTP/1.1 defaults to keep-alive: a client must be TOLD the
@@ -58,6 +70,15 @@ class _Handler(BaseHTTPRequestHandler):
 
     def log_message(self, fmt, *args):  # quiet by default; JSONL has it
         pass
+
+    def _wants_json(self) -> bool:
+        """Content negotiation for /metrics: Prometheus scrapers send no
+        Accept (or */*) and get the text exposition; a client that asks
+        for application/json gets the legacy JSON dict. The full q-value
+        dance is not worth stdlib-reimplementing — naming application/
+        json anywhere in Accept is the opt-in."""
+        accept = self.headers.get("Accept", "")
+        return "application/json" in accept.lower()
 
     def do_GET(self):  # noqa: N802 - http.server API
         srv = self.server
@@ -73,6 +94,15 @@ class _Handler(BaseHTTPRequestHandler):
                 },
             )
         elif self.path == "/metrics":
+            if self._wants_json():
+                self._send_json(200, srv.metrics())
+            else:
+                self._send_text(
+                    200,
+                    srv.registry.render_prometheus(),
+                    PROMETHEUS_CONTENT_TYPE,
+                )
+        elif self.path == "/metrics.json":
             self._send_json(200, srv.metrics())
         else:
             self._send_json(404, {"error": f"no such path {self.path!r}"})
@@ -170,16 +200,33 @@ class _QueryHTTPServer(ThreadingHTTPServer):
     # the overflow sees ECONNRESET. Observed under 8 synchronized clients.
     request_queue_size = 128
 
-    def __init__(self, addr, reader):
+    def __init__(self, addr, reader, registry=None):
         super().__init__(addr, _Handler)
         self.reader = reader
         self.batcher = None  # attached by QueryServer AFTER the bind
+        self.registry = registry or default_registry()
         self._stats_lock = threading.Lock()
         self._t0 = time.time()
         self._http_requests = 0
         self._http_errors = 0
         self._latency_total = 0.0
         self._latency_max = 0.0
+        # server_start_time makes uptime derivable from any scrape
+        # (time() - server_start_time), the Prometheus convention.
+        self.registry.gauge(
+            "gamesman_server_start_time_seconds",
+            "unix time the query server bound its port",
+        ).set(self._t0)
+        self._m_requests = self.registry.counter(
+            "gamesman_http_requests_total", "POST requests, rejects included"
+        )
+        self._m_errors = self.registry.counter(
+            "gamesman_http_errors_total", "POST requests answered >= 400"
+        )
+        self._m_latency = self.registry.histogram(
+            "gamesman_http_request_seconds",
+            "wall seconds per POST request, parse to response",
+        )
 
     def note_request(self, secs: float, code: int) -> None:
         with self._stats_lock:
@@ -188,6 +235,10 @@ class _QueryHTTPServer(ThreadingHTTPServer):
                 self._http_errors += 1
             self._latency_total += secs
             self._latency_max = max(self._latency_max, secs)
+        self._m_requests.inc()
+        if code >= 400:
+            self._m_errors.inc()
+        self._m_latency.observe(secs)
 
     def metrics(self) -> dict:
         with self._stats_lock:
@@ -197,6 +248,7 @@ class _QueryHTTPServer(ThreadingHTTPServer):
             peak = self._latency_max
             uptime = time.time() - self._t0
         return {
+            "server_start_time": self._t0,
             "uptime_secs": uptime,
             "http_requests": n,
             "http_errors": errors,
@@ -216,15 +268,17 @@ class QueryServer:
 
     def __init__(self, reader, *, host: str = "127.0.0.1", port: int = 0,
                  window: float = 0.002, cache_size: int = 65536,
-                 logger=None):
+                 logger=None, registry=None):
         self.reader = reader
         self.logger = logger
+        self.registry = registry or default_registry()
         # Bind FIRST: a bind failure (port in use) must raise before the
         # batcher spawns its worker thread, or every failed construction
         # would leak an unjoinable daemon thread.
-        self._httpd = _QueryHTTPServer((host, port), reader)
+        self._httpd = _QueryHTTPServer((host, port), reader, self.registry)
         self.batcher = Batcher(
-            reader, window=window, cache_size=cache_size, logger=logger
+            reader, window=window, cache_size=cache_size, logger=logger,
+            registry=self.registry,
         )
         self._httpd.batcher = self.batcher
         self._thread: threading.Thread | None = None

@@ -84,6 +84,7 @@ from gamesmanmpi_tpu.ops.mergesort import (
 )
 from gamesmanmpi_tpu.ops.lookup import lookup_window, search_method
 from gamesmanmpi_tpu.ops.padding import MIN_BUCKET, bucket_size, pad_to, pad_to_bucket
+from gamesmanmpi_tpu.obs import Heartbeat, Span, default_registry, trace_span
 from gamesmanmpi_tpu.solve.precompile import global_precompiler, sds
 from gamesmanmpi_tpu.utils.platform import backend_epoch, platform_auto_bool
 
@@ -432,6 +433,18 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+def _env_float(name: str, default: float) -> float:
+    """Float twin of _env_int (same degradation contract)."""
+    raw = os.environ.get(name, str(default))
+    try:
+        return float(raw)
+    except ValueError:
+        import warnings
+
+        warnings.warn(f"{name}={raw!r} is not a number; using {default}")
+        return default
+
+
 def _backward_block() -> int:
     """Max positions resolved per backward kernel call (per shard).
 
@@ -501,6 +514,7 @@ class Solver:
         force_generic: bool = False,
         store_tables: bool = True,
         level_sink=None,
+        heartbeat_secs: Optional[float] = None,
     ):
         self.game = game
         if min_bucket is None:
@@ -523,6 +537,14 @@ class Solver:
         #: level and never holds the full table in host memory
         #: (combine with store_tables=False).
         self.level_sink = level_sink
+        #: Heartbeat period in seconds (0 = off); None reads
+        #: GAMESMAN_HEARTBEAT_SECS. The heartbeat thread reads `progress`
+        #: (replaced atomically per level, never mutated in place) so a
+        #: wedged multi-hour solve still reports where it stopped.
+        if heartbeat_secs is None:
+            heartbeat_secs = _env_float("GAMESMAN_HEARTBEAT_SECS", 0.0)
+        self.heartbeat_secs = float(heartbeat_secs)
+        self.progress: dict = {"phase": "init"}
         self.fast = bool(game.uniform_level_jump) and not force_generic
         self.device_store_bytes = _device_store_bytes()
         self.backward_block = _backward_block()
@@ -858,7 +880,8 @@ class Solver:
         else:
             levels[k] = _Level(host0.shape[0], host0, frontier)
             if self.checkpointer is not None:
-                self.checkpointer.save_frontier_level(k, host0)
+                with trace_span("checkpoint", level=k, kind="frontier"):
+                    self.checkpointer.save_frontier_level(k, host0)
         stored_bytes = frontier.nbytes
         # Speculation hides the ~65 ms relay host-sync; on CPU the sync is
         # microseconds and a dropped speculative expand is real wasted work.
@@ -876,14 +899,20 @@ class Solver:
 
         pending = fwd_step(frontier)
         while True:
-            t0 = time.perf_counter()
+            sp = Span("forward", logger=self.logger, level=k)
+            self.progress = {
+                "phase": "forward", "level": k, "frontier": levels[k].n,
+            }
             cap = frontier.shape[0]
             uniq, count, uidx, prim = pending
             spec = spec_input = None
             if speculate:
                 spec_input = jax.lax.slice(uniq, (0,), (cap,))
                 spec = fwd_step(spec_input)
-            n = int(count)  # the one host sync per level
+            # The expand+dedup kernel retires AT this host sync (dispatch
+            # is async), so the dedup/sort wait is what this span times.
+            with trace_span("dedup", level=k):
+                n = int(count)  # the one host sync per level
             rec = levels[k]
             if uidx is not None:
                 extra = prim.nbytes + uidx.nbytes
@@ -893,6 +922,10 @@ class Solver:
                     rec.prim, rec.uidx = prim, uidx
                     stored_bytes += extra
             if n == 0:
+                # Terminal probe: the span's trace event is kept (its
+                # wait time is real) but no JSONL record — the per-level
+                # stream is unchanged from the hand-rolled log calls.
+                sp.end(log=False)
                 break
             if k + 1 >= g.num_levels:
                 # num_levels is the declared exclusive bound on level_of over
@@ -946,8 +979,9 @@ class Solver:
             levels[k + 1] = rec
             frontier = nxt
             if self.checkpointer is not None:
-                self.checkpointer.save_frontier_level(k + 1,
-                                                      rec.host_states())
+                with trace_span("checkpoint", level=k + 1, kind="frontier"):
+                    self.checkpointer.save_frontier_level(k + 1,
+                                                          rec.host_states())
             item = np.dtype(g.state_dtype).itemsize
             # Only operands of actual sorts count (the traffic denominator
             # must match the kernel the platform lowered).
@@ -960,17 +994,11 @@ class Solver:
                 # expand_core: one dedup sort + the compaction.
                 level_sort_bytes = cap * g.max_moves * (item + compaction)
             self.bytes_sorted += level_sort_bytes
-            if self.logger is not None:
-                self.logger.log(
-                    {
-                        "phase": "forward",
-                        "level": k,
-                        "frontier": levels[k].n,
-                        "children": n,
-                        "bytes_sorted": level_sort_bytes,
-                        "secs": time.perf_counter() - t0,
-                    }
-                )
+            sp.end(
+                frontier=levels[k].n,
+                children=n,
+                bytes_sorted=level_sort_bytes,
+            )
             k += 1
         return levels
 
@@ -1037,9 +1065,10 @@ class Solver:
                 self._sched_bwd(min(C, block), wcaps)
         prev = None  # (states_dev, values_dev, rem_dev) of level k+1, at its C
         for k in ks:
-            t0 = time.perf_counter()
+            sp = Span("backward", logger=self.logger, level=k)
             rec = levels[k]
             n = rec.n
+            self.progress = {"phase": "backward", "level": k, "n": n}
             C = common[k]
             if rec.dev is not None:
                 states_dev = rec.dev
@@ -1139,7 +1168,8 @@ class Solver:
             if table is not None and (self.store_tables or k == root_level):
                 resolved[k] = table
             if self.level_sink is not None and table is not None:
-                self.level_sink(k, table)
+                with trace_span("db_export", level=k, n=n):
+                    self.level_sink(k, table)
             prev = (states_dev, values_dev, rem_dev)
             rec.dev = None  # release the forward copy
             rec.prim = rec.uidx = None  # release provenance
@@ -1155,20 +1185,15 @@ class Solver:
                 rec.host = None
             self.bytes_sorted += lvl_sort_bytes
             self.bytes_gathered += lvl_gather_bytes
-            if self.logger is not None:
-                self.logger.log(
-                    {
-                        "phase": "backward",
-                        "level": k,
-                        "n": n,
-                        "resumed": from_checkpoint,
-                        "bytes_sorted": lvl_sort_bytes,
-                        "bytes_gathered": lvl_gather_bytes,
-                        "secs": time.perf_counter() - t0,
-                    }
-                )
+            sp.end(
+                n=n,
+                resumed=from_checkpoint,
+                bytes_sorted=lvl_sort_bytes,
+                bytes_gathered=lvl_gather_bytes,
+            )
             if self.checkpointer is not None and not from_checkpoint:
-                self.checkpointer.save_level(k, table)
+                with trace_span("checkpoint", level=k, kind="level"):
+                    self.checkpointer.save_level(k, table)
         return resolved
 
     # ---------------------------------------------------------- generic phase
@@ -1181,8 +1206,12 @@ class Solver:
             if k not in pools:
                 k += 1
                 continue
-            t0 = time.perf_counter()
+            sp = Span("forward", logger=self.logger, level=k)
             frontier = pools[k]
+            self.progress = {
+                "phase": "forward", "level": k,
+                "frontier": int(frontier.shape[0]),
+            }
             padded = pad_to_bucket(frontier, self.min_bucket)
             uniq, levels, count = self._fwd_generic(padded.shape[0])(
                 jnp.asarray(padded)
@@ -1195,33 +1224,31 @@ class Solver:
                 * (item + compaction_sort_bytes(item))
             )
             self.bytes_sorted += lvl_sort_bytes
-            n = int(count)
-            kids = np.asarray(uniq[:n])
-            kid_levels = np.asarray(levels[:n])
-            for lv in np.unique(kid_levels):
-                lv = int(lv)
-                if lv >= g.num_levels:
-                    raise SolverError(
-                        f"game {g.name}: children found at level {lv} but "
-                        f"num_levels={g.num_levels} — level_of/num_levels "
-                        "inconsistent"
-                    )
-                batch = kids[kid_levels == lv]
-                if lv in pools:
-                    pools[lv] = np.union1d(pools[lv], batch)
-                else:
-                    pools[lv] = batch
-            if self.logger is not None:
-                self.logger.log(
-                    {
-                        "phase": "forward",
-                        "level": k,
-                        "frontier": int(frontier.shape[0]),
-                        "children": n,
-                        "bytes_sorted": lvl_sort_bytes,
-                        "secs": time.perf_counter() - t0,
-                    }
-                )
+            # Generic-path dedup is two-stage: the kernel's sort-unique
+            # (whose wait is the int(count) sync) plus the host-side
+            # merge of multi-jump children into per-level pools.
+            with trace_span("dedup", level=k):
+                n = int(count)
+                kids = np.asarray(uniq[:n])
+                kid_levels = np.asarray(levels[:n])
+                for lv in np.unique(kid_levels):
+                    lv = int(lv)
+                    if lv >= g.num_levels:
+                        raise SolverError(
+                            f"game {g.name}: children found at level {lv} "
+                            f"but num_levels={g.num_levels} — level_of/"
+                            "num_levels inconsistent"
+                        )
+                    batch = kids[kid_levels == lv]
+                    if lv in pools:
+                        pools[lv] = np.union1d(pools[lv], batch)
+                    else:
+                        pools[lv] = batch
+            sp.end(
+                frontier=int(frontier.shape[0]),
+                children=n,
+                bytes_sorted=lvl_sort_bytes,
+            )
             k += 1
 
     def _backward_generic(self, pools: Dict[int, np.ndarray],
@@ -1244,10 +1271,11 @@ class Solver:
             else set()
         )
         for k in sorted(pools, reverse=True):
-            t0 = time.perf_counter()
+            sp = Span("backward", logger=self.logger, level=k)
             states = pools[k]
             padded = pad_to_bucket(states, self.min_bucket)
             n = states.shape[0]
+            self.progress = {"phase": "backward", "level": k, "n": int(n)}
             from_checkpoint = k in completed
             lvl_sort_bytes = lvl_gather_bytes = 0
             if from_checkpoint:
@@ -1300,7 +1328,8 @@ class Solver:
             if self.store_tables or k == root_level:
                 resolved[k] = table
             if self.level_sink is not None:
-                self.level_sink(k, table)
+                with trace_span("db_export", level=k, n=int(n)):
+                    self.level_sink(k, table)
             cap = padded.shape[0]
             pv = np.full(cap, UNDECIDED, dtype=np.uint8)
             pr = np.zeros(cap, dtype=np.int32)
@@ -1310,25 +1339,40 @@ class Solver:
             # Levels deeper than the lookback window can never be read again.
             for done in [d for d in padded_cache if d > k + g.max_level_jump]:
                 del padded_cache[done]
-            if self.logger is not None:
-                self.logger.log(
-                    {
-                        "phase": "backward",
-                        "level": k,
-                        "n": n,
-                        "resumed": from_checkpoint,
-                        "bytes_sorted": lvl_sort_bytes,
-                        "bytes_gathered": lvl_gather_bytes,
-                        "secs": time.perf_counter() - t0,
-                    }
-                )
+            sp.end(
+                n=n,
+                resumed=from_checkpoint,
+                bytes_sorted=lvl_sort_bytes,
+                bytes_gathered=lvl_gather_bytes,
+            )
             if self.checkpointer is not None and not from_checkpoint:
-                self.checkpointer.save_level(k, table)
+                with trace_span("checkpoint", level=k, kind="level"):
+                    self.checkpointer.save_level(k, table)
         return resolved
 
     # ------------------------------------------------------------------ solve
 
     def solve(self) -> SolveResult:
+        """Public entry: the solve body under an optional heartbeat.
+
+        The heartbeat thread (obs/heartbeat.py) reads `self.progress` —
+        replaced atomically at each phase/level boundary — and emits
+        periodic JSONL records + registry gauges, so a wedged multi-hour
+        solve reports its last known level, RSS, and device memory."""
+        hb = None
+        if self.heartbeat_secs > 0:
+            hb = Heartbeat(
+                self.heartbeat_secs,
+                progress=lambda: self.progress,
+                logger=self.logger,
+            ).start()
+        try:
+            return self._solve_impl()
+        finally:
+            if hb is not None:
+                hb.stop()
+
+    def _solve_impl(self) -> SolveResult:
         g = self.game
         t0 = time.perf_counter()
         # Platform-auto knob, resolved here (not in __init__) so a
@@ -1420,8 +1464,24 @@ class Solver:
             "bytes_sorted": self.bytes_sorted,
             "bytes_gathered": self.bytes_gathered,
         }
+        self.progress = {"phase": "done"}
         if self.logger is not None:
             self.logger.log({"phase": "done", **stats})
+        # Solve-level registry rollups: the counters a /metrics scrape (or
+        # --metrics-out dump) aggregates across every solve this process
+        # ran — the per-level breakdown lives in gamesman_span_seconds.
+        reg = default_registry()
+        reg.counter(
+            "gamesman_solves_total", "completed solves", game=g.name
+        ).inc()
+        reg.counter(
+            "gamesman_solve_positions_total",
+            "reachable positions solved", game=g.name,
+        ).inc(num_positions)
+        reg.histogram(
+            "gamesman_solve_seconds", "wall seconds per full solve",
+            game=g.name,
+        ).observe(t_total)
         return SolveResult(g, value, remoteness, resolved, stats)
 
 

@@ -10,6 +10,7 @@ bench.py computes it from these records.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -41,7 +42,19 @@ class JsonlLogger(_ClosingLogger):
         self._fh.write(json.dumps(rec, default=str) + "\n")
 
     def close(self) -> None:
-        self._fh.close()
+        """Flush-and-fsync, tolerating double-close: an aborted solve's
+        teardown may close both via the context manager and an explicit
+        close, and the tail records (the evidence of WHERE it died) must
+        be durable on disk, not in a lost OS buffer."""
+        if self._fh.closed:
+            return
+        try:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+        except (OSError, ValueError):
+            pass  # fs without fsync / already-invalid fd: best effort
+        finally:
+            self._fh.close()
 
 
 class StdoutLogger(_ClosingLogger):

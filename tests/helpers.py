@@ -27,6 +27,69 @@ def full_table(result):
     return out
 
 
+def parse_prometheus_text(text):
+    """Strict-enough parser for text exposition format v0.0.4: the test
+    oracle for GET /metrics and render_prometheus(). Returns
+    {family: {"type": kind, "help": str|None,
+              "samples": [(name, {label: value}, float)]}}.
+    Raises ValueError on anything a Prometheus scraper would reject
+    (unknown line shape, sample before TYPE, unparseable value)."""
+    import re
+
+    families = {}
+    current = None
+    sample_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$"
+    )
+    label_re = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_ = rest.partition(" ")
+            families.setdefault(
+                name, {"type": None, "help": None, "samples": []}
+            )["help"] = help_
+        elif line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            if kind not in ("counter", "gauge", "histogram", "summary",
+                            "untyped"):
+                raise ValueError(f"bad TYPE line: {line!r}")
+            families.setdefault(
+                name, {"type": None, "help": None, "samples": []}
+            )["type"] = kind
+            current = name
+        elif line.startswith("#"):
+            continue
+        else:
+            m = sample_re.match(line)
+            if not m:
+                raise ValueError(f"unparseable sample line: {line!r}")
+            name, labelstr, value = m.groups()
+            fam = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                base = name[: -len(suffix)] if name.endswith(suffix) else None
+                if base and base in families:
+                    fam = base
+                    break
+            if fam not in families or families[fam]["type"] is None:
+                # A lone HELP line does not make a family scrapeable.
+                raise ValueError(f"sample {name!r} before its TYPE line")
+            labels = dict(label_re.findall(labelstr or ""))
+            if value == "+Inf":
+                v = float("inf")
+            elif value == "-Inf":
+                v = float("-inf")
+            else:
+                v = float(value)  # raises ValueError on junk
+            families[fam]["samples"].append((name, labels, v))
+    if current is None and families:
+        raise ValueError("no TYPE lines")
+    return families
+
+
 def assert_table_parity(result, oracle_table):
     engine_table = full_table(result)
     assert len(engine_table) == len(oracle_table), (

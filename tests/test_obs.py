@@ -1,0 +1,500 @@
+"""obs/: metrics registry, span tracing, heartbeat, CLI artifacts.
+
+Acceptance axes (ISSUE 2): concurrent registry increments are exact,
+histogram boundaries are inclusive, Prometheus rendering survives a
+strict parser (golden file for the exact text), span nesting/timing is
+deterministic under a fake clock, and a real tictactoe solve driven
+through the CLI with --metrics-out + --trace-events + --checkpoint-dir
+produces artifacts that parse and whose span names cover the forward /
+dedup / backward / checkpoint phases while the per-level JSONL stays
+bench-compatible.
+"""
+
+import json
+import threading
+
+import pytest
+
+from gamesmanmpi_tpu.obs import (
+    Heartbeat,
+    MetricsRegistry,
+    Span,
+    TraceEventSink,
+    set_trace_sink,
+    trace_span,
+)
+from gamesmanmpi_tpu.obs.heartbeat import rss_bytes
+
+from helpers import REPO, load_module, parse_prometheus_text
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_counter_concurrent_increments_exact():
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", "concurrent counter")
+    n_threads, per_thread = 8, 2000
+
+    def worker():
+        for _ in range(per_thread):
+            c.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * per_thread
+
+
+def test_histogram_concurrent_observes_exact():
+    reg = MetricsRegistry()
+    h = reg.histogram("h_seconds", "concurrent histogram", buckets=(1, 10))
+
+    def worker(v):
+        for _ in range(1000):
+            h.observe(v)
+
+    threads = [
+        threading.Thread(target=worker, args=(v,)) for v in (0.5, 5, 50)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == 3000
+    assert h.sum == pytest.approx(0.5 * 1000 + 5 * 1000 + 50 * 1000)
+    snap = reg.snapshot()["h_seconds"]["values"][0]
+    assert snap["buckets"] == {"1": 1000, "10": 1000, "+Inf": 1000}
+
+
+def test_histogram_bucket_boundaries_inclusive():
+    """le is INCLUSIVE: a sample equal to a boundary lands in that
+    bucket (the Prometheus contract)."""
+    reg = MetricsRegistry()
+    h = reg.histogram("b_seconds", "", buckets=(0.1, 1.0))
+    for v in (0.1, 1.0, 1.0000001):
+        h.observe(v)
+    snap = reg.snapshot()["b_seconds"]["values"][0]
+    assert snap["buckets"] == {"0.1": 1, "1": 1, "+Inf": 1}
+    # Rendered cumulatively.
+    fams = parse_prometheus_text(reg.render_prometheus())
+    samples = {
+        (n, lb.get("le")): v for n, lb, v in fams["b_seconds"]["samples"]
+    }
+    assert samples[("b_seconds_bucket", "0.1")] == 1
+    assert samples[("b_seconds_bucket", "1")] == 2
+    assert samples[("b_seconds_bucket", "+Inf")] == 3
+    assert samples[("b_seconds_count", None)] == 3
+
+
+def test_registry_kind_conflicts_and_validation():
+    reg = MetricsRegistry()
+    reg.counter("x_total", "")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("x_total", "")
+    with pytest.raises(ValueError, match="only go up"):
+        reg.counter("x_total", "").inc(-1)
+    with pytest.raises(ValueError, match="invalid metric name"):
+        reg.counter("9bad", "")
+    with pytest.raises(ValueError, match="invalid metric name"):
+        reg.counter("bad-name", "")
+
+
+def test_prometheus_rendering_golden():
+    """Exact text, byte for byte: the exposition format is a wire
+    protocol, and an accidental reordering or escape change is a break
+    even when a lenient parser still accepts it."""
+    reg = MetricsRegistry()
+    reg.counter("req_total", 'requests with "quotes" and \\slash',
+                method="post", code="200").inc(3)
+    reg.counter("req_total", "", method="get", code="200").inc()
+    reg.gauge("temp_celsius", "ambient\nmultiline").set(21.5)
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.25, 1))
+    h.observe(0.1)
+    h.observe(3)
+    golden = (
+        "# HELP lat_seconds latency\n"
+        "# TYPE lat_seconds histogram\n"
+        'lat_seconds_bucket{le="0.25"} 1\n'
+        'lat_seconds_bucket{le="1"} 1\n'
+        'lat_seconds_bucket{le="+Inf"} 2\n'
+        "lat_seconds_sum 3.1\n"
+        "lat_seconds_count 2\n"
+        # HELP escapes only backslash and newline (label VALUES also
+        # escape quotes; help text does not — the v0.0.4 rule).
+        '# HELP req_total requests with "quotes" and \\\\slash\n'
+        "# TYPE req_total counter\n"
+        'req_total{code="200",method="get"} 1\n'
+        'req_total{code="200",method="post"} 3\n'
+        "# HELP temp_celsius ambient\\nmultiline\n"
+        "# TYPE temp_celsius gauge\n"
+        "temp_celsius 21.5\n"
+    )
+    assert reg.render_prometheus() == golden
+    # And it round-trips through the strict parser.
+    fams = parse_prometheus_text(golden)
+    assert fams["req_total"]["type"] == "counter"
+    assert fams["lat_seconds"]["type"] == "histogram"
+
+
+def test_snapshot_shape():
+    reg = MetricsRegistry()
+    reg.counter("c_total", "help", a="1").inc(2)
+    reg.gauge("g", "").set(7)
+    snap = reg.snapshot()
+    assert snap["c_total"]["type"] == "counter"
+    assert snap["c_total"]["values"] == [{"labels": {"a": "1"}, "value": 2.0}]
+    assert snap["g"]["values"][0]["value"] == 7.0
+    json.dumps(snap)  # JSON-serializable as-is (--metrics-out contract)
+
+
+def test_registered_instruments_export_zero_before_first_write():
+    """A scrape between registration and first write must show 0, not
+    'no data' — an error-rate alert cannot tell an unseeded counter from
+    a counter reset."""
+    reg = MetricsRegistry()
+    reg.counter("errs_total", "never incremented")
+    reg.histogram("lat_seconds", "never observed", buckets=(1,))
+    snap = reg.snapshot()
+    assert snap["errs_total"]["values"] == [{"labels": {}, "value": 0.0}]
+    assert snap["lat_seconds"]["values"][0]["count"] == 0
+    fams = parse_prometheus_text(reg.render_prometheus())
+    assert ("errs_total", {}, 0.0) in fams["errs_total"]["samples"]
+    assert ("lat_seconds_count", {}, 0.0) in fams["lat_seconds"]["samples"]
+
+
+def test_parser_rejects_sample_without_type_line():
+    with pytest.raises(ValueError, match="TYPE"):
+        parse_prometheus_text("# HELP x help only\nx 1\n")
+    with pytest.raises(ValueError, match="TYPE"):
+        parse_prometheus_text("y 1\n")
+
+
+# ----------------------------------------------------------------- spans
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+class _ListLogger:
+    def __init__(self):
+        self.records = []
+
+    def log(self, rec):
+        self.records.append(rec)
+
+
+def test_span_timing_and_jsonl_record_fake_clock():
+    clock = _FakeClock()
+    reg = MetricsRegistry()
+    logger = _ListLogger()
+    sp = Span("forward", logger=logger, registry=reg, clock=clock, level=3)
+    clock.t += 2.5
+    sp.end(frontier=10, children=40, bytes_sorted=320)
+    assert sp.secs == pytest.approx(2.5)
+    assert logger.records == [
+        {"phase": "forward", "level": 3, "frontier": 10, "children": 40,
+         "bytes_sorted": 320, "secs": pytest.approx(2.5)}
+    ]
+    # Idempotent end: a with-block exit after explicit end is a no-op.
+    clock.t += 50
+    assert sp.end() == pytest.approx(2.5)
+    assert len(logger.records) == 1
+    # Wall time landed in the registry histogram; integer payloads in the
+    # payload counters (level excluded — it is a coordinate, not a size).
+    snap = reg.snapshot()
+    spanrow = snap["gamesman_span_seconds"]["values"][0]
+    assert spanrow["labels"] == {"span": "forward"}
+    assert spanrow["sum"] == pytest.approx(2.5)
+    payloads = {
+        tuple(sorted(v["labels"].items())): v["value"]
+        for v in snap["gamesman_span_payload_total"]["values"]
+    }
+    assert payloads[(("key", "children"), ("span", "forward"))] == 40
+    assert (("key", "level"), ("span", "forward")) not in payloads
+
+
+def test_span_nesting_trace_events_fake_clock():
+    clock = _FakeClock()
+    reg = MetricsRegistry()
+    sink = TraceEventSink()
+    prev = set_trace_sink(sink)
+    try:
+        with trace_span("outer", registry=reg, clock=clock, level=1):
+            clock.t += 1.0
+            with trace_span("inner", registry=reg, clock=clock):
+                clock.t += 0.25
+            clock.t += 1.0
+    finally:
+        set_trace_sink(prev)
+    events = {e["name"]: e for e in sink.to_dict()["traceEvents"]}
+    assert events.keys() == {"outer", "inner"}
+    outer, inner = events["outer"], events["inner"]
+    assert outer["dur"] == pytest.approx(2.25e6)
+    assert inner["dur"] == pytest.approx(0.25e6)
+    # The inner span nests strictly inside the outer one on the
+    # timeline — what makes the Chrome/Perfetto flame view truthful.
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-6
+    assert outer["ph"] == inner["ph"] == "X"
+    assert outer["tid"] == inner["tid"]
+    assert outer["args"]["level"] == 1
+
+
+def test_span_records_time_on_exception():
+    clock = _FakeClock()
+    reg = MetricsRegistry()
+    with pytest.raises(RuntimeError):
+        with trace_span("doomed", registry=reg, clock=clock):
+            clock.t += 4.0
+            raise RuntimeError("mid-phase death")
+    row = reg.snapshot()["gamesman_span_seconds"]["values"][0]
+    assert row["labels"] == {"span": "doomed"}
+    assert row["sum"] == pytest.approx(4.0)
+
+
+def test_trace_sink_dump_is_valid_json(tmp_path):
+    sink = TraceEventSink()
+    sink.add_complete("phase", 1.0, 0.5, 7, {"n": 3, "obj": object()})
+    out = tmp_path / "trace.json"
+    sink.dump(out)
+    data = json.loads(out.read_text())
+    (ev,) = data["traceEvents"]
+    assert ev["name"] == "phase" and ev["dur"] == 0.5e6
+    assert isinstance(ev["args"]["obj"], str)  # exotic values stringified
+
+
+# ------------------------------------------------------------- heartbeat
+
+
+def test_heartbeat_beats_and_stops():
+    reg = MetricsRegistry()
+    logger = _ListLogger()
+    seen = []
+
+    def progress():
+        seen.append(1)
+        return {"phase": "forward", "level": 4}
+
+    hb = Heartbeat(0.01, progress=progress, logger=logger, registry=reg)
+    with hb:
+        while hb.beats < 3:
+            threading.Event().wait(0.005)
+    assert not hb._thread  # joined
+    recs = logger.records
+    assert len(recs) >= 3
+    # Progress nests: its own "phase" key must not let a heartbeat
+    # masquerade as a per-level record in the shared stream.
+    assert recs[0]["phase"] == "heartbeat"
+    assert recs[0]["progress"] == {"phase": "forward", "level": 4}
+    assert recs[0]["rss_bytes"] > 0
+    assert recs[0]["uptime_secs"] >= 0
+    snap = reg.snapshot()
+    assert snap["gamesman_heartbeat_beats_total"]["values"][0]["value"] >= 3
+    assert snap["gamesman_rss_bytes"]["values"][0]["value"] > 0
+
+
+def test_heartbeat_survives_broken_progress():
+    logger = _ListLogger()
+    hb = Heartbeat(
+        1, progress=lambda: 1 / 0, logger=logger, registry=MetricsRegistry()
+    )
+    rec = hb.beat()  # direct beat: no thread needed
+    assert rec["phase"] == "heartbeat"  # ZeroDivisionError swallowed
+
+
+def test_heartbeat_rejects_nonpositive_interval():
+    with pytest.raises(ValueError):
+        Heartbeat(0)
+
+
+def test_rss_bytes_reports_something():
+    assert rss_bytes() > 1 << 20  # a Python + jax process is > 1 MB
+
+
+def test_solver_heartbeat_integration():
+    """Solver(heartbeat_secs=...) emits heartbeat records carrying the
+    solver's live progress into the shared JSONL stream."""
+    from gamesmanmpi_tpu.games import get_game
+    from gamesmanmpi_tpu.solve import Solver
+
+    logger = _ListLogger()
+    Solver(
+        get_game("tictactoe"), logger=logger, heartbeat_secs=0.01
+    ).solve()
+    beats = [r for r in logger.records if r["phase"] == "heartbeat"]
+    assert beats, "no heartbeat records in a multi-interval solve"
+    assert any("level" in b.get("progress", {}) for b in beats)
+    assert all(b["rss_bytes"] > 0 for b in beats)
+    # The per-level stream is intact alongside the heartbeats.
+    phases = {r["phase"] for r in logger.records}
+    assert {"forward", "backward", "done"} <= phases
+
+
+# ------------------------------------------------------------ JsonlLogger
+
+
+def test_jsonl_logger_close_is_durable_and_reentrant(tmp_path):
+    from gamesmanmpi_tpu.utils.metrics import JsonlLogger, TeeLogger
+
+    path = tmp_path / "m.jsonl"
+    logger = JsonlLogger(str(path))
+    logger.log({"phase": "forward", "level": 0})
+    logger.close()
+    logger.close()  # double-close tolerated
+    # TeeLogger teardown after an explicit close (the abort path where
+    # both the finally and the context manager fire) is also safe.
+    tee = TeeLogger(JsonlLogger(str(path)))
+    tee.log({"phase": "backward", "level": 0})
+    tee.close()
+    tee.close()
+    records = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [r["phase"] for r in records] == ["forward", "backward"]
+
+
+# ----------------------------------------------- CLI artifacts (smoke tier)
+
+
+@pytest.mark.smoke
+def test_cli_solve_artifacts_parse_and_cover_phases(tmp_path, capsys):
+    """The acceptance run: a tictactoe solve with --metrics-out +
+    --trace-events (+ --jsonl + --checkpoint-dir) must leave three
+    parseable artifacts; the trace's span names must cover the forward,
+    dedup, backward, and checkpoint phases; the JSONL must still carry
+    the per-level schema bench.py and obs_report consume."""
+    from gamesmanmpi_tpu.cli import main as cli_main
+
+    trace = tmp_path / "trace.json"
+    metrics = tmp_path / "metrics.json"
+    jsonl = tmp_path / "m.jsonl"
+    rc = cli_main([
+        "tictactoe",
+        "--trace-events", str(trace),
+        "--metrics-out", str(metrics),
+        "--jsonl", str(jsonl),
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+    ])
+    assert rc == 0
+    assert "value: TIE" in capsys.readouterr().out
+
+    # 1. Chrome trace: valid JSON, complete events, phase coverage.
+    data = json.loads(trace.read_text())
+    events = data["traceEvents"]
+    assert events and all(e["ph"] == "X" for e in events)
+    names = {e["name"] for e in events}
+    assert {"forward", "dedup", "backward", "checkpoint"} <= names
+    assert all(e["dur"] >= 0 and "ts" in e for e in events)
+
+    # 2. Registry snapshot: valid JSON with the span histograms.
+    snap = json.loads(metrics.read_text())
+    spans = snap["gamesman_span_seconds"]
+    assert spans["type"] == "histogram"
+    span_labels = {v["labels"]["span"] for v in spans["values"]}
+    assert {"forward", "dedup", "backward", "checkpoint"} <= span_labels
+    assert "gamesman_solve_positions_total" in snap
+
+    # 3. Per-level JSONL: unchanged schema.
+    records = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    fwd = [r for r in records if r["phase"] == "forward"]
+    bwd = [r for r in records if r["phase"] == "backward"]
+    done = [r for r in records if r["phase"] == "done"]
+    assert fwd and bwd and len(done) == 1
+    assert {"level", "frontier", "children", "bytes_sorted", "secs"} <= set(
+        fwd[0]
+    )
+    assert {"level", "n", "resumed", "bytes_sorted", "secs"} <= set(bwd[0])
+    assert done[0]["positions"] == 5478
+
+    # 4. obs_report folds the stream into a per-level table.
+    obs_report = load_module(REPO / "tools" / "obs_report.py")
+    table = obs_report.report(records)
+    assert "TOTAL" in table and "5478" in table
+    rows = obs_report.summarize_levels(records)
+    assert sum(r["positions"] for r in rows) == 5478
+    assert all(r["bwd_secs"] > 0 for r in rows)
+
+
+@pytest.mark.smoke
+def test_obs_report_cli(tmp_path, capsys):
+    jsonl = tmp_path / "m.jsonl"
+    jsonl.write_text(
+        json.dumps({"phase": "forward", "level": 0, "frontier": 1,
+                    "children": 9, "bytes_sorted": 72, "secs": 0.5}) + "\n"
+        + json.dumps({"phase": "backward", "level": 0, "n": 1,
+                      "resumed": False, "bytes_sorted": 0,
+                      "bytes_gathered": 8, "secs": 0.25}) + "\n"
+        + "{torn line\n"
+        + json.dumps({"phase": "done", "game": "x", "positions": 10,
+                      "positions_per_sec": 13.3}) + "\n"
+    )
+    obs_report = load_module(REPO / "tools" / "obs_report.py")
+    assert obs_report.main([str(jsonl)]) == 0
+    out = capsys.readouterr().out
+    assert "TOTAL" in out
+    assert "done: game=x positions=10" in out
+    assert obs_report.main([str(tmp_path / "missing.jsonl")]) == 2
+
+
+# ------------------------------------------------- server exposition (HTTP)
+
+
+def test_server_metrics_prometheus_and_negotiation(tmp_path):
+    """curl /metrics returns valid Prometheus text exposition (strict
+    parser), Accept: application/json returns the JSON dict, and
+    /metrics.json always does."""
+    import urllib.request
+
+    from gamesmanmpi_tpu.db import DbReader, export_result
+    from gamesmanmpi_tpu.games import get_game
+    from gamesmanmpi_tpu.serve import QueryServer
+    from gamesmanmpi_tpu.solve import Solver
+
+    spec = "subtract:total=10,moves=1-2"
+    d = tmp_path / "db"
+    export_result(Solver(get_game(spec)).solve(), d, spec)
+    with DbReader(d) as reader, QueryServer(reader) as server:
+        base = f"http://127.0.0.1:{server.port}"
+        req = urllib.request.Request(
+            base + "/query",
+            data=json.dumps({"positions": [9, 3]}).encode(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.status == 200
+            json.loads(resp.read())
+
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as resp:
+            ctype = resp.headers["Content-Type"]
+            text = resp.read().decode()
+        assert ctype == "text/plain; version=0.0.4; charset=utf-8"
+        fams = parse_prometheus_text(text)  # raises on malformed output
+        assert fams["gamesman_http_requests_total"]["type"] == "counter"
+        assert fams["gamesman_server_start_time_seconds"]["type"] == "gauge"
+        (start_sample,) = fams["gamesman_server_start_time_seconds"]["samples"]
+        assert start_sample[2] > 1e9  # unix seconds: uptime is derivable
+        assert fams["gamesman_batch_seconds"]["type"] == "histogram"
+        assert fams["gamesman_db_probe_seconds"]["type"] == "histogram"
+        # The db reader's probe/page counters moved with real traffic.
+        (q,) = fams["gamesman_db_probe_queries_total"]["samples"]
+        assert q[2] >= 2
+        (pages,) = fams["gamesman_db_mmap_page_touches_total"]["samples"]
+        assert pages[2] > 0
+
+        # Content negotiation: JSON on request; /metrics.json always.
+        for path, hdrs in (
+            ("/metrics", {"Accept": "application/json"}),
+            ("/metrics.json", {}),
+        ):
+            req = urllib.request.Request(base + path, headers=hdrs)
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                body = json.loads(resp.read())
+            assert body["http_requests"] >= 1
+            assert body["server_start_time"] > 1e9
+            assert body["uptime_secs"] >= 0

@@ -118,7 +118,9 @@ def test_concurrent_queries_match_oracle_and_batch(nim_db):
         # Repeat the same traffic: answers now come from the LRU cache.
         _fire_concurrent(server, chunks)
 
-        status, metrics = _get(base + "/metrics")
+        # JSON counters moved to /metrics.json (Prometheus text owns
+        # /metrics; negotiation is covered in test_obs.py).
+        status, metrics = _get(base + "/metrics.json")
         assert status == 200
         assert metrics["batches"] >= 1
         assert metrics["mean_batch_size"] > 1  # coalescing happened
@@ -189,7 +191,7 @@ def test_http_error_paths(nim_db):
         assert e.value.code == 404
         # Rejects are visible in the counters: every POST lands in
         # http_requests, errors in http_errors.
-        _, metrics = _get(base + "/metrics")
+        _, metrics = _get(base + "/metrics.json")
         assert metrics["http_errors"] >= 2
         assert metrics["http_requests"] >= 3
 

@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Summarize a solve's JSONL metrics file into a per-level table.
+
+The --jsonl stream (utils/metrics.JsonlLogger) already answers "where
+did the level time go" — forward expand vs backward resolve, positions
+and operand bytes per level — but only as raw records. This tool folds
+it into the table an operator actually reads:
+
+    python tools/obs_report.py m.jsonl
+
+    level  positions   fwd_s   bwd_s  total_s      pos/s    sort_MB  gather_MB
+        0          1   0.012   0.009    0.021      47.6        0.0        0.0
+        ...
+    TOTAL       5478   0.310   0.270    0.580    9444.8        6.2        1.1
+
+    done: game=tictactoe positions=5478 pos/s=9444 ...
+
+Works on any stream the engine writes (classic, sharded, dense all share
+the phase/level/secs schema); serve_batch / heartbeat records are
+counted and reported but excluded from the level table. No third-party
+deps — stdlib only, CI-runnable (see tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_records(path: str) -> list[dict]:
+    """Parse a JSONL metrics file, skipping blank/torn lines (an aborted
+    solve's file may end mid-record; the intact prefix is the point)."""
+    records = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return records
+
+
+def summarize_levels(records: list[dict]) -> list[dict]:
+    """Fold forward/backward records into one row per level, sorted by
+    level. Repeated records for a level (sharded runs emit one per
+    process; retries re-log) accumulate seconds and keep the latest
+    sizes."""
+    levels: dict[int, dict] = {}
+    for rec in records:
+        phase = rec.get("phase")
+        if phase not in ("forward", "backward") or "level" not in rec:
+            continue
+        row = levels.setdefault(
+            int(rec["level"]),
+            {
+                "level": int(rec["level"]),
+                "positions": 0,
+                "fwd_secs": 0.0,
+                "bwd_secs": 0.0,
+                "bytes_sorted": 0,
+                "bytes_gathered": 0,
+            },
+        )
+        secs = float(rec.get("secs", 0.0))
+        row["bytes_sorted"] += int(rec.get("bytes_sorted", 0))
+        row["bytes_gathered"] += int(rec.get("bytes_gathered", 0))
+        if phase == "forward":
+            row["fwd_secs"] += secs
+            # The frontier size IS the level's position count; backward's
+            # n confirms it, and wins when present (forward records are
+            # absent for resumed runs).
+            if rec.get("frontier"):
+                row["positions"] = max(row["positions"],
+                                       int(rec["frontier"]))
+        else:
+            row["bwd_secs"] += secs
+            if rec.get("n"):
+                row["positions"] = max(row["positions"], int(rec["n"]))
+    return [levels[k] for k in sorted(levels)]
+
+
+def format_table(rows: list[dict]) -> str:
+    header = (
+        f"{'level':>5}  {'positions':>10}  {'fwd_s':>8}  {'bwd_s':>8}  "
+        f"{'total_s':>8}  {'pos/s':>12}  {'sort_MB':>9}  {'gather_MB':>9}"
+    )
+    lines = [header]
+    tot = {
+        "positions": 0, "fwd_secs": 0.0, "bwd_secs": 0.0,
+        "bytes_sorted": 0, "bytes_gathered": 0,
+    }
+    for r in rows:
+        total = r["fwd_secs"] + r["bwd_secs"]
+        pps = r["positions"] / total if total > 0 else 0.0
+        lines.append(
+            f"{r['level']:>5}  {r['positions']:>10}  {r['fwd_secs']:>8.3f}  "
+            f"{r['bwd_secs']:>8.3f}  {total:>8.3f}  {pps:>12.1f}  "
+            f"{r['bytes_sorted'] / 1e6:>9.1f}  "
+            f"{r['bytes_gathered'] / 1e6:>9.1f}"
+        )
+        for k in tot:
+            tot[k] += r[k]
+    total = tot["fwd_secs"] + tot["bwd_secs"]
+    pps = tot["positions"] / total if total > 0 else 0.0
+    lines.append(
+        f"{'TOTAL':>5}  {tot['positions']:>10}  {tot['fwd_secs']:>8.3f}  "
+        f"{tot['bwd_secs']:>8.3f}  {total:>8.3f}  {pps:>12.1f}  "
+        f"{tot['bytes_sorted'] / 1e6:>9.1f}  "
+        f"{tot['bytes_gathered'] / 1e6:>9.1f}"
+    )
+    return "\n".join(lines)
+
+
+def report(records: list[dict]) -> str:
+    """The full report: level table + done summary + aux record counts."""
+    out = [format_table(summarize_levels(records))]
+    for rec in records:
+        if rec.get("phase") == "done":
+            keys = ("game", "positions", "levels", "secs_forward",
+                    "secs_backward", "secs_total", "positions_per_sec")
+            out.append(
+                "done: " + " ".join(
+                    f"{k}={rec[k]:.3f}" if isinstance(rec.get(k), float)
+                    else f"{k}={rec.get(k)}"
+                    for k in keys if k in rec
+                )
+            )
+    aux = {}
+    for rec in records:
+        phase = rec.get("phase")
+        if phase not in ("forward", "backward", "done"):
+            aux[phase] = aux.get(phase, 0) + 1
+    if aux:
+        out.append(
+            "other records: " + " ".join(
+                f"{k}={v}" for k, v in sorted(aux.items())
+            )
+        )
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Per-level time/volume table from a --jsonl metrics "
+        "file (docs/OBSERVABILITY.md)."
+    )
+    p.add_argument("jsonl", help="metrics file written by --jsonl")
+    args = p.parse_args(argv)
+    try:
+        records = load_records(args.jsonl)
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not records:
+        print("error: no parseable records", file=sys.stderr)
+        return 2
+    print(report(records))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
