@@ -38,6 +38,10 @@ import time
 # BASELINE.md's north star: 4.5e12 positions in 1h on 32 chips.
 NORTH_STAR_PPS = 4.5e12 / 3600.0 / 32.0  # 39.06M pos/s/chip
 
+# DELIBERATE TWIN of gamesmanmpi_tpu/utils/platform.py's _PROBE_SRC (the
+# CLI's fail-fast probe): this parent must never import jax, and the
+# package __init__ imports jax at module level, so the source cannot be
+# shared by import — a fix to either copy must be mirrored in the other.
 _PROBE_SRC = r"""
 import faulthandler, sys, time
 # If init wedges, print every thread's stack to stderr before the parent's
@@ -218,6 +222,20 @@ def inner() -> int:
     """The actual measurement: runs entirely in one child process."""
     from gamesmanmpi_tpu.utils.platform import apply_platform_env
 
+    if (os.environ.get("BENCH_ENGINE") == "sharded"
+            and not os.environ.get("GAMESMAN_FAKE_DEVICES")):
+        # The sharded config needs a mesh; a CPU-pinned run fakes
+        # BENCH_SHARDS host devices (a real accelerator mesh is used
+        # as-is — make_solver clamps to the devices present). Parse and
+        # clamp HERE too: exporting a malformed BENCH_SHARDS raw would
+        # crash apply_platform_env's int() before any record prints,
+        # while make_solver deliberately tolerates it with the same
+        # try/except -> 8.
+        try:
+            shards = int(os.environ.get("BENCH_SHARDS", "8"))
+        except ValueError:
+            shards = 8
+        os.environ["GAMESMAN_FAKE_DEVICES"] = str(max(1, shards))
     apply_platform_env()
 
     import gamesmanmpi_tpu  # noqa: F401  (enables x64 before first trace)
@@ -253,8 +271,40 @@ def inner() -> int:
 
     def make_solver(game):
         nonlocal bench_engine
-        if bench_engine == "hybrid" and isinstance(game, Connect4) \
-                and not game.sym:
+        if bench_engine == "sharded":
+            # The owner-routed sharded engine over BENCH_SHARDS devices
+            # (fake host devices on CPU — see the GAMESMAN_FAKE_DEVICES
+            # defaulting at the top of inner()). This is the config the
+            # edge-cached backward A/B runs against: GAMESMAN_BACKWARD=
+            # edges|lookup selects the backward, and the record's
+            # secs_backward + efficiency.bytes_sorted carry the delta.
+            from gamesmanmpi_tpu.parallel import ShardedSolver
+
+            try:
+                shards = int(os.environ.get("BENCH_SHARDS", "8"))
+            except ValueError:
+                shards = 8
+            have = len(jax.devices())
+            if have < max(1, shards):
+                # Unpinned CPU boxes land here (GAMESMAN_FAKE_DEVICES is
+                # honored only under a GAMESMAN_PLATFORM pin): the solve
+                # still runs, but an "8-shard" A/B on 1 shard would be a
+                # silent lie — say so, and the record's `shards` field
+                # (from the solver's stats) carries the truth.
+                print(
+                    f"sharded bench: only {have} device(s) available, "
+                    f"requested {shards} shards — running {have}-shard "
+                    "(pin GAMESMAN_PLATFORM=cpu to fake a mesh)",
+                    file=sys.stderr,
+                )
+            shards = max(1, min(shards, have))
+            return ShardedSolver(game, num_shards=shards,
+                                 store_tables=False)
+        # HybridSolver accepts sym=1 since r5 (its BFS region keeps the
+        # mirror reduction; the dense region runs a sym-free twin), so the
+        # secondary sym run benches the SAME engine as the primary instead
+        # of silently demoting to classic (ADVICE r5).
+        if bench_engine == "hybrid" and isinstance(game, Connect4):
             try:
                 from gamesmanmpi_tpu.solve.hybrid import HybridSolver
 
@@ -318,7 +368,7 @@ def inner() -> int:
         `efficiency` with the roofline-aware version."""
         traffic = (stats.get("bytes_sorted", 0)
                    + stats.get("bytes_gathered", 0))
-        return {
+        rec = {
             "metric": f"{name}_positions_solved_per_sec_per_chip",
             "value": round(best_pps, 1),
             "unit": "positions/sec/chip",
@@ -347,6 +397,14 @@ def inner() -> int:
                     / 1e9, 3),
             },
         }
+        if "shards" in stats:
+            # Sharded engine only: the shard count that ACTUALLY ran (a
+            # device-starved box clamps below BENCH_SHARDS — see
+            # make_solver's warning; the record must not imply otherwise).
+            rec["shards"] = stats["shards"]
+        if "backward" in stats:
+            rec["backward"] = stats["backward"]
+        return rec
 
     def run_solves(game_spec: str, nruns: int, provisional: bool = False):
         """Best-of-N solve of one board; returns (best pps, best stats,
@@ -468,6 +526,10 @@ def inner() -> int:
                 "positions_per_sec": round(sym_pps, 1),
                 "median_pps": round(statistics.median(sym_runs), 1),
                 "positions": sym_stats["positions"],
+                # The engine that ACTUALLY ran the sym solve (ADVICE r5):
+                # engine-eligibility differs by sym, so without this field
+                # a demoted sym run is indistinguishable from the primary's.
+                "engine": sym_stats.get("engine", "classic"),
             }
         except Exception as e:  # pragma: no cover - diagnostic only
             print(f"sym bench failed: {e!r}", file=sys.stderr)

@@ -176,6 +176,17 @@ def build_parser() -> argparse.ArgumentParser:
         "between forward and backward; excess spills to host (env "
         "GAMESMAN_DEVICE_STORE_MB)",
     )
+    p.add_argument(
+        "--backward",
+        choices=("edges", "lookup"),
+        default=None,
+        help="sharded backward strategy: 'edges' (default) resolves each "
+        "level from the forward pass's stored edge indices — gathers + "
+        "collectives, no search, no re-expansion — falling back to "
+        "'lookup' per level where edges are missing (pre-edge "
+        "checkpoints, multi-jump games); 'lookup' forces the owner-"
+        "routed search join everywhere (env GAMESMAN_BACKWARD)",
+    )
     # Multi-host bring-up (SURVEY.md §5.8 control plane): one process per
     # host, jax.distributed over DCN, mesh over all addressable devices.
     # docs/ARCHITECTURE.md "Multi-host launch" shows a v4-32 example.
@@ -315,6 +326,7 @@ def main(argv=None) -> int:
         (args.window_block, "GAMESMAN_WINDOW_BLOCK"),
         (args.device_store_mb, "GAMESMAN_DEVICE_STORE_MB"),
         (args.heartbeat_secs, "GAMESMAN_HEARTBEAT_SECS"),
+        (args.backward, "GAMESMAN_BACKWARD"),
     ):
         if flag is not None:
             saved_env[env] = os.environ.get(env)
@@ -329,12 +341,66 @@ def main(argv=None) -> int:
                 os.environ[env] = old
 
 
+def _maybe_probe_backend() -> bool:
+    """Bench-style fail-fast platform probe (VERDICT r5).
+
+    The bare CLI used to wedge >300 s at first backend touch when the
+    axon relay was dead — no error, no output. When the backend about to
+    initialize is a non-CPU plugin and nothing has pinned the platform,
+    probe it in a throwaway subprocess under a deadline
+    (GAMESMAN_PROBE_TIMEOUT, default 120 s) and fail with a clear message
+    instead. Returns False when the backend is dead (caller exits).
+    Skipped when: probing is disabled (GAMESMAN_PROBE=0), the platform is
+    explicitly pinned (GAMESMAN_PLATFORM — the user chose), backends are
+    already initialized in this process (too late to help), or the first
+    platform to initialize is the CPU (cannot wedge on a relay).
+    """
+    if os.environ.get("GAMESMAN_PROBE", "auto") in ("0", "off", "false"):
+        return True
+    if os.environ.get("GAMESMAN_PLATFORM"):
+        return True
+    import jax
+    from jax._src import xla_bridge
+
+    if xla_bridge.backends_are_initialized():
+        return True
+    # Probe only when a non-CPU platform is explicitly first in line (the
+    # plugin-pinned container's sitecustomize sets jax_platforms=
+    # "axon,cpu"): plain auto-detect environments and CPU pins cannot
+    # wedge on a dead relay, and the probe would cost them a jax-import
+    # subprocess per solve for nothing.
+    first_cfg = str(getattr(jax.config, "jax_platforms", None) or "") \
+        .split(",")[0].strip().lower()
+    first_env = os.environ.get("JAX_PLATFORMS", "") \
+        .split(",")[0].strip().lower()
+    if first_cfg in ("", "cpu") and first_env in ("", "cpu"):
+        return True
+    from gamesmanmpi_tpu.utils.platform import probe_backend
+
+    try:
+        timeout = float(os.environ.get("GAMESMAN_PROBE_TIMEOUT", 120.0))
+    except ValueError:
+        timeout = 120.0
+    if probe_backend(timeout) is not None:
+        return True
+    print(
+        f"error: accelerator backend failed to initialize within "
+        f"{timeout:.0f}s (dead relay?). Set GAMESMAN_PLATFORM=cpu to "
+        "solve on the CPU, GAMESMAN_PROBE_TIMEOUT to wait longer, or "
+        "GAMESMAN_PROBE=0 to skip this check.",
+        file=sys.stderr,
+    )
+    return False
+
+
 def _main(args) -> int:
     from gamesmanmpi_tpu.utils.platform import apply_platform_env
 
     # Honor GAMESMAN_PLATFORM=cpu|tpu|axon (and GAMESMAN_FAKE_DEVICES) before
     # any backend init; --devices N on a faked-CPU run needs >= N devices.
     apply_platform_env(default_fake_devices=max(args.devices, 1))
+    if not _maybe_probe_backend():
+        return 3
     if args.coordinator:
         # Must run before the first backend touch so every process joins the
         # same PJRT world; the mesh then spans all addressable devices.

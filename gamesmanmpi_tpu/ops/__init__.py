@@ -11,6 +11,7 @@ from gamesmanmpi_tpu.ops.padding import bucket_size, pad_to_bucket
 from gamesmanmpi_tpu.ops.dedup import sort_unique
 from gamesmanmpi_tpu.ops.lookup import lookup_sorted, lookup_window
 from gamesmanmpi_tpu.ops.combine import combine_children
+from gamesmanmpi_tpu.ops.provenance import dedup_provenance, gather_cells
 
 __all__ = [
     "bucket_size",
@@ -19,4 +20,6 @@ __all__ = [
     "lookup_sorted",
     "lookup_window",
     "combine_children",
+    "dedup_provenance",
+    "gather_cells",
 ]

@@ -67,22 +67,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from gamesmanmpi_tpu.core.codec import pack_cells, unpack_cells
+from gamesmanmpi_tpu.core.codec import unpack_cells
 from gamesmanmpi_tpu.core.values import UNDECIDED
 from gamesmanmpi_tpu.games.base import TensorGame
 from gamesmanmpi_tpu.ops.combine import combine_children
 from gamesmanmpi_tpu.ops.dedup import (
     compact_method,
-    compact_sorted,
     compaction_sort_bytes,
     sort_unique,
 )
-from gamesmanmpi_tpu.ops.mergesort import (
-    backend_key,
-    sort_with_payload,
-    use_merge_sort,
-)
+from gamesmanmpi_tpu.ops.mergesort import backend_key, use_merge_sort
 from gamesmanmpi_tpu.ops.lookup import lookup_window, search_method
+from gamesmanmpi_tpu.ops.provenance import dedup_provenance, gather_cells
 from gamesmanmpi_tpu.ops.padding import MIN_BUCKET, bucket_size, pad_to, pad_to_bucket
 from gamesmanmpi_tpu.obs import Heartbeat, Span, default_registry, trace_span
 from gamesmanmpi_tpu.solve.precompile import global_precompiler, sds
@@ -338,25 +334,14 @@ def expand_provenance(game: TensorGame, states, merge: bool | None = None,
     sort) preserves that knowledge, so the backward pass needs NO search and
     NO re-expansion — child values become a single gather (see
     resolve_provenance). Costs one extra pair sort in forward; saves the
-    sort-merge join (the backward pass's dominant cost) per level.
+    sort-merge join (the backward pass's dominant cost) per level. The
+    pair-sort core is shared with the sharded engine's edge-cached backward
+    (ops/provenance.dedup_provenance).
     """
     prim = game.primitive(states)
     active = (states != game.sentinel) & (prim == UNDECIDED)
     children, _ = canonical_children(game, states, active)
-    flat = children.reshape(-1)
-    origin = jax.lax.iota(jnp.int32, flat.shape[0])
-    # Sorts dispatch through ops.mergesort: XLA's network by default, the
-    # elementwise merge ladder under GAMESMAN_SORT=merge.
-    s, o = sort_with_payload(flat, origin, merge)
-    first = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
-    keep = first & (s != game.sentinel)
-    # Every slot in a duplicate run shares the survivor's unique-index
-    # (cumsum over run-first markers is constant within the run).
-    uid = jnp.cumsum(keep.astype(jnp.int32)) - 1
-    uid = jnp.where(s != game.sentinel, uid, -1)
-    _, uidx = sort_with_payload(o, uid, merge)
-    uniq = compact_sorted(s, keep, merge, compact)
-    count = jnp.sum(keep).astype(jnp.int32)
+    uniq, count, uidx = dedup_provenance(children.reshape(-1), merge, compact)
     return uniq, count, uidx, prim
 
 
@@ -377,9 +362,7 @@ def resolve_provenance(n, prim, uidx, wvals, wrem, max_moves: int):
     undecided = valid & (prim == UNDECIDED)
     m = uidx.reshape(C, max_moves)
     mask = (m >= 0) & undecided[:, None]
-    cells = pack_cells(wvals, wrem)
-    got = cells[jnp.clip(m, 0, cells.shape[0] - 1)]
-    cv, cr = unpack_cells(got)
+    cv, cr = unpack_cells(gather_cells(m, wvals, wrem))
     values, remoteness = combine_children(cv, cr, mask)
     values = jnp.where(
         undecided, values,

@@ -288,6 +288,15 @@ def global_precompiler() -> Precompiler:
     return _GLOBAL
 
 
-def sds(shape, dtype) -> jax.ShapeDtypeStruct:
-    """Shorthand ShapeDtypeStruct for schedule() avals."""
+def sds(shape, dtype, sharding=None) -> jax.ShapeDtypeStruct:
+    """Shorthand ShapeDtypeStruct for schedule() avals.
+
+    sharding: pass the NamedSharding the kernel will actually be called
+    with for mesh-partitioned (shard_map) kernels — an AOT executable is
+    strict about input shardings, so scheduling one with unsharded avals
+    would compile a program the call site then rejects. The sharded
+    engine's edge-backward prescheduling is the first user.
+    """
+    if sharding is not None:
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
     return jax.ShapeDtypeStruct(shape, dtype)

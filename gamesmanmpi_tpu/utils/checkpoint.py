@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import os
 import pathlib
+import zipfile
 
 import jax.numpy as jnp
 import numpy as np
@@ -24,27 +25,54 @@ from gamesmanmpi_tpu.core.codec import (
     unpack_cells_np,
 )
 
+#: What a torn/truncated/deleted npz read can raise (ADVICE r5): missing
+#: file, a zip whose central directory never landed, a short read surfacing
+#: as a bare OSError, a zip that lost a member (KeyError on z["name"]), or
+#: overwritten-with-garbage content (np.load raises ValueError when the
+#: bytes are neither zip nor npy). Loaders that degrade to an intact
+#: prefix catch exactly this tuple.
+TORN_NPZ_ERRORS = (
+    FileNotFoundError, zipfile.BadZipFile, OSError, KeyError, ValueError
+)
+
 
 def _savez(path, **arrays) -> None:
-    """Compressed below ~64 MB, raw above.
+    """Atomic npz write: tmp + os.replace; compressed below ~64 MB.
 
-    Small-game checkpoints compress well and stay tidy; at big-run scale
-    the payload is high-entropy packed bitboards where zlib costs
-    ~50 MB/s/core for single-digit-percent savings — raw npz writes at
-    disk speed. Override with GAMESMAN_CKPT_COMPRESS=0/1.
+    Atomicity (ADVICE r5): resumed runs RE-save levels whose files already
+    exist while the manifest still seals them — a death mid-overwrite
+    would otherwise leave a sealed-but-truncated npz that kills the next
+    resume with zipfile.BadZipFile instead of degrading to the intact
+    prefix. The tmp name is per-writer (pid), same discipline as the
+    manifest's.
+
+    Compression: small-game checkpoints compress well and stay tidy; at
+    big-run scale the payload is high-entropy packed bitboards where zlib
+    costs ~50 MB/s/core for single-digit-percent savings — raw npz writes
+    at disk speed. Override with GAMESMAN_CKPT_COMPRESS=0/1.
     """
-    import os
-
     total = sum(a.nbytes for a in arrays.values())
     flag = os.environ.get("GAMESMAN_CKPT_COMPRESS", "auto")
     if flag == "auto":
         compress = total < (64 << 20)
     else:
         compress = flag not in ("0", "off", "false")
-    if compress:
-        np.savez_compressed(path, **arrays)
-    else:
-        np.savez(path, **arrays)
+    path = pathlib.Path(path)
+    if path.suffix != ".npz":
+        # np.savez appends .npz to extension-less paths; the atomic
+        # tmp+replace write must keep that contract (`--table-out results`
+        # has always produced results.npz — silently writing `results`
+        # would leave a stale results.npz for consumers to read).
+        path = path.with_name(path.name + ".npz")
+    tmp = path.with_suffix(f".{os.getpid()}.tmp.npz")
+    try:
+        if compress:
+            np.savez_compressed(tmp, **arrays)
+        else:
+            np.savez(tmp, **arrays)
+        os.replace(tmp, path)
+    finally:
+        tmp.unlink(missing_ok=True)
 
 
 class LevelCheckpointer:
@@ -245,6 +273,45 @@ class LevelCheckpointer:
         values, remoteness = unpack_cells_np(cells[idx[0] : idx[0] + 1])
         return int(values[0]), int(remoteness[0])
 
+    # ------------------------------------------------ edges (per-shard)
+    # The sharded engine's forward edge provenance (ISSUE 3): one npz per
+    # (level, shard) holding that shard's edge-index row (eidx — each
+    # child's unique-index within its owner's next-level slice, in routing
+    # order) and its reply-slot map (slot). Sealed with the geometry the
+    # backward must validate on resume: shard count, routing capacity
+    # (ecap) and slot length (level capacity x max_moves). A level absent
+    # here simply falls back to the lookup backward — pre-edge checkpoint
+    # directories keep resuming unchanged.
+
+    def _edges_path(self, level: int, shard: int) -> pathlib.Path:
+        return self.dir / f"edges_{level:04d}.shard_{shard:04d}.npz"
+
+    def save_edges_shard(self, level: int, shard: int, eidx, slot) -> None:
+        _savez(
+            self._edges_path(level, shard),
+            eidx=np.asarray(eidx, dtype=np.int32),
+            slot=np.asarray(slot, dtype=np.int32),
+        )
+
+    def finish_edges_level(self, level: int, num_shards: int, ecap: int,
+                           slot_len: int) -> None:
+        """Seal one level's edge-shard set (process 0, post-barrier)."""
+        manifest = self.load_manifest()
+        manifest.setdefault("edge_levels", {})[str(level)] = {
+            "shards": num_shards, "ecap": int(ecap),
+            "slot_len": int(slot_len),
+        }
+        self._write_manifest(manifest)
+
+    def edge_level_info(self, level: int):
+        """{"shards", "ecap", "slot_len"} of a sealed edge level, or None."""
+        return self.load_manifest().get("edge_levels", {}).get(str(level))
+
+    def load_edges_shard(self, level: int, shard: int):
+        """-> (eidx [S*ecap] int32, slot [cap*M] int32) of one shard."""
+        with np.load(self._edges_path(level, shard)) as z:
+            return z["eidx"], z["slot"]
+
     # Incremental per-(level, shard) forward saves — the sharded analog of
     # save_frontier_level: written as each level is discovered, superseded
     # by the consolidated per-shard snapshot once forward completes (the
@@ -288,11 +355,13 @@ class LevelCheckpointer:
                     )
                     with np.load(path) as z:
                         arrs.append(z["states"])
-            except FileNotFoundError:
-                # Torn directory (e.g. a death between unlink and manifest
-                # write in an older layout): keep the intact prefix below
-                # this level — at big-run scale the prefix is hours of
-                # re-discovery — and re-run forward from its deepest.
+            except TORN_NPZ_ERRORS:
+                # Torn directory (a death between unlink and manifest
+                # write in an older layout, or mid-resave before _savez
+                # became atomic — BadZipFile/short-read OSError/KeyError,
+                # ADVICE r5): keep the intact prefix below this level —
+                # at big-run scale the prefix is hours of re-discovery —
+                # and re-run forward from its deepest.
                 break
             out[int(k)] = arrs
         return out

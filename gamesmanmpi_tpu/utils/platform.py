@@ -105,6 +105,77 @@ def apply_platform_env(default_fake_devices: int | None = None) -> None:
     force_platform(platform, fake_devices)
 
 
+# DELIBERATE TWIN of bench.py's _PROBE_SRC (same staged prints, same
+# PROBE_OK protocol, same faulthandler deadline trick): bench's parent
+# process must never import jax, and this package's __init__ imports jax
+# at module level, so bench cannot reuse this module — a fix to either
+# probe source must be mirrored in the other.
+_PROBE_SRC = r"""
+import faulthandler, sys, time
+# If init wedges, print every thread's stack to stderr before the parent's
+# deadline so the parent can capture *where* it hung (relay dial, compile
+# RPC, device enumeration, ...).
+faulthandler.dump_traceback_later({dump_after}, exit=False, file=sys.stderr)
+t0 = time.time()
+import jax
+print(f"probe: jax imported in {{time.time()-t0:.1f}}s", file=sys.stderr)
+t0 = time.time()
+devs = jax.devices()
+print(f"probe: jax.devices() -> {{devs}} in {{time.time()-t0:.1f}}s",
+      file=sys.stderr)
+import jax.numpy as jnp
+t0 = time.time()
+x = jnp.arange(1024, dtype=jnp.uint32)
+y = jnp.sort(x).block_until_ready()
+print(f"probe: first kernel in {{time.time()-t0:.1f}}s", file=sys.stderr)
+faulthandler.cancel_dump_traceback_later()
+print("PROBE_OK", devs[0].platform)
+"""
+
+
+def probe_backend(timeout: float) -> str | None:
+    """Probe backend init in a throwaway subprocess, under a deadline.
+
+    Returns the platform string on success, None on failure/hang. The
+    relayed accelerator backend's observed failure mode is WEDGING at
+    first touch (no error, no timeout of its own) — an in-process solve
+    would hang >300 s with zero output. The subprocess inherits the
+    environment, costs one jax import, and on a hang dumps every thread's
+    stack to stderr shortly before the deadline so the operator sees
+    *where* it hung (relay dial, compile RPC, device enumeration). The
+    same probe bench.py has always run (see the twin-source note on
+    _PROBE_SRC), shared here so the bare CLI fails fast too (VERDICT r5).
+    """
+    import subprocess
+    import sys
+
+    src = _PROBE_SRC.format(dump_after=max(timeout - 15.0, 5.0))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", src],
+            timeout=timeout, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired as e:
+        for stream in (e.stderr, e.stdout):
+            if stream:
+                sys.stderr.write(
+                    stream if isinstance(stream, str)
+                    else stream.decode(errors="replace")
+                )
+        print(f"backend probe: timed out after {timeout:.0f}s "
+              "(stacks above)", file=sys.stderr)
+        return None
+    if proc.returncode == 0:
+        for line in proc.stdout.splitlines():
+            if line.startswith("PROBE_OK"):
+                return line.split()[1]
+    if proc.stderr:
+        sys.stderr.write(proc.stderr)
+    print(f"backend probe: child exited rc={proc.returncode}",
+          file=sys.stderr)
+    return None
+
+
 def platform_auto_flag(name: str, accel: str, cpu: str,
                        choices: tuple[str, ...]) -> str:
     """Resolve an env knob with platform-auto default, strictly.

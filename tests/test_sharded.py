@@ -102,8 +102,13 @@ def test_route_headroom_knob(monkeypatch):
         ShardedSolver(get_game("tictactoe"), num_shards=4)
 
 
-def test_sharded_blocked_backward_parity():
-    """Column-blocked owner-routed backward: same tables, bounded temporaries."""
+@pytest.mark.parametrize("mode", ["edges", "lookup"])
+def test_sharded_blocked_backward_parity(mode, monkeypatch):
+    """Column-blocked owner-routed backward: same tables, bounded
+    temporaries. Parametrized over GAMESMAN_BACKWARD so the lookup join's
+    blocking keeps coverage now that edges is the default (the edges
+    resolve is gather-only and ignores the resolve-side blocking)."""
+    monkeypatch.setenv("GAMESMAN_BACKWARD", mode)
     single = Solver(get_game("tictactoe")).solve()
     solver = ShardedSolver(get_game("tictactoe"), num_shards=8, paranoid=True)
     solver.backward_block = 256
@@ -156,9 +161,14 @@ def test_sharded_window_streaming_parity(spec):
     assert full_table(result) == full_table(single)
 
 
-def test_sharded_window_streaming_composes_with_blocked_backward():
+@pytest.mark.parametrize("mode", ["edges", "lookup"])
+def test_sharded_window_streaming_composes_with_blocked_backward(
+        mode, monkeypatch):
     """Both blockings at once: resolving side in column blocks AND window
-    side streamed — the full 7x6 memory shape."""
+    side streamed — the full 7x6 memory shape, in both backward modes
+    (edges streams only the window cells; lookup also blocks the
+    resolving side)."""
+    monkeypatch.setenv("GAMESMAN_BACKWARD", mode)
     single = Solver(get_game("tictactoe")).solve()
     solver = ShardedSolver(get_game("tictactoe"), num_shards=8, paranoid=True)
     solver.window_block = 128

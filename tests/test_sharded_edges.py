@@ -1,0 +1,252 @@
+"""Edge-cached provenance backward for the sharded engine (ISSUE 3).
+
+Contracts under test, on the faked 8-device CPU mesh:
+
+* A/B parity: GAMESMAN_BACKWARD=edges and =lookup produce byte-identical
+  (value, remoteness) tables — and both match the single-device solver,
+  whose own tables are oracle-tested in test_engine/test_games — on the
+  fast path (tictactoe, connect4 4x4) and the generic multi-jump path
+  (nim, chomp), where edges structurally fall back to lookup.
+* The edges backward does NO sorting: per-level backward bytes_sorted is
+  exactly zero (the forward pays the provenance pair sorts instead).
+* Edge-spill resume: a run killed after forward resumes from the sealed
+  frontier snapshot AND the per-(level, shard) edge files, running the
+  edge-cached backward — not the lookup join — from disk.
+* Structural fallback: a pre-edge checkpoint (no edge files) resumes via
+  the lookup backward without error.
+* Checkpoint atomicity (ADVICE r5): _savez never leaves a torn file
+  visible, and a corrupted sealed npz degrades resume to the intact
+  prefix instead of raising BadZipFile.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from gamesmanmpi_tpu.games import get_game
+from gamesmanmpi_tpu.parallel import ShardedSolver
+from gamesmanmpi_tpu.solve import Solver
+from gamesmanmpi_tpu.solve.engine import SolverError
+from gamesmanmpi_tpu.utils.checkpoint import LevelCheckpointer
+
+from helpers import full_table
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (fake) devices"
+)
+
+
+class _RecordingLogger:
+    def __init__(self):
+        self.records = []
+
+    def log(self, rec):
+        self.records.append(rec)
+
+
+def _phase_sum(records, phases, key):
+    return sum(r.get(key, 0) for r in records if r.get("phase") in phases)
+
+
+@pytest.mark.parametrize(
+    "spec",
+    ["tictactoe", "connect4:w=4,h=4", "nim:heaps=3-4-5", "chomp:w=3,h=3"],
+)
+def test_edges_lookup_ab_parity(spec, monkeypatch):
+    """Byte-identical tables across both backward modes and the oracle-
+    exact single-device solver; edges actually ran where they can."""
+    single = Solver(get_game(spec), paranoid=True).solve()
+    monkeypatch.setenv("GAMESMAN_BACKWARD", "edges")
+    se = ShardedSolver(get_game(spec), num_shards=8, paranoid=True)
+    redges = se.solve()
+    monkeypatch.setenv("GAMESMAN_BACKWARD", "lookup")
+    sl = ShardedSolver(get_game(spec), num_shards=8, paranoid=True)
+    rlookup = sl.solve()
+    assert sl.backward_edges_levels == 0
+    fast = bool(get_game(spec).uniform_level_jump)
+    if fast:
+        # Every level but the deepest (no deeper window to point into).
+        assert se.backward_edges_levels == len(redges.levels) - 1
+    else:
+        # Generic multi-jump path: structural fallback, no edges at all.
+        assert se.backward_edges_levels == 0
+    t_edges, t_lookup, t_single = (
+        full_table(redges), full_table(rlookup), full_table(single)
+    )
+    assert t_edges == t_lookup
+    assert t_edges == t_single
+    assert (redges.value, redges.remoteness) == (single.value,
+                                                 single.remoteness)
+
+
+def test_edges_backward_sorts_nothing(monkeypatch):
+    """The roofline contract: backward levels contribute ZERO sort bytes
+    in edges mode (lookup mode's join sorts are the comparison), and the
+    per-level records say which backward ran (docs/OBSERVABILITY.md)."""
+    monkeypatch.setenv("GAMESMAN_SEARCH", "sort")  # join = sort bytes
+    monkeypatch.setenv("GAMESMAN_BACKWARD", "edges")
+    log_e = _RecordingLogger()
+    ShardedSolver(
+        get_game("tictactoe"), num_shards=8, logger=log_e
+    ).solve()
+    bwd = [r for r in log_e.records
+           if r["phase"] in ("backward", "backward_edges")]
+    assert any(r["phase"] == "backward_edges" for r in bwd)
+    assert all(r["mode"] == "edges" for r in bwd
+               if r["phase"] == "backward_edges")
+    assert _phase_sum(bwd, ("backward", "backward_edges"),
+                      "bytes_sorted") == 0
+
+    monkeypatch.setenv("GAMESMAN_BACKWARD", "lookup")
+    log_l = _RecordingLogger()
+    ShardedSolver(
+        get_game("tictactoe"), num_shards=8, logger=log_l
+    ).solve()
+    assert _phase_sum(log_l.records, ("backward",), "bytes_sorted") > 0
+
+
+def test_edges_precompile_scheduling_parity(monkeypatch):
+    """GAMESMAN_PRECOMPILE=1 schedules the edge-backward shapes as
+    background AOT compiles (sharded avals); the fetched executables must
+    produce the same tables as inline jit — this is the only CPU coverage
+    the accelerator-default scheduling path gets."""
+    monkeypatch.setenv("GAMESMAN_BACKWARD", "edges")
+    monkeypatch.setenv("GAMESMAN_PRECOMPILE", "1")
+    single = Solver(get_game("tictactoe")).solve()
+    solver = ShardedSolver(get_game("tictactoe"), num_shards=8)
+    assert solver.precompile
+    r = solver.solve()
+    assert solver.backward_edges_levels > 0
+    assert full_table(r) == full_table(single)
+
+
+def test_edges_strict_knob_parse(monkeypatch):
+    monkeypatch.setenv("GAMESMAN_BACKWARD", "fast")
+    with pytest.raises(SolverError, match="GAMESMAN_BACKWARD"):
+        ShardedSolver(get_game("tictactoe"), num_shards=2)
+
+
+def test_edges_with_window_streaming_and_store_tables_false(monkeypatch):
+    """Big-run composition: host-spilled windows stream their cell blocks
+    through the edge gather (window_stream_blocks observable), and
+    nothing but the root answer leaves the devices."""
+    monkeypatch.setenv("GAMESMAN_BACKWARD", "edges")
+    single = Solver(get_game("tictactoe")).solve()
+    solver = ShardedSolver(
+        get_game("tictactoe"), num_shards=8, store_tables=False
+    )
+    solver.window_block = 128
+    r = solver.solve()
+    assert solver.backward_edges_levels > 0
+    assert solver.window_stream_blocks > 0
+    assert (r.value, r.remoteness) == (single.value, single.remoteness)
+    assert len(r.levels) == 0
+
+
+def test_edges_device_budget_spill_parity(monkeypatch):
+    """Edges evicted from the device-store budget spill to host, count in
+    edges_bytes_spilled, re-upload for backward, and stay exact."""
+    monkeypatch.setenv("GAMESMAN_BACKWARD", "edges")
+    single = Solver(get_game("tictactoe")).solve()
+    solver = ShardedSolver(get_game("tictactoe"), num_shards=8)
+    solver.device_store_bytes = 0  # evict everything, edges included
+    r = solver.solve()
+    assert solver.edges_bytes_spilled > 0
+    assert solver.backward_edges_levels > 0
+    assert full_table(r) == full_table(single)
+
+
+def _killed_after_forward(spec, ckpt_dir, num_shards=8):
+    """Run a checkpointed solve whose backward dies — the mid-run death
+    the resume machinery exists for. Returns the solver."""
+    solver = ShardedSolver(
+        get_game(spec), num_shards=num_shards,
+        checkpointer=LevelCheckpointer(str(ckpt_dir)),
+    )
+
+    def boom(*a, **k):
+        raise RuntimeError("killed after forward")
+
+    solver._backward = boom
+    with pytest.raises(RuntimeError, match="killed after forward"):
+        solver.solve()
+    return solver
+
+
+def test_edge_spill_resume_runs_edges_backward(tmp_path, monkeypatch):
+    """Kill after forward; the resumed run must load the per-(level,
+    shard) edge files and run the edge-cached backward from disk."""
+    monkeypatch.setenv("GAMESMAN_BACKWARD", "edges")
+    single = Solver(get_game("tictactoe")).solve()
+    _killed_after_forward("tictactoe", tmp_path / "ck")
+    resumed = ShardedSolver(
+        get_game("tictactoe"), num_shards=8,
+        checkpointer=LevelCheckpointer(str(tmp_path / "ck")),
+    )
+    r = resumed.solve()
+    # Edges came from the spilled files (the in-memory ones died with the
+    # first process): every level but the deepest resolves via edges.
+    assert resumed.backward_edges_levels == len(r.levels) - 1
+    assert full_table(r) == full_table(single)
+
+
+def test_pre_edge_checkpoint_falls_back_to_lookup(tmp_path, monkeypatch):
+    """A checkpoint written before edges existed (simulated by a lookup-
+    mode run, which stores none) must resume via the lookup backward
+    without error — the structural fallback contract."""
+    single = Solver(get_game("tictactoe")).solve()
+    monkeypatch.setenv("GAMESMAN_BACKWARD", "lookup")
+    _killed_after_forward("tictactoe", tmp_path / "ck")
+    monkeypatch.setenv("GAMESMAN_BACKWARD", "edges")
+    resumed = ShardedSolver(
+        get_game("tictactoe"), num_shards=8,
+        checkpointer=LevelCheckpointer(str(tmp_path / "ck")),
+    )
+    r = resumed.solve()
+    assert resumed.backward_edges_levels == 0  # no edge files: fallback
+    assert full_table(r) == full_table(single)
+
+
+def test_torn_edge_files_degrade_to_lookup(tmp_path, monkeypatch):
+    """Sealed-but-corrupt edge files (death mid-resave before _savez was
+    atomic, disk trouble) degrade that level to the lookup join instead
+    of killing the resume."""
+    monkeypatch.setenv("GAMESMAN_BACKWARD", "edges")
+    single = Solver(get_game("tictactoe")).solve()
+    _killed_after_forward("tictactoe", tmp_path / "ck")
+    for p in (tmp_path / "ck").glob("edges_*.shard_*.npz"):
+        p.write_bytes(b"not a zip")
+    resumed = ShardedSolver(
+        get_game("tictactoe"), num_shards=8,
+        checkpointer=LevelCheckpointer(str(tmp_path / "ck")),
+    )
+    r = resumed.solve()
+    assert resumed.backward_edges_levels == 0
+    assert full_table(r) == full_table(single)
+
+
+def test_savez_atomic_and_torn_recovery(tmp_path):
+    """ADVICE r5: _savez writes tmp + os.replace (no torn file ever at
+    the final name), and a sealed forward level whose npz is corrupt
+    truncates the resumable prefix instead of raising BadZipFile."""
+    from gamesmanmpi_tpu.utils.checkpoint import _savez
+
+    path = tmp_path / "x.npz"
+    _savez(path, a=np.arange(4, dtype=np.uint32))
+    assert path.exists()
+    assert not list(tmp_path.glob("*.tmp.npz"))  # no tmp left behind
+    with np.load(path) as z:
+        assert (z["a"] == np.arange(4)).all()
+
+    ck = LevelCheckpointer(str(tmp_path / "ck"))
+    for level in (0, 1, 2):
+        for s in (0, 1):
+            ck.save_forward_level_shard(
+                level, s, np.arange(level + 1, dtype=np.uint64)
+            )
+        ck.finish_forward_level(level, 2)
+    # Corrupt level 1's shard 0: levels 1 and 2 drop, level 0 survives.
+    (tmp_path / "ck" / "frontier_0001.shard_0000.npz").write_bytes(b"xx")
+    out = ck.load_forward_level_shards(2)
+    assert sorted(out) == [0]

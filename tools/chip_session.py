@@ -112,6 +112,20 @@ class Session:
                 # deadline must budget for the probe bench will actually
                 # run, not the value we failed to parse.
                 probe = 600
+            # Clamp an ambient GAMESMAN_PROBE_TIMEOUT (e.g. bench's 600s
+            # default exported in the shell) and WRITE IT BACK, so probe
+            # + deadline + margin always fit inside this step's timeout
+            # and the parent bench gets to print best-of-completed-runs
+            # before our kill arrives (ADVICE r5 — the max(300, ...)
+            # floor alone silently degraded that guarantee to the
+            # partial-stdout salvage). Two bounds: half the step budget,
+            # AND timeout - 420 so the deadline's own 300s floor + 120s
+            # margin still fit (the tighter one wins; below a 480s step
+            # nothing can honor the floors, and no step here is that
+            # short).
+            probe = min(probe, max(60, int(timeout) // 2),
+                        max(60, int(timeout) - 420))
+            full_env["GAMESMAN_PROBE_TIMEOUT"] = str(probe)
             full_env["GAMESMAN_BENCH_DEADLINE"] = str(
                 max(300, int(timeout) - probe - 120))
         t0 = time.time()

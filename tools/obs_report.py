@@ -52,7 +52,10 @@ def summarize_levels(records: list[dict]) -> list[dict]:
     levels: dict[int, dict] = {}
     for rec in records:
         phase = rec.get("phase")
-        if phase not in ("forward", "backward") or "level" not in rec:
+        # backward_edges is the sharded engine's edge-cached resolve of a
+        # level (GAMESMAN_BACKWARD=edges) — same schema, same bwd column.
+        if (phase not in ("forward", "backward", "backward_edges")
+                or "level" not in rec):
             continue
         row = levels.setdefault(
             int(rec["level"]),
@@ -132,7 +135,7 @@ def report(records: list[dict]) -> str:
     aux = {}
     for rec in records:
         phase = rec.get("phase")
-        if phase not in ("forward", "backward", "done"):
+        if phase not in ("forward", "backward", "backward_edges", "done"):
             aux[phase] = aux.get(phase, 0) + 1
     if aux:
         out.append(
