@@ -107,6 +107,17 @@ def build_parser() -> argparse.ArgumentParser:
         "mid-flight (env GAMESMAN_HEARTBEAT_SECS; 0 = off)",
     )
     p.add_argument(
+        "--watchdog-secs",
+        type=float,
+        default=None,
+        metavar="S",
+        help="abort (exit 124, diagnostics dumped, checkpoint prefix "
+        "intact) when a level stalls longer than "
+        "max(S, GAMESMAN_WATCHDOG_FACTOR x slowest recent level) — "
+        "turns a wedged backend into a resumable death (env "
+        "GAMESMAN_WATCHDOG_SECS; 0 = off)",
+    )
+    p.add_argument(
         "--table-out",
         default=None,
         help="dump the full solved table as .npz (packed cells per level)",
@@ -326,6 +337,7 @@ def main(argv=None) -> int:
         (args.window_block, "GAMESMAN_WINDOW_BLOCK"),
         (args.device_store_mb, "GAMESMAN_DEVICE_STORE_MB"),
         (args.heartbeat_secs, "GAMESMAN_HEARTBEAT_SECS"),
+        (args.watchdog_secs, "GAMESMAN_WATCHDOG_SECS"),
         (args.backward, "GAMESMAN_BACKWARD"),
     ):
         if flag is not None:
@@ -708,6 +720,21 @@ def _db_parser() -> argparse.ArgumentParser:
     )
     ps.add_argument("--cache-size", type=int, default=65536,
                     help="LRU hot-position cache entries (0 disables)")
+    ps.add_argument(
+        "--request-timeout-ms",
+        type=float,
+        default=None,
+        help="per-request deadline on the batcher: a request not "
+        "answered within it gets 503 + Retry-After instead of hanging "
+        "(env GAMESMAN_REQUEST_TIMEOUT in seconds; 0 = no deadline)",
+    )
+    ps.add_argument(
+        "--max-queue",
+        type=int,
+        default=1024,
+        help="load shedding: refuse (503) new queries when this many "
+        "requests are already parked in the coalescing queue",
+    )
     ps.add_argument("--jsonl", default=None,
                     help="write per-batch serving metrics to this JSONL file")
     ps.add_argument("-v", "--verbose", action="store_true")
@@ -836,6 +863,9 @@ def _cmd_export_db(args) -> int:
 
 
 def _cmd_serve(args) -> int:
+    import signal
+    import threading
+
     from gamesmanmpi_tpu.db import DbFormatError, DbReader
     from gamesmanmpi_tpu.serve import QueryServer
 
@@ -853,6 +883,11 @@ def _cmd_serve(args) -> int:
                 port=args.port,
                 window=args.batch_window_ms / 1e3,
                 cache_size=args.cache_size,
+                max_queue=args.max_queue,
+                request_timeout=(
+                    args.request_timeout_ms / 1e3
+                    if args.request_timeout_ms is not None else None
+                ),
                 logger=logger,
             )
         except OSError as e:  # port in use / unbindable host
@@ -864,14 +899,35 @@ def _cmd_serve(args) -> int:
         print(
             f"serving {reader.game.name} ({reader.num_positions} positions) "
             f"on http://{args.host}:{server.port} "
-            f"(POST /query, GET /healthz, GET /metrics)"
+            f"(POST /query, GET /healthz, GET /metrics)",
+            flush=True,  # a supervisor tailing the pipe needs the banner NOW
         )
+        # Graceful shutdown: SIGINT/SIGTERM flip /healthz to "draining"
+        # (new queries 503 so a load balancer fails over), let in-flight
+        # requests and the coalescing batch finish, then tear down — the
+        # JSONL logger closes via the surrounding scope either way. The
+        # old path was a bare serve_forever(): SIGTERM tore down nothing.
+        stop = threading.Event()
+
+        def _on_signal(signum, frame):
+            stop.set()
+
+        previous = {}
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                previous[sig] = signal.signal(sig, _on_signal)
+            except ValueError:  # not the main thread (programmatic use)
+                pass
+        server.start()
         try:
-            server.serve_forever()
-        except KeyboardInterrupt:
-            pass
+            stop.wait()
+            print("draining: refusing new queries, flushing in-flight "
+                  "batches", file=sys.stderr)
+            server.begin_drain()
         finally:
             server.stop()
+            for sig, handler in previous.items():
+                signal.signal(sig, handler)
     return 0
 
 

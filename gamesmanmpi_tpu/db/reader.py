@@ -37,6 +37,7 @@ from gamesmanmpi_tpu.db.format import (
 )
 from gamesmanmpi_tpu.obs import default_registry
 from gamesmanmpi_tpu.ops.padding import bucket_size, pad_to
+from gamesmanmpi_tpu.resilience import faults
 from gamesmanmpi_tpu.solve.engine import get_kernel, undecided_mask
 
 # Smallest query-kernel capacity: batches are tiny next to frontiers, and
@@ -216,6 +217,7 @@ class DbReader:
         half of lookup; split out so lookup_best canonicalizes a batch
         once and reuses it for both the probe and the expansion)."""
         k = canon.shape[0]
+        faults.fire("db.probe", queries=k)
         t0 = time.perf_counter()
         values = np.full(k, UNDECIDED, dtype=np.uint8)
         remoteness = np.zeros(k, dtype=np.int32)

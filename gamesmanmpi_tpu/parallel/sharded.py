@@ -103,6 +103,10 @@ from gamesmanmpi_tpu.ops.provenance import (
 )
 from gamesmanmpi_tpu.obs import Span
 from gamesmanmpi_tpu.parallel.mesh import AXIS, make_mesh, shard_map
+from gamesmanmpi_tpu.resilience import faults
+from gamesmanmpi_tpu.resilience.retry import retry_call
+from gamesmanmpi_tpu.resilience.supervisor import maybe_watchdog
+from gamesmanmpi_tpu.utils.checkpoint import TORN_NPZ_ERRORS
 from gamesmanmpi_tpu.solve.engine import (
     LevelTable,
     SolveResult,
@@ -632,10 +636,26 @@ class ShardedSolver:
         self.bytes_routed = 0
         self.bytes_sorted = 0
         self.bytes_gathered = 0
+        #: transient level-step failures absorbed by retry (stats field).
+        self.retries = 0
+        #: phase/level progress for the watchdog (replaced atomically,
+        #: never mutated — same contract as the single-device engine's).
+        self.progress: dict = {"phase": "init"}
         # Mesh identity participates in the process-wide kernel cache key
         # (same shard count over different device sets must not share).
         self._mesh_key = tuple(d.id for d in self.mesh.devices.flat)
         self._sharding = NamedSharding(self.mesh, P(AXIS))
+
+    def _retry(self, point: str, fn, reset=None, level=None):
+        """Level-step retry wrapper (see resilience.retry): the sharded
+        steps' inputs — frontier, window triples, edge arrays — stay
+        referenced across the step, so re-dispatch is idempotent."""
+
+        def on_retry(attempt, exc):
+            self.retries += 1
+
+        return retry_call(fn, point=point, reset=reset, level=level,
+                          logger=self.logger, on_retry=on_retry)
 
     # ------------------------------------------------------------- jit builds
 
@@ -1147,19 +1167,32 @@ class ShardedSolver:
         stored_bytes = frontier.nbytes
         while True:
             t0 = time.perf_counter()
+            self.progress = {
+                "phase": "forward", "level": k,
+                "frontier": int(levels[k].counts.sum()),
+            }
             b0 = (self.bytes_routed, self.bytes_sorted)
             route_cap = self._initial_route_cap(cap)
             eidx = slot = None
             while True:
-                if self.use_edges:
-                    uniq, eidx, slot, count, send_counts = self._forward_fn(
-                        cap, route_cap, provenance=True
-                    )(frontier)
-                else:
-                    uniq, count, send_counts = self._forward_fn(
-                        cap, route_cap
-                    )(frontier)
-                max_sent = int(np.asarray(send_counts).max())
+                # The whole dispatch+counts-sync is the retried unit: a
+                # transient collective failure re-dispatches from the
+                # frontier, which stays referenced across the step.
+                def _step(cap=cap, route_cap=route_cap, frontier=frontier,
+                          k=k):
+                    faults.fire("sharded.forward", level=k)
+                    if self.use_edges:
+                        u, e, sl, c, sc = self._forward_fn(
+                            cap, route_cap, provenance=True
+                        )(frontier)
+                    else:
+                        u, c, sc = self._forward_fn(cap, route_cap)(frontier)
+                        e = sl = None
+                    return u, e, sl, c, int(np.asarray(sc).max())
+
+                uniq, eidx, slot, count, max_sent = self._retry(
+                    "sharded.forward", _step, level=k
+                )
                 if max_sent <= route_cap:
                     break
                 self.spill_retries += 1
@@ -1261,6 +1294,7 @@ class ShardedSolver:
         while pools:
             k = min(pools)
             t0 = time.perf_counter()
+            self.progress = {"phase": "forward", "level": k}
             b0 = (self.bytes_routed, self.bytes_sorted)
             frontier, counts = pools.pop(k)
             rec = _SLevel(counts, frontier, None)
@@ -1278,10 +1312,15 @@ class ShardedSolver:
             cap = frontier.shape[1]
             route_cap = self._initial_route_cap(cap)
             while True:
-                uniq, count, send_counts = self._forward_fn(cap, route_cap)(
-                    frontier
+                def _step(cap=cap, route_cap=route_cap, frontier=frontier,
+                          k=k):
+                    faults.fire("sharded.forward", level=k)
+                    u, c, sc = self._forward_fn(cap, route_cap)(frontier)
+                    return u, c, int(np.asarray(sc).max())
+
+                uniq, count, max_sent = self._retry(
+                    "sharded.forward", _step, level=k
                 )
-                max_sent = int(np.asarray(send_counts).max())
                 if max_sent <= route_cap:
                     break
                 self.spill_retries += 1
@@ -1518,6 +1557,10 @@ class ShardedSolver:
         for k in sorted(levels, reverse=True):
             b0 = (self.bytes_routed, self.bytes_sorted, self.bytes_gathered)
             rec = levels[k]
+            self.progress = {
+                "phase": "backward", "level": k,
+                "n": int(rec.counts.sum()),
+            }
             from_checkpoint = k in completed
             # Edge-cached resolve when this level's forward edges exist
             # (in memory, spilled, or sealed in the checkpoint dir) AND the
@@ -1547,71 +1590,23 @@ class ShardedSolver:
             edges = self._load_edges(k, rec, cap) if want_edges else None
             if edges is None:
                 mode = "lookup"  # rare torn/mismatched edge files degrade
+            loaded = None
             if from_checkpoint:
-                # Restart-from-level: refill the per-shard window cache
-                # from the checkpoint. Per-shard files at a matching shard
-                # count load shard-to-shard with no global assembly; a
-                # global file (or a different shard count) goes through
-                # assemble + repartition.
-                pv = np.full((S, cap), UNDECIDED, dtype=np.uint8)
-                pr = np.zeros((S, cap), dtype=np.int32)
-                table = None
-                if self.checkpointer.level_shard_count(k) == S:
-                    shards = rec.host_shards()
-                    loaded = []
-                    for s in range(S):
-                        st, cells = self.checkpointer.load_level_shard(k, s)
-                        if st.shape[0] != shards[s].shape[0] or not (
-                            st.astype(g.state_dtype) == shards[s]
-                        ).all():
-                            raise SolverError(
-                                f"checkpointed level {k} (shard {s}) does "
-                                "not match the discovered frontier — stale "
-                                "checkpoint directory?"
-                            )
-                        v, r = unpack_cells_np(cells)
-                        pv[s, : v.shape[0]] = v
-                        pr[s, : r.shape[0]] = r
-                        loaded.append((st, v, r))
-                    if self.store_tables or (
-                        k == root_level and self.materialize_root_table
-                    ):
-                        # Assemble from the shards already in hand (a
-                        # load_level call would re-read every file).
-                        states = np.concatenate([t[0] for t in loaded])
-                        order = np.argsort(states)
-                        table = LevelTable(
-                            states=states[order].astype(g.state_dtype),
-                            values=np.concatenate(
-                                [t[1] for t in loaded]
-                            )[order],
-                            remoteness=np.concatenate(
-                                [t[2] for t in loaded]
-                            )[order],
-                        )
-                else:
-                    table = self.checkpointer.load_level(k)
-                    table = LevelTable(
-                        states=np.asarray(table.states, dtype=g.state_dtype),
-                        values=table.values,
-                        remoteness=table.remoteness,
+                try:
+                    loaded = self._load_checkpointed_level(
+                        k, rec, cap, root_level
                     )
-                    shards = rec.host_shards()
-                    expected = np.sort(np.concatenate(shards)) if shards \
-                        else np.empty(0, g.state_dtype)
-                    if table.states.shape[0] != expected.shape[0] or not (
-                        table.states == expected
-                    ).all():
-                        raise SolverError(
-                            f"checkpointed level {k} does not match the "
-                            "discovered frontier — stale checkpoint "
-                            "directory?"
-                        )
-                    owners = owner_shard_np(table.states, S)
-                    for s in range(S):
-                        sel = owners == s
-                        pv[s, : sel.sum()] = table.values[sel]
-                        pr[s, : sel.sum()] = table.remoteness[sel]
+                except TORN_NPZ_ERRORS as e:
+                    # Torn or crc-mismatching sealed level: quarantine and
+                    # degrade to a recompute — the frontier is still known
+                    # and the deeper window is already resolved. (The
+                    # lookup join, not edges: the edge decision was taken
+                    # before the load and this path is rare.)
+                    self.checkpointer.quarantine_and_log(k, e, self.logger)
+                    from_checkpoint = False
+                    mode = "lookup"
+            if loaded is not None:
+                pv, pr, table = loaded
                 values_dev = jax.device_put(pv, self._sharding)
                 rem_dev = jax.device_put(pr, self._sharding)
             elif edges is not None:
@@ -1619,9 +1614,17 @@ class ShardedSolver:
                 # indices — no search, no re-expansion, no join sort
                 # (bytes_sorted contribution: zero).
                 eidx, slot, ecap = edges
-                values_dev, rem_dev, misses = self._resolve_edges_level(
-                    rec, eidx, slot, ecap,
-                    dev_cache.get(k + 1), host_cache.get(k + 1),
+
+                def _resolve_e(eidx=eidx, slot=slot, ecap=ecap, rec=rec,
+                               k=k):
+                    faults.fire("sharded.backward", level=k)
+                    return self._resolve_edges_level(
+                        rec, eidx, slot, ecap,
+                        dev_cache.get(k + 1), host_cache.get(k + 1),
+                    )
+
+                values_dev, rem_dev, misses = self._retry(
+                    "sharded.backward", _resolve_e, level=k
                 )
                 self.backward_edges_levels += 1
                 del eidx, slot
@@ -1647,8 +1650,16 @@ class ShardedSolver:
                     window_flat = []
                     for L in window_levels:
                         window_flat.extend(dev_cache[L])
-                    values_dev, rem_dev, misses = self._resolve_blocked(
-                        rec.dev, window_caps, window_flat
+
+                    def _resolve_l(rec=rec, window_caps=window_caps,
+                                   window_flat=window_flat, k=k):
+                        faults.fire("sharded.backward", level=k)
+                        return self._resolve_blocked(
+                            rec.dev, window_caps, window_flat
+                        )
+
+                    values_dev, rem_dev, misses = self._retry(
+                        "sharded.backward", _resolve_l, level=k
                     )
                 else:
                     # At least one window level was spilled: stream ALL of
@@ -1666,8 +1677,15 @@ class ShardedSolver:
                             )
                             del dev_cache[L]
                         windows.append(host_cache[L])
-                    values_dev, rem_dev, misses = (
-                        self._resolve_blocked_streamed(rec.dev, windows)
+
+                    def _resolve_s(rec=rec, windows=windows, k=k):
+                        faults.fire("sharded.backward", level=k)
+                        return self._resolve_blocked_streamed(
+                            rec.dev, windows
+                        )
+
+                    values_dev, rem_dev, misses = self._retry(
+                        "sharded.backward", _resolve_s, level=k
                     )
                 if self.paranoid and int(np.asarray(misses).sum()) > 0:
                     raise SolverError(
@@ -1719,6 +1737,79 @@ class ShardedSolver:
                 bytes_gathered=self.bytes_gathered - b0[2],
             )
         return resolved
+
+    def _load_checkpointed_level(self, k: int, rec, cap: int,
+                                 root_level: int):
+        """Restart-from-level: (values [S, cap], remoteness [S, cap],
+        table|None) of a sealed level, validated against the discovered
+        frontier. Per-shard files at a matching shard count load
+        shard-to-shard with no global assembly; a global file (or a
+        different shard count) goes through assemble + repartition.
+        Raises a TORN_NPZ_ERRORS member on unreadable/corrupt files
+        (caller quarantines + recomputes) and SolverError on a genuine
+        frontier mismatch (stale directory — still fatal)."""
+        g = self.game
+        S = self.S
+        pv = np.full((S, cap), UNDECIDED, dtype=np.uint8)
+        pr = np.zeros((S, cap), dtype=np.int32)
+        table = None
+        manifest = self.checkpointer.load_manifest()
+        if manifest.get("sharded_levels", {}).get(str(k)) == S:
+            shards = rec.host_shards()
+            loaded = []
+            for s in range(S):
+                st, cells = self.checkpointer.load_level_shard(k, s,
+                                                               manifest)
+                if st.shape[0] != shards[s].shape[0] or not (
+                    st.astype(g.state_dtype) == shards[s]
+                ).all():
+                    raise SolverError(
+                        f"checkpointed level {k} (shard {s}) does "
+                        "not match the discovered frontier — stale "
+                        "checkpoint directory?"
+                    )
+                v, r = unpack_cells_np(cells)
+                pv[s, : v.shape[0]] = v
+                pr[s, : r.shape[0]] = r
+                loaded.append((st, v, r))
+            if self.store_tables or (
+                k == root_level and self.materialize_root_table
+            ):
+                # Assemble from the shards already in hand (a
+                # load_level call would re-read every file).
+                states = np.concatenate([t[0] for t in loaded])
+                order = np.argsort(states)
+                table = LevelTable(
+                    states=states[order].astype(g.state_dtype),
+                    values=np.concatenate([t[1] for t in loaded])[order],
+                    remoteness=np.concatenate(
+                        [t[2] for t in loaded]
+                    )[order],
+                )
+        else:
+            table = self.checkpointer.load_level(k)
+            table = LevelTable(
+                states=np.asarray(table.states, dtype=g.state_dtype),
+                values=table.values,
+                remoteness=table.remoteness,
+            )
+            shards = rec.host_shards()
+            expected = np.sort(np.concatenate(shards)) if shards \
+                else np.empty(0, g.state_dtype)
+            if table.states.shape[0] != expected.shape[0] or not (
+                table.states == expected
+            ).all():
+                raise SolverError(
+                    f"checkpointed level {k} does not match the "
+                    "discovered frontier — stale checkpoint "
+                    "directory?"
+                )
+            owners = owner_shard_np(table.states, S)
+            for s in range(S):
+                sel = owners == s
+                pv[s, : sel.sum()] = table.values[sel]
+                pr[s, : sel.sum()] = table.remoteness[sel]
+        return pv, pr, table
 
     def _materialize_level(self, k: int, rec, values_dev, rem_dev,
                            root_level: int):
@@ -1999,6 +2090,18 @@ class ShardedSolver:
     # ------------------------------------------------------------------ solve
 
     def solve(self) -> SolveResult:
+        """Public entry: the solve body under the env-gated watchdog
+        (GAMESMAN_WATCHDOG_SECS — same stall-abort contract as the
+        single-device engine; `progress` is replaced atomically at each
+        phase/level boundary)."""
+        wd = maybe_watchdog(lambda: self.progress, logger=self.logger)
+        try:
+            return self._solve_impl()
+        finally:
+            if wd is not None:
+                wd.stop()
+
+    def _solve_impl(self) -> SolveResult:
         g = self.game
         t0 = time.perf_counter()
         init, start_level = canonical_scalar(g, g.initial_state())
@@ -2066,6 +2169,7 @@ class ShardedSolver:
             "shards": self.S,
             "positions": num_positions,
             "levels": len(levels),
+            "retries": self.retries,
             "spill_retries": self.spill_retries,
             "backward": self.backward_mode,
             "backward_edges_levels": self.backward_edges_levels,
@@ -2078,6 +2182,7 @@ class ShardedSolver:
             "bytes_sorted": self.bytes_sorted,
             "bytes_gathered": self.bytes_gathered,
         }
+        self.progress = {"phase": "done"}
         if self.logger is not None:
             self.logger.log({"phase": "done", **stats})
         return SolveResult(g, root_value, root_rem, resolved, stats)

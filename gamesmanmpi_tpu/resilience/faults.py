@@ -1,0 +1,230 @@
+"""Deterministic fault-injection registry.
+
+Failure handling that is never exercised is failure handling that does
+not work. This module weaves NAMED fault points through the solve and
+serve stacks (checkpoint save/load, engine level steps, the sharded
+collectives, the DB probe, the batcher flush) and arms them from one
+environment variable, so every failure shape the system claims to
+survive can be injected on demand — in-process by tests, or into a
+subprocess for whole-process chaos (kill + resume + byte-parity, see
+tests/test_resilience.py).
+
+Grammar (``GAMESMAN_FAULTS``, comma-separated directives)::
+
+    point:kind[:when]
+
+* ``point`` — one of :data:`KNOWN_POINTS` (arming an unknown point is a
+  ``ValueError``: a typo'd chaos run must not silently pass).
+* ``kind`` — what happens when the directive fires:
+
+  - ``transient`` — raise :class:`TransientFault` (classified transient
+    by ``resilience.retry``; the retry supervisor must absorb it);
+  - ``fatal`` — raise :class:`FatalFault` (must fail fast, checkpoint
+    prefix intact);
+  - ``delay=SECS`` — sleep (watchdog / deadline fodder);
+  - ``kill[=CODE]`` — ``os._exit`` (default 77): process chaos, the
+    moral equivalent of a preemption;
+  - ``torn`` — truncate the file the call site is writing (the
+    ``path=`` context) to half its bytes, then ``os._exit(86)``: a torn
+    write followed by death, the silent-bit-rot shape the checkpoint
+    crc catches.
+
+* ``when`` — which visit fires (the schedule, always replayable):
+
+  - an integer ``N`` (default 1) — exactly the Nth visit of the point;
+  - ``always`` — every visit;
+  - ``pPROB@SEED`` — seeded Bernoulli per visit (``p0.2@7``): random
+    chaos that replays identically run to run.
+
+A disarmed process pays one falsy-dict check per fault point; points
+are only ever visited on host-side per-level/per-batch paths, never
+per-position.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import time
+import warnings
+
+from gamesmanmpi_tpu.obs import default_registry
+
+
+class FaultError(RuntimeError):
+    """Base of injected faults (never raised itself)."""
+
+
+class TransientFault(FaultError):
+    """Injected error the retry supervisor must absorb."""
+
+
+class FatalFault(FaultError):
+    """Injected error that must fail fast (never retried)."""
+
+
+#: Exit codes for process-killing kinds, distinct from real crash codes
+#: so the chaos harness can assert the *injected* death happened.
+KILL_EXIT_CODE = 77
+TORN_EXIT_CODE = 86
+
+#: Every fault point woven into the codebase. The chaos harness
+#: enumerates this dict — adding a call site without registering it here
+#: means it never gets chaos coverage, so keep them in lockstep.
+KNOWN_POINTS = {
+    "engine.forward": "single-device forward: per-level expand+dedup sync",
+    "engine.dedup": "single-device forward: inside the dedup span, pre-sync",
+    "engine.backward": "single-device backward: per-level resolve",
+    "sharded.forward": "sharded forward: per-level all_to_all expand step",
+    "sharded.backward": "sharded backward: per-level owner-routed resolve",
+    "ckpt.save_frontier": "checkpoint: after a frontier level is sealed",
+    "ckpt.save_level": "checkpoint: after a solved level is sealed",
+    "ckpt.load_level": "checkpoint: at the top of a resume level load",
+    "db.probe": "DbReader: at the top of every batched level probe",
+    "serve.flush": "Batcher worker: before the coalesced reader probe",
+}
+
+
+class _Directive:
+    """One armed ``point:kind:when`` with its per-run schedule state."""
+
+    __slots__ = ("point", "kind", "arg", "when", "visits", "rng")
+
+    def __init__(self, point: str, kind: str, arg, when):
+        self.point = point
+        self.kind = kind
+        self.arg = arg
+        self.when = when  # int | "always" | ("p", prob, seed)
+        self.visits = 0
+        self.rng = (
+            random.Random(when[2]) if isinstance(when, tuple) else None
+        )
+
+    def due(self) -> bool:
+        if self.when == "always":
+            return True
+        if isinstance(self.when, int):
+            return self.visits == self.when
+        return self.rng.random() < self.when[1]
+
+
+#: point -> [directives]; empty when disarmed (the fast-path check).
+_ARMED: dict = {}
+
+
+def _parse_when(tok: str):
+    if tok == "always":
+        return "always"
+    if tok.startswith("p"):
+        body = tok[1:].lstrip("=")
+        prob, _, seed = body.partition("@")
+        return ("p", float(prob), int(seed or 0))
+    n = int(tok)
+    if n < 1:
+        raise ValueError(f"fault visit index must be >= 1, got {n}")
+    return n
+
+
+def _parse_directive(text: str) -> _Directive:
+    parts = text.split(":")
+    if len(parts) not in (2, 3):
+        raise ValueError(
+            f"bad fault directive {text!r}: expected point:kind[:when]"
+        )
+    point = parts[0].strip()
+    if point not in KNOWN_POINTS:
+        raise ValueError(
+            f"unknown fault point {point!r}; known: "
+            + ", ".join(sorted(KNOWN_POINTS))
+        )
+    kind, _, argtxt = parts[1].strip().partition("=")
+    if kind not in ("transient", "fatal", "delay", "kill", "torn"):
+        raise ValueError(f"unknown fault kind {kind!r} in {text!r}")
+    arg = float(argtxt) if argtxt else None
+    when = _parse_when(parts[2].strip()) if len(parts) == 3 else 1
+    return _Directive(point, kind, arg, when)
+
+
+def configure(spec: str | None) -> dict:
+    """(Re)arm the registry from a ``GAMESMAN_FAULTS`` spec string.
+
+    Replaces the whole table (schedules restart from visit 0) — tests
+    arm, run, and :func:`clear`. Raises ``ValueError`` on junk specs.
+    """
+    table: dict = {}
+    for text in (spec or "").split(","):
+        text = text.strip()
+        if not text:
+            continue
+        d = _parse_directive(text)
+        table.setdefault(d.point, []).append(d)
+    _ARMED.clear()
+    _ARMED.update(table)
+    return dict(_ARMED)
+
+
+def clear() -> None:
+    """Disarm every fault point."""
+    _ARMED.clear()
+
+
+def known_points(prefix: str = "") -> list[str]:
+    """Registered fault points, optionally filtered by name prefix."""
+    return sorted(p for p in KNOWN_POINTS if p.startswith(prefix))
+
+
+def _inject(d: _Directive, point: str, path, ctx: dict) -> None:
+    where = f"{point} (visit {d.visits}{', ' + repr(ctx) if ctx else ''})"
+    sys.stderr.write(f"[faults] injecting {d.kind} at {where}\n")
+    sys.stderr.flush()
+    default_registry().counter(
+        "gamesman_faults_injected_total", "injected faults fired",
+        point=point, kind=d.kind,
+    ).inc()
+    if d.kind == "transient":
+        raise TransientFault(f"injected transient fault at {where}")
+    if d.kind == "fatal":
+        raise FatalFault(f"injected fatal fault at {where}")
+    if d.kind == "delay":
+        time.sleep(d.arg if d.arg is not None else 0.05)
+        return
+    if d.kind == "torn":
+        if path is not None and os.path.exists(path):
+            size = os.path.getsize(path)
+            with open(path, "r+b") as fh:
+                fh.truncate(max(1, size // 2))
+            sys.stderr.write(f"[faults] tore {path} ({size} -> {size // 2})\n")
+            sys.stderr.flush()
+        os._exit(TORN_EXIT_CODE)
+    if d.kind == "kill":
+        os._exit(int(d.arg) if d.arg is not None else KILL_EXIT_CODE)
+
+
+def fire(point: str, path=None, **ctx) -> None:
+    """Visit a fault point; inject whatever is armed for it.
+
+    ``path`` names the file a checkpoint call site just wrote (the
+    ``torn`` kind's target); ``ctx`` is free-form diagnostics (level,
+    shard) echoed into the injection banner.
+    """
+    if not _ARMED:
+        return
+    ds = _ARMED.get(point)
+    if not ds:
+        return
+    for d in ds:
+        d.visits += 1
+        if d.due():
+            _inject(d, point, path, ctx)
+
+
+# Arm from the environment at import so subprocess chaos needs no code:
+# the harness sets GAMESMAN_FAULTS and launches the stock CLI. A
+# malformed env var degrades to disarmed with a warning (same contract
+# as the engine's _env_int knobs) — in a chaos run the harness notices
+# because the expected death never happens.
+try:
+    configure(os.environ.get("GAMESMAN_FAULTS"))
+except ValueError as e:  # pragma: no cover - env misuse
+    warnings.warn(f"GAMESMAN_FAULTS ignored: {e}")

@@ -6,7 +6,22 @@ whose concurrent requests coalesce through a micro-batching queue (with
 an LRU hot-position cache) into single vectorized DbReader probes.
 """
 
-from gamesmanmpi_tpu.serve.batcher import Batcher
+from gamesmanmpi_tpu.serve.batcher import (
+    Batcher,
+    BatcherClosed,
+    BatcherOverloaded,
+    BatcherTimeout,
+    BatcherTripped,
+    BatcherUnavailable,
+)
 from gamesmanmpi_tpu.serve.server import QueryServer
 
-__all__ = ["Batcher", "QueryServer"]
+__all__ = [
+    "Batcher",
+    "BatcherUnavailable",
+    "BatcherClosed",
+    "BatcherTimeout",
+    "BatcherOverloaded",
+    "BatcherTripped",
+    "QueryServer",
+]

@@ -15,13 +15,25 @@ Raw — not canonical — keys mean symmetric duplicates occupy separate
 entries; that costs cache capacity, never correctness, and avoids paying
 a canonicalize kernel call before the cache.
 
+Degradation model (the resilience layer, docs/CONFIG.md): the batcher
+never hangs a client and never dies with the reader. Every ``submit``
+carries a deadline (``request_timeout``; expiry raises
+:class:`BatcherTimeout` → HTTP 503 + Retry-After); a queue deeper than
+``max_queue`` sheds new requests (:class:`BatcherOverloaded`); and
+consecutive reader faults trip a circuit breaker
+(:class:`BatcherTripped`) that fails misses fast — cache hits still
+answer — while the worker re-probes the reader in the background
+(half-open) and closes the circuit on the first success, no restart
+needed. ``state`` reports ok/open/half_open; ``/healthz`` maps any
+non-ok state to "degraded".
+
 Counters are plain ints mutated under the one lock and snapshotted by
 `metrics()` (the `/metrics.json` dict); per-batch records go to the
 shared utils/metrics JSONL logger so serving latency lands in the same
 stream as solve phases, and the obs registry carries the Prometheus
 series (`gamesman_batch_queue_depth`, `gamesman_batch_size`,
-`gamesman_batch_seconds`, cache hit/miss counters) that `/metrics`
-exposes.
+`gamesman_batch_seconds`, cache hit/miss counters, shed/timeout/breaker
+counters) that `/metrics` exposes.
 """
 
 from __future__ import annotations
@@ -34,14 +46,41 @@ import numpy as np
 
 from gamesmanmpi_tpu.obs import default_registry
 from gamesmanmpi_tpu.obs.registry import DEFAULT_SIZE_BUCKETS
+from gamesmanmpi_tpu.resilience import faults
+from gamesmanmpi_tpu.utils.env import env_float as _env_float
 
 
-class BatcherClosed(RuntimeError):
-    """submit() after close(): the one *transient* failure (server
-    shutdown). A distinct type so the HTTP layer can answer 503 here and
-    500 for real reader faults — jaxlib's runtime errors subclass
-    RuntimeError, so matching on RuntimeError would misclassify a broken
-    DB as a recovering server."""
+class BatcherUnavailable(RuntimeError):
+    """Base of the *transient* submit failures (the HTTP layer answers
+    503 + Retry-After for every subclass, 500 only for real reader
+    faults — jaxlib's runtime errors subclass RuntimeError, so matching
+    on RuntimeError would misclassify a broken DB as a recovering
+    server). ``retry_after`` is the advisory client backoff in seconds.
+    """
+
+    retry_after = 1
+
+    def __init__(self, msg: str, retry_after: int | None = None):
+        super().__init__(msg)
+        if retry_after is not None:
+            self.retry_after = max(1, int(retry_after))
+
+
+class BatcherClosed(BatcherUnavailable):
+    """submit() after (or parked across) close(): server shutdown."""
+
+
+class BatcherTimeout(BatcherUnavailable):
+    """The per-request deadline expired before the batch flushed."""
+
+
+class BatcherOverloaded(BatcherUnavailable):
+    """Queue-depth load shedding: more parked requests than max_queue."""
+
+
+class BatcherTripped(BatcherUnavailable):
+    """Circuit breaker open after consecutive reader faults; misses
+    fail fast until the background half-open re-probe succeeds."""
 
 
 class _Request:
@@ -60,12 +99,15 @@ class Batcher:
     """Thread-safe coalescing front-end over one DbReader.
 
     submit() blocks its calling thread until the worker flushes the
-    window's batch; results come back per position as
-    (value, remoteness, found, best) tuples of Python scalars.
+    window's batch (or its deadline expires); results come back per
+    position as (value, remoteness, found, best) tuples of Python
+    scalars.
     """
 
     def __init__(self, reader, *, window: float = 0.002,
                  cache_size: int = 65536, max_batch: int = 1 << 16,
+                 max_queue: int = 1024, request_timeout: float | None = None,
+                 breaker_threshold: int = 3, breaker_cooldown: float = 5.0,
                  logger=None, registry=None):
         self.reader = reader
         self.window = float(window)
@@ -74,6 +116,18 @@ class Batcher:
         #: pad to a huge (possibly freshly-compiled) kernel capacity and
         #: stall every parked request behind a single oversized batch.
         self.max_batch = int(max_batch)
+        #: Load-shed threshold: requests (not positions) parked at once.
+        self.max_queue = max(1, int(max_queue))
+        #: Per-request deadline in seconds (0 = wait forever). None reads
+        #: GAMESMAN_REQUEST_TIMEOUT (default 30 — matches the handler's
+        #: socket timeout, so the batcher always answers first).
+        if request_timeout is None:
+            request_timeout = _env_float("GAMESMAN_REQUEST_TIMEOUT", 30.0)
+        self.request_timeout = float(request_timeout)
+        #: Consecutive reader faults that open the circuit breaker, and
+        #: how long it stays open before a half-open re-probe.
+        self.breaker_threshold = max(1, int(breaker_threshold))
+        self.breaker_cooldown = float(breaker_cooldown)
         self.logger = logger
         self._cache: OrderedDict = OrderedDict()
         # Clamp: a negative size (the conventional "unlimited" spelling
@@ -83,6 +137,11 @@ class Batcher:
         self._cond = threading.Condition(self._lock)
         self._pending: list[_Request] = []
         self._closed = False
+        #: breaker: "ok" | "open" | "half_open" (+ the fault streak and
+        #: when the circuit opened), all mutated under the one lock.
+        self._breaker = "ok"
+        self._consecutive_faults = 0
+        self._opened_at = 0.0
         self.counters = {
             "requests": 0,
             "queries": 0,
@@ -92,6 +151,10 @@ class Batcher:
             "batched_queries": 0,
             "max_batch_size": 0,
             "batch_secs_total": 0.0,
+            "timeouts": 0,
+            "shed": 0,
+            "reader_faults": 0,
+            "breaker_opens": 0,
         }
         reg = registry or default_registry()
         self._m_queue_depth = reg.gauge(
@@ -111,6 +174,23 @@ class Batcher:
         self._m_cache_misses = reg.counter(
             "gamesman_cache_misses_total", "positions that went to a probe"
         )
+        self._m_timeouts = reg.counter(
+            "gamesman_request_timeouts_total",
+            "submits whose per-request deadline expired",
+        )
+        self._m_shed = reg.counter(
+            "gamesman_requests_shed_total",
+            "submits refused by load shedding or an open breaker",
+        )
+        self._m_reader_faults = reg.counter(
+            "gamesman_reader_faults_total",
+            "probe batches that failed with a reader error",
+        )
+        self._m_breaker_state = reg.gauge(
+            "gamesman_breaker_state",
+            "reader circuit breaker: 0=ok, 1=half_open, 2=open",
+        )
+        self._m_breaker_state.set(0)
         self._worker = threading.Thread(
             target=self._loop, name="gamesman-batcher", daemon=True
         )
@@ -118,12 +198,25 @@ class Batcher:
 
     # ------------------------------------------------------------ client API
 
-    def submit(self, positions) -> list[tuple[int, int, bool, int | None]]:
-        """Resolve a request's positions; blocks until the batch flushes.
+    @property
+    def state(self) -> str:
+        """Breaker state: "ok" | "open" | "half_open"."""
+        with self._lock:
+            return self._breaker
+
+    def submit(self, positions,
+               timeout: float | None = None,
+               ) -> list[tuple[int, int, bool, int | None]]:
+        """Resolve a request's positions; blocks until the batch flushes
+        or the deadline (``timeout``, default the batcher's
+        ``request_timeout``; 0 = forever) expires.
 
         positions: iterable of ints (already range-validated by the
         caller). Returns one (value, remoteness, found, best_or_None)
-        tuple per position, in order.
+        tuple per position, in order. Raises a
+        :class:`BatcherUnavailable` subclass on shutdown, deadline,
+        shedding, or an open breaker — cache hits are still served in
+        every state, so a degraded server keeps answering its hot set.
         """
         positions = [int(p) for p in positions]
         results: list = [None] * len(positions)
@@ -156,10 +249,40 @@ class Batcher:
         with self._cond:
             if self._closed:  # close() may have landed since the cache pass
                 raise BatcherClosed("batcher is closed")
+            if self._breaker != "ok":
+                self.counters["shed"] += 1
+                self._m_shed.inc()
+                remaining = self._opened_at + self.breaker_cooldown \
+                    - time.monotonic()
+                raise BatcherTripped(
+                    "reader circuit breaker is open",
+                    retry_after=max(1, int(remaining) + 1),
+                )
+            if len(self._pending) >= self.max_queue:
+                self.counters["shed"] += 1
+                self._m_shed.inc()
+                raise BatcherOverloaded(
+                    f"query queue is full ({self.max_queue} requests parked)"
+                )
             self._pending.append(req)
             self._m_queue_depth.set(len(self._pending))
             self._cond.notify_all()
-        req.event.wait()
+        deadline = self.request_timeout if timeout is None else float(timeout)
+        ok = req.event.wait(deadline if deadline > 0 else None)
+        if not ok:
+            with self._cond:
+                if req in self._pending:
+                    self._pending.remove(req)
+                    self._m_queue_depth.set(len(self._pending))
+                if req.event.is_set():
+                    ok = True  # flushed while we raced the removal
+                else:
+                    self.counters["timeouts"] += 1
+            if not ok:
+                self._m_timeouts.inc()
+                raise BatcherTimeout(
+                    f"request deadline ({deadline:g}s) exceeded"
+                )
         if req.error is not None:
             raise req.error
         with self._lock:
@@ -171,9 +294,20 @@ class Batcher:
                 self._cache.popitem(last=False)
         return results
 
-    def close(self) -> None:
+    def close(self, drain: bool = False) -> None:
+        """Stop the batcher. Default: requests still parked in the
+        coalescing window fail with BatcherClosed (→ 503; a client
+        retries another replica) — they must never hang on an event
+        nobody will set. ``drain=True`` (graceful shutdown, SIGTERM)
+        flushes the parked requests through one last probe first."""
         with self._cond:
             self._closed = True
+            if not drain:
+                for r in self._pending:
+                    r.error = BatcherClosed("batcher is closed")
+                    r.event.set()
+                self._pending.clear()
+                self._m_queue_depth.set(0)
             self._cond.notify_all()
         self._worker.join(timeout=5)
 
@@ -181,24 +315,101 @@ class Batcher:
         """Snapshot of the coalescing/cache counters (+ derived means)."""
         with self._lock:
             c = dict(self.counters)
+            state = self._breaker
         batches = max(c["batches"], 1)
         lookups = c["cache_hits"] + c["cache_misses"]
         return {
             **c,
+            "breaker_state": state,
             "mean_batch_size": c["batched_queries"] / batches,
             "mean_batch_secs": c["batch_secs_total"] / batches,
             "cache_hit_rate": c["cache_hits"] / max(lookups, 1),
         }
+
+    # ------------------------------------------------------- circuit breaker
+
+    def _note_reader_fault(self) -> None:
+        with self._lock:
+            self.counters["reader_faults"] += 1
+            self._consecutive_faults += 1
+            opened = (
+                self._breaker == "ok"
+                and self._consecutive_faults >= self.breaker_threshold
+            )
+            if opened or self._breaker == "half_open":
+                self._breaker = "open"
+                self._opened_at = time.monotonic()
+                if opened:
+                    self.counters["breaker_opens"] += 1
+        self._m_reader_faults.inc()
+        if opened:
+            self._m_breaker_state.set(2)
+            if self.logger is not None:
+                self.logger.log({
+                    "phase": "breaker_open",
+                    "consecutive_faults": self._consecutive_faults,
+                })
+        elif self.state == "open":
+            self._m_breaker_state.set(2)
+
+    def _note_reader_ok(self) -> None:
+        recovered = False
+        with self._lock:
+            self._consecutive_faults = 0
+            if self._breaker != "ok":
+                self._breaker = "ok"
+                recovered = True
+        if recovered:
+            self._m_breaker_state.set(0)
+            if self.logger is not None:
+                self.logger.log({"phase": "breaker_closed"})
+
+    def _breaker_wait(self) -> float | None:
+        """Seconds the idle worker may sleep before it owes a half-open
+        re-probe; None when the breaker is closed (sleep until work)."""
+        if self._breaker == "ok":
+            return None
+        return max(
+            0.01, self._opened_at + self.breaker_cooldown - time.monotonic()
+        )
+
+    def _breaker_tick(self) -> None:
+        """Half-open re-probe: after the cooldown, probe the reader with
+        one real lookup (through the same faultable probe path) in the
+        worker thread — no client request is spent on the experiment —
+        and close the circuit on success."""
+        with self._lock:
+            if self._breaker == "ok":
+                return
+            if time.monotonic() < self._opened_at + self.breaker_cooldown:
+                return
+            self._breaker = "half_open"
+        self._m_breaker_state.set(1)
+        try:
+            probe = np.asarray(
+                [int(self.reader.game.initial_state())],
+                dtype=self.reader.game.state_dtype,
+            )
+            self.reader.lookup_best(probe)
+        except Exception:  # noqa: BLE001 - still broken: stay open
+            self._note_reader_fault()
+        else:
+            self._note_reader_ok()
 
     # ---------------------------------------------------------------- worker
 
     def _drain_window(self) -> list[_Request]:
         """Wait for work, then collect what arrives in the window — up to
         max_batch queries; the remainder stays queued and the worker loops
-        straight back into the next flush without waiting."""
+        straight back into the next flush without waiting. With the
+        breaker open the wait is bounded so the worker wakes for its
+        half-open re-probe even with zero traffic."""
         with self._cond:
             while not self._pending and not self._closed:
-                self._cond.wait()
+                t = self._breaker_wait()
+                self._cond.wait(t)
+                if t is not None:
+                    return []  # let _loop run the breaker tick
             if not self._pending:
                 return []
             deadline = time.monotonic() + self.window
@@ -225,6 +436,7 @@ class Batcher:
 
     def _loop(self) -> None:
         while True:
+            self._breaker_tick()
             batch = self._drain_window()
             if not batch:
                 with self._lock:
@@ -236,13 +448,16 @@ class Batcher:
                 # Everything that can fail lives inside this try: an escape
                 # would kill the worker and leave every parked submitter
                 # (and all future ones) blocked on events nobody will set.
+                faults.fire("serve.flush", batch=len(batch))
                 states = np.concatenate([r.states for r in batch])
                 values, rem, found, best = self.reader.lookup_best(states)
             except Exception as e:  # noqa: BLE001 - must unblock submitters
                 for r in batch:
                     r.error = e
                     r.event.set()
+                self._note_reader_fault()
                 continue
+            self._note_reader_ok()
             secs = time.perf_counter() - t0
             sentinel = int(self.reader.game.sentinel)
             with self._lock:

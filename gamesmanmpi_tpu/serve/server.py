@@ -24,6 +24,7 @@ hex (the CLI's --query spelling) so responses are copy-pasteable into
 from __future__ import annotations
 
 import json
+import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -31,7 +32,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from gamesmanmpi_tpu.core.values import value_name
 from gamesmanmpi_tpu.db.format import parse_position
 from gamesmanmpi_tpu.obs import default_registry
-from gamesmanmpi_tpu.serve.batcher import Batcher, BatcherClosed
+from gamesmanmpi_tpu.serve.batcher import Batcher, BatcherUnavailable
+
+#: Socket errors a disconnecting client inflicts on the handler's write
+#: path. Counted (http_client_aborts), never a thread traceback: a
+#: hung-up client is load, not a server bug.
+CLIENT_ABORT_ERRORS = (BrokenPipeError, ConnectionResetError)
 
 #: The exposition format version the /metrics endpoint speaks.
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -51,21 +57,33 @@ class _Handler(BaseHTTPRequestHandler):
 
     # self.server is the _QueryHTTPServer below.
 
-    def _send_json(self, code: int, payload: dict) -> int:
-        return self._send_text(code, json.dumps(payload), "application/json")
+    def _send_json(self, code: int, payload: dict, headers=None) -> int:
+        return self._send_text(
+            code, json.dumps(payload), "application/json", headers
+        )
 
-    def _send_text(self, code: int, text: str, content_type: str) -> int:
+    def _send_text(self, code: int, text: str, content_type: str,
+                   headers=None) -> int:
         body = text.encode()
-        self.send_response(code)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(body)))
-        if self.close_connection:
-            # HTTP/1.1 defaults to keep-alive: a client must be TOLD the
-            # connection is closing, or its next request hits a dead
-            # socket (the early-400 path closes without draining).
-            self.send_header("Connection", "close")
-        self.end_headers()
-        self.wfile.write(body)
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            if self.close_connection:
+                # HTTP/1.1 defaults to keep-alive: a client must be TOLD the
+                # connection is closing, or its next request hits a dead
+                # socket (the early-400 path closes without draining).
+                self.send_header("Connection", "close")
+            self.end_headers()
+            self.wfile.write(body)
+        except CLIENT_ABORT_ERRORS:
+            # The client hung up mid-response: count it and reap the
+            # connection — the old behavior was a handler-thread
+            # traceback per disconnect.
+            self.server.note_client_abort()
+            self.close_connection = True
         return code
 
     def log_message(self, fmt, *args):  # quiet by default; JSONL has it
@@ -83,10 +101,17 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 - http.server API
         srv = self.server
         if self.path == "/healthz":
+            # Three states, one field: "ok" (serving normally),
+            # "degraded" (reader circuit breaker open — misses answer
+            # 503, cache hits still serve), "draining" (shutdown in
+            # progress; stop routing here). Always 200: a load balancer
+            # reads the body, an operator reads it too.
             self._send_json(
                 200,
                 {
-                    "status": "ok",
+                    "status": srv.health_status(),
+                    "breaker": srv.batcher.state
+                    if srv.batcher is not None else "ok",
                     "game": srv.reader.game.name,
                     "spec": srv.reader.manifest["spec"],
                     "positions": srv.reader.num_positions,
@@ -113,13 +138,23 @@ class _Handler(BaseHTTPRequestHandler):
         # busy, and http_errors makes the reject rate derivable.
         t0 = time.perf_counter()
         code = 500
+        self.server.note_inflight(+1)
         try:
             code = self._handle_post()
         finally:
+            self.server.note_inflight(-1)
             self.server.note_request(time.perf_counter() - t0, code)
 
     def _handle_post(self) -> int:
         srv = self.server
+        if srv.draining:
+            # Graceful shutdown: finish what is in flight, refuse new
+            # work loudly so clients fail over instead of timing out.
+            self.close_connection = True
+            return self._send_json(
+                503, {"error": "server is draining"},
+                headers={"Retry-After": "1"},
+            )
         if self.path != "/query":
             # The body (if any) is never read on this branch; its bytes
             # would desync the keep-alive socket (same guard as below).
@@ -169,8 +204,14 @@ class _Handler(BaseHTTPRequestHandler):
         states = [s for _, s in parsed if isinstance(s, int)]
         try:
             answers = iter(srv.batcher.submit(states))
-        except BatcherClosed as e:  # shutting down: genuinely transient
-            return self._send_json(503, {"error": str(e)})
+        except BatcherUnavailable as e:
+            # Genuinely transient (shutdown, deadline, shed, breaker):
+            # 503 + Retry-After so a well-behaved client backs off
+            # instead of hammering a recovering server.
+            return self._send_json(
+                503, {"error": str(e)},
+                headers={"Retry-After": str(e.retry_after)},
+            )
         except Exception as e:  # noqa: BLE001 - reader faults re-raise in
             # submit (a truncated shard, an unreadable mmap): answer 500
             # rather than dropping the connection mid-response.
@@ -205,10 +246,15 @@ class _QueryHTTPServer(ThreadingHTTPServer):
         self.reader = reader
         self.batcher = None  # attached by QueryServer AFTER the bind
         self.registry = registry or default_registry()
+        #: flipped by QueryServer.begin_drain(): /healthz says so and new
+        #: POST /query work answers 503 while in-flight requests finish.
+        self.draining = False
         self._stats_lock = threading.Lock()
         self._t0 = time.time()
         self._http_requests = 0
         self._http_errors = 0
+        self._http_client_aborts = 0
+        self._inflight = 0  # POSTs between entry and response written
         self._latency_total = 0.0
         self._latency_max = 0.0
         # server_start_time makes uptime derivable from any scrape
@@ -227,6 +273,42 @@ class _QueryHTTPServer(ThreadingHTTPServer):
             "gamesman_http_request_seconds",
             "wall seconds per POST request, parse to response",
         )
+        self._m_client_aborts = self.registry.counter(
+            "gamesman_http_client_aborts_total",
+            "responses abandoned by a disconnecting client "
+            "(BrokenPipe/ConnectionReset on the write path)",
+        )
+
+    def health_status(self) -> str:
+        if self.draining:
+            return "draining"
+        if self.batcher is not None and self.batcher.state != "ok":
+            return "degraded"
+        return "ok"
+
+    def note_client_abort(self) -> None:
+        with self._stats_lock:
+            self._http_client_aborts += 1
+        self._m_client_aborts.inc()
+
+    def note_inflight(self, delta: int) -> None:
+        with self._stats_lock:
+            self._inflight += delta
+
+    @property
+    def inflight(self) -> int:
+        with self._stats_lock:
+            return self._inflight
+
+    def handle_error(self, request, client_address):
+        """Client aborts escaping outside _send_text (e.g. during the
+        request read) are counted, not dumped as thread tracebacks;
+        everything else keeps the stdlib report."""
+        exc = sys.exc_info()[1]
+        if isinstance(exc, CLIENT_ABORT_ERRORS):
+            self.note_client_abort()
+            return
+        super().handle_error(request, client_address)
 
     def note_request(self, secs: float, code: int) -> None:
         with self._stats_lock:
@@ -244,14 +326,17 @@ class _QueryHTTPServer(ThreadingHTTPServer):
         with self._stats_lock:
             n = self._http_requests
             errors = self._http_errors
+            aborts = self._http_client_aborts
             mean = self._latency_total / max(n, 1)
             peak = self._latency_max
             uptime = time.time() - self._t0
         return {
             "server_start_time": self._t0,
             "uptime_secs": uptime,
+            "status": self.health_status(),
             "http_requests": n,
             "http_errors": errors,
+            "http_client_aborts": aborts,
             "latency_mean_ms": mean * 1e3,
             "latency_max_ms": peak * 1e3,
             **self.batcher.metrics(),
@@ -268,6 +353,8 @@ class QueryServer:
 
     def __init__(self, reader, *, host: str = "127.0.0.1", port: int = 0,
                  window: float = 0.002, cache_size: int = 65536,
+                 max_queue: int = 1024, request_timeout: float | None = None,
+                 breaker_threshold: int = 3, breaker_cooldown: float = 5.0,
                  logger=None, registry=None):
         self.reader = reader
         self.logger = logger
@@ -277,8 +364,11 @@ class QueryServer:
         # would leak an unjoinable daemon thread.
         self._httpd = _QueryHTTPServer((host, port), reader, self.registry)
         self.batcher = Batcher(
-            reader, window=window, cache_size=cache_size, logger=logger,
-            registry=self.registry,
+            reader, window=window, cache_size=cache_size,
+            max_queue=max_queue, request_timeout=request_timeout,
+            breaker_threshold=breaker_threshold,
+            breaker_cooldown=breaker_cooldown,
+            logger=logger, registry=self.registry,
         )
         self._httpd.batcher = self.batcher
         self._thread: threading.Thread | None = None
@@ -305,13 +395,31 @@ class QueryServer:
     def metrics(self) -> dict:
         return self._httpd.metrics()
 
+    def begin_drain(self) -> None:
+        """Flip /healthz to "draining" and 503 new queries while
+        in-flight requests finish — the first half of a SIGTERM
+        shutdown; stop() completes it."""
+        self._httpd.draining = True
+
     def stop(self) -> None:
+        self.begin_drain()
         self._httpd.shutdown()
-        self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
-        self.batcher.close()
+        # Requests already coalescing get one final flush (drain=True):
+        # they arrived before the drain flip and deserve an answer.
+        self.batcher.close(drain=True)
+        # Handler threads are daemons ThreadingHTTPServer never joins: a
+        # process exit right after this call would kill them mid-write,
+        # truncating the very responses the drain flushed. Bounded wait
+        # for the in-flight POSTs to finish writing (their batch answers
+        # arrived in the close(drain=True) above, so this is socket-write
+        # time — milliseconds; the deadline only guards a hung client).
+        deadline = time.monotonic() + 5.0
+        while self._httpd.inflight > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        self._httpd.server_close()
 
     def __enter__(self):
         self.start()

@@ -81,6 +81,9 @@ from gamesmanmpi_tpu.ops.lookup import lookup_window, search_method
 from gamesmanmpi_tpu.ops.provenance import dedup_provenance, gather_cells
 from gamesmanmpi_tpu.ops.padding import MIN_BUCKET, bucket_size, pad_to, pad_to_bucket
 from gamesmanmpi_tpu.obs import Heartbeat, Span, default_registry, trace_span
+from gamesmanmpi_tpu.resilience import faults
+from gamesmanmpi_tpu.resilience.retry import retry_call
+from gamesmanmpi_tpu.resilience.supervisor import maybe_watchdog
 from gamesmanmpi_tpu.solve.precompile import global_precompiler, sds
 from gamesmanmpi_tpu.utils.platform import backend_epoch, platform_auto_bool
 
@@ -561,6 +564,20 @@ class Solver:
         # (speculate/search/compact all would; this must not lag behind on
         # the stale platform).
         self.use_provenance: bool | None = None
+        #: transient level-step failures absorbed by retry (stats field;
+        #: the registry carries the per-point gamesman_retries_total).
+        self.retries = 0
+
+    def _retry(self, point: str, fn, reset=None, level=None):
+        """Level-step retry wrapper: bounded exponential backoff on
+        transient runtime errors, re-entering from the step's held
+        inputs via ``reset`` (see resilience.retry)."""
+
+        def on_retry(attempt, exc):
+            self.retries += 1
+
+        return retry_call(fn, point=point, reset=reset, level=level,
+                          logger=self.logger, on_retry=on_retry)
 
     # ---------------------------------------------------------------- kernels
 
@@ -887,15 +904,32 @@ class Solver:
                 "phase": "forward", "level": k, "frontier": levels[k].n,
             }
             cap = frontier.shape[0]
-            uniq, count, uidx, prim = pending
             spec = spec_input = None
             if speculate:
-                spec_input = jax.lax.slice(uniq, (0,), (cap,))
+                spec_input = jax.lax.slice(pending[0], (0,), (cap,))
                 spec = fwd_step(spec_input)
             # The expand+dedup kernel retires AT this host sync (dispatch
             # is async), so the dedup/sort wait is what this span times.
+            # The sync is the level's transient-failure surface: a relay
+            # hiccup raises here, and the retry re-dispatches from the
+            # frontier (still in hand) — checkpoint-consistent re-entry.
+            holder = [pending]
+
+            def _sync(holder=holder, k=k):
+                faults.fire("engine.forward", level=k)
+                faults.fire("engine.dedup", level=k)
+                return int(holder[0][1])  # the one host sync per level
+
+            def _redispatch(holder=holder, frontier=frontier):
+                holder[0] = fwd_step(frontier)
+
             with trace_span("dedup", level=k):
-                n = int(count)  # the one host sync per level
+                n = self._retry("engine.forward", _sync, reset=_redispatch,
+                                level=k)
+            if holder[0] is not pending:
+                pending = holder[0]
+                spec = spec_input = None  # speculation predates the retry
+            uniq, count, uidx, prim = pending
             rec = levels[k]
             if uidx is not None:
                 extra = prim.nbytes + uidx.nbytes
@@ -1065,8 +1099,20 @@ class Solver:
             from_checkpoint = k in completed
             item = np.dtype(g.state_dtype).itemsize
             lvl_sort_bytes = lvl_gather_bytes = 0
+            table = None
             if from_checkpoint:
-                table = self.checkpointer.load_level(k)
+                from gamesmanmpi_tpu.utils.checkpoint import TORN_NPZ_ERRORS
+
+                try:
+                    table = self.checkpointer.load_level(k)
+                except TORN_NPZ_ERRORS as e:
+                    # Torn or crc-mismatching sealed level (the loader
+                    # already quarantined a crc failure): degrade to the
+                    # intact prefix — the frontier is still known, so the
+                    # level recomputes and re-seals over the quarantine.
+                    self.checkpointer.quarantine_and_log(k, e, self.logger)
+                    from_checkpoint = False
+            if from_checkpoint:
                 states_host = rec.host_states()
                 if table.states.shape[0] != n or not (
                     np.asarray(table.states, dtype=g.state_dtype) == states_host
@@ -1078,24 +1124,30 @@ class Solver:
                 values_dev = jnp.asarray(pad_to_cap_u8(table.values, cap))
                 rem_dev = jnp.asarray(pad_to_cap_i32(table.remoteness, cap))
             else:
-                if prev is not None and rec.uidx is not None:
-                    # uidx read (4 B) + packed-cell gather (4 B) per child.
-                    lvl_gather_bytes = C * g.max_moves * 8
-                    # Gather-only resolve from forward provenance: no
-                    # search, no re-expansion (see resolve_provenance).
-                    wcap = caps[k + 1]
-                    wv = jax.lax.slice(prev[1], (0,), (wcap,))
-                    wr = jax.lax.slice(prev[2], (0,), (wcap,))
-                    values_dev, rem_dev, misses = self._resolve_blocked_prov(
-                        n,
-                        self._pad_dev(rec.prim, C, np.uint8(UNDECIDED)),
-                        self._pad_dev(
-                            rec.uidx, C * g.max_moves, np.int32(-1)
-                        ),
-                        self._pad_dev(wv, C, np.uint8(UNDECIDED)),
-                        self._pad_dev(wr, C, np.int32(0)),
-                    )
-                else:
+                def _resolve():
+                    # The level's inputs (states_dev, prev window triple,
+                    # stored provenance) are all still referenced, so a
+                    # transient failure re-dispatches idempotently.
+                    nonlocal lvl_sort_bytes, lvl_gather_bytes
+                    faults.fire("engine.backward", level=k)
+                    if prev is not None and rec.uidx is not None:
+                        # uidx read (4 B) + packed-cell gather (4 B) per
+                        # child.
+                        lvl_gather_bytes = C * g.max_moves * 8
+                        # Gather-only resolve from forward provenance: no
+                        # search, no re-expansion (see resolve_provenance).
+                        wcap = caps[k + 1]
+                        wv = jax.lax.slice(prev[1], (0,), (wcap,))
+                        wr = jax.lax.slice(prev[2], (0,), (wcap,))
+                        return self._resolve_blocked_prov(
+                            n,
+                            self._pad_dev(rec.prim, C, np.uint8(UNDECIDED)),
+                            self._pad_dev(
+                                rec.uidx, C * g.max_moves, np.int32(-1)
+                            ),
+                            self._pad_dev(wv, C, np.uint8(UNDECIDED)),
+                            self._pad_dev(wr, C, np.int32(0)),
+                        )
                     if prev is not None:
                         if search_method() == "sort":
                             # Sort-merge join operands + fused u64 payload
@@ -1125,9 +1177,11 @@ class Solver:
                             self._pad_dev(wr, C, np.int32(0)),
                         )
                         wcaps = (args[0].shape[0],)
-                    values_dev, rem_dev, misses = self._resolve_blocked(
-                        states_dev, wcaps, args
-                    )
+                    return self._resolve_blocked(states_dev, wcaps, args)
+
+                values_dev, rem_dev, misses = self._retry(
+                    "engine.backward", _resolve, level=k
+                )
                 if self.paranoid and int(misses) > 0:
                     raise SolverError(
                         f"level {k}: {int(misses)} consistency failures (child "
@@ -1211,9 +1265,23 @@ class Solver:
             # (whose wait is the int(count) sync) plus the host-side
             # merge of multi-jump children into per-level pools.
             with trace_span("dedup", level=k):
-                n = int(count)
-                kids = np.asarray(uniq[:n])
-                kid_levels = np.asarray(levels[:n])
+                holder = [(uniq, levels, count)]
+
+                def _sync(holder=holder, k=k):
+                    faults.fire("engine.forward", level=k)
+                    faults.fire("engine.dedup", level=k)
+                    u, lv, c = holder[0]
+                    nn = int(c)
+                    return nn, np.asarray(u[:nn]), np.asarray(lv[:nn])
+
+                def _redispatch(holder=holder, padded=padded):
+                    holder[0] = self._fwd_generic(padded.shape[0])(
+                        jnp.asarray(padded)
+                    )
+
+                n, kids, kid_levels = self._retry(
+                    "engine.forward", _sync, reset=_redispatch, level=k
+                )
                 for lv in np.unique(kid_levels):
                     lv = int(lv)
                     if lv >= g.num_levels:
@@ -1261,8 +1329,18 @@ class Solver:
             self.progress = {"phase": "backward", "level": k, "n": int(n)}
             from_checkpoint = k in completed
             lvl_sort_bytes = lvl_gather_bytes = 0
+            table = None
             if from_checkpoint:
-                table = self.checkpointer.load_level(k)
+                from gamesmanmpi_tpu.utils.checkpoint import TORN_NPZ_ERRORS
+
+                try:
+                    table = self.checkpointer.load_level(k)
+                except TORN_NPZ_ERRORS as e:
+                    # Same degrade contract as the fast path: quarantine
+                    # and recompute from the still-known frontier.
+                    self.checkpointer.quarantine_and_log(k, e, self.logger)
+                    from_checkpoint = False
+            if from_checkpoint:
                 if table.states.shape[0] != n or not (
                     np.asarray(table.states, dtype=g.state_dtype) == states
                 ).all():
@@ -1293,9 +1371,16 @@ class Solver:
                     lvl_gather_bytes = cm * 8 * len(wcaps)
                 self.bytes_sorted += lvl_sort_bytes
                 self.bytes_gathered += lvl_gather_bytes
-                values_dev, rem_dev, misses = self._resolve_blocked(
-                    jnp.asarray(padded), wcaps,
-                    tuple(jnp.asarray(a) for a in window_flat),
+
+                def _resolve():
+                    faults.fire("engine.backward", level=k)
+                    return self._resolve_blocked(
+                        jnp.asarray(padded), wcaps,
+                        tuple(jnp.asarray(a) for a in window_flat),
+                    )
+
+                values_dev, rem_dev, misses = self._retry(
+                    "engine.backward", _resolve, level=k
                 )
                 if self.paranoid and int(misses) > 0:
                     raise SolverError(
@@ -1341,7 +1426,11 @@ class Solver:
         The heartbeat thread (obs/heartbeat.py) reads `self.progress` —
         replaced atomically at each phase/level boundary — and emits
         periodic JSONL records + registry gauges, so a wedged multi-hour
-        solve reports its last known level, RSS, and device memory."""
+        solve reports its last known level, RSS, and device memory. The
+        watchdog (resilience/supervisor.py, GAMESMAN_WATCHDOG_SECS)
+        reads the same progress and turns a stall past its adaptive
+        deadline into a diagnosed abort with the checkpoint prefix
+        intact."""
         hb = None
         if self.heartbeat_secs > 0:
             hb = Heartbeat(
@@ -1349,11 +1438,14 @@ class Solver:
                 progress=lambda: self.progress,
                 logger=self.logger,
             ).start()
+        wd = maybe_watchdog(lambda: self.progress, logger=self.logger)
         try:
             return self._solve_impl()
         finally:
             if hb is not None:
                 hb.stop()
+            if wd is not None:
+                wd.stop()
 
     def _solve_impl(self) -> SolveResult:
         g = self.game
@@ -1441,6 +1533,10 @@ class Solver:
             "secs_backward": t_total - t_forward,
             "secs_total": t_total,
             "positions_per_sec": num_positions / max(t_total, 1e-9),
+            # Transient level-step failures absorbed by retry (0 on a
+            # clean run; the per-point breakdown is in the registry's
+            # gamesman_retries_total).
+            "retries": self.retries,
             # Roofline denominators (SURVEY.md §5.5): analytic operand
             # bytes of the sort/gather kernels; see docs/ARCHITECTURE.md
             # "Efficiency accounting" for how to read them.

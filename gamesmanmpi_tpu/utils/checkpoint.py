@@ -15,6 +15,7 @@ import json
 import os
 import pathlib
 import zipfile
+import zlib
 
 import jax.numpy as jnp
 import numpy as np
@@ -24,16 +25,45 @@ from gamesmanmpi_tpu.core.codec import (
     unpack_cells,
     unpack_cells_np,
 )
+from gamesmanmpi_tpu.resilience import faults
+
+
+class CorruptCheckpointError(ValueError):
+    """A sealed checkpoint file failed its manifest crc32 — silent
+    bit-rot or an overwrite the torn-zip errors cannot see. Subclasses
+    ValueError so every existing TORN_NPZ_ERRORS degrade path treats it
+    as one more torn-file shape."""
+
 
 #: What a torn/truncated/deleted npz read can raise (ADVICE r5): missing
 #: file, a zip whose central directory never landed, a short read surfacing
 #: as a bare OSError, a zip that lost a member (KeyError on z["name"]), or
 #: overwritten-with-garbage content (np.load raises ValueError when the
-#: bytes are neither zip nor npy). Loaders that degrade to an intact
-#: prefix catch exactly this tuple.
+#: bytes are neither zip nor npy; CorruptCheckpointError — a crc32
+#: mismatch against the manifest — is a ValueError too). Loaders that
+#: degrade to an intact prefix catch exactly this tuple.
 TORN_NPZ_ERRORS = (
     FileNotFoundError, zipfile.BadZipFile, OSError, KeyError, ValueError
 )
+
+
+def file_crc32(path, chunk: int = 1 << 20) -> int:
+    """Streaming crc32 of a file (zlib polynomial, chunked reads — disk
+    speed, constant memory, so sealing a multi-GB shard stays cheap)."""
+    crc = 0
+    with open(path, "rb") as fh:
+        while True:
+            block = fh.read(chunk)
+            if not block:
+                break
+            crc = zlib.crc32(block, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _verify_enabled() -> bool:
+    return os.environ.get("GAMESMAN_CKPT_VERIFY", "1") not in (
+        "0", "off", "false"
+    )
 
 
 def _savez(path, **arrays) -> None:
@@ -101,6 +131,94 @@ class LevelCheckpointer:
         tmp.write_text(json.dumps(manifest))
         os.replace(tmp, self.manifest_path)
 
+    # ------------------------------------------------------------ integrity
+    # Per-file crc32, recorded in the manifest when a file is sealed and
+    # verified when it is loaded for resume. Atomic _savez already rules
+    # out torn WRITES; the crc catches what atomicity cannot — silent
+    # bit-rot, a partial overwrite by a foreign process, a filesystem
+    # that lied about durability. A mismatching file is quarantined
+    # (renamed .corrupt, unsealed from the manifest) and the loader
+    # raises CorruptCheckpointError, which every TORN_NPZ_ERRORS degrade
+    # path already turns into "recompute this level from the intact
+    # prefix".
+
+    def _check_crc(self, path: pathlib.Path, manifest=None) -> None:
+        """Verify one sealed file against its recorded crc (no-op when
+        no crc was recorded — pre-integrity checkpoint directories keep
+        loading — or when GAMESMAN_CKPT_VERIFY=0). ``manifest`` lets a
+        loop verify many files against ONE manifest read (a sharded
+        level is S files; S redundant manifest reads on a shared
+        checkpoint filesystem are not free)."""
+        if not _verify_enabled():
+            return
+        if manifest is None:
+            manifest = self.load_manifest()
+        want = manifest.get("crc", {}).get(path.name)
+        if want is None or not path.exists():
+            return
+        got = file_crc32(path)
+        if got != int(want):
+            raise CorruptCheckpointError(
+                f"{path.name}: crc32 {got:#010x} != sealed {int(want):#010x}"
+                " — quarantine and recompute"
+            )
+
+    def quarantine_level(self, level: int) -> None:
+        """Rename a sealed level's file(s) to ``.corrupt`` and unseal it,
+        so the run degrades to the intact prefix: the level recomputes
+        (its frontier is still known) and re-seals over the quarantine.
+        Idempotent — callers may race the loader's own quarantine."""
+        manifest = self.load_manifest()
+        paths = [self._level_path(level)]
+        num = manifest.get("sharded_levels", {}).get(str(level))
+        if num:
+            paths += [self._shard_level_path(level, s) for s in range(num)]
+        crc = manifest.get("crc", {})
+        for p in paths:
+            if p.exists():
+                p.rename(p.with_name(p.name + ".corrupt"))
+            crc.pop(p.name, None)
+        if level in manifest.get("levels", []):
+            manifest["levels"] = [
+                l for l in manifest["levels"] if l != level
+            ]
+        manifest.get("sharded_levels", {}).pop(str(level), None)
+        self._write_manifest(manifest)
+
+    def quarantine_and_log(self, level: int, exc, logger=None) -> None:
+        """The one degrade contract every resume path shares: quarantine
+        the level's sealed files and emit the ``ckpt_degraded`` record
+        (phase name + 200-char error truncation live HERE, not at three
+        call sites)."""
+        self.quarantine_level(level)
+        if logger is not None:
+            logger.log({
+                "phase": "ckpt_degraded", "level": int(level),
+                "error": str(exc)[:200],
+            })
+
+    def _quarantine_frontier(self, level: int) -> None:
+        """Quarantine one incrementally-saved frontier level and truncate
+        the discovery prefix there: every deeper frontier is unsealed too
+        (the resume contract is contiguous-from-root), and the
+        ``frontiers_complete`` flag drops so the engine re-expands from
+        the surviving prefix instead of trusting a holed snapshot."""
+        manifest = self.load_manifest()
+        crc = manifest.get("crc", {})
+        kept, dropped = [], []
+        for k in manifest.get("forward_levels", []):
+            (kept if int(k) < level else dropped).append(int(k))
+        for k in dropped:
+            p = self.dir / f"frontier_{k:04d}.npz"
+            if k == level and p.exists():
+                # Only the corrupt file is renamed; deeper levels are
+                # merely unsealed (re-expansion re-saves over them).
+                p.rename(p.with_name(p.name + ".corrupt"))
+            crc.pop(p.name, None)
+        manifest["forward_levels"] = sorted(kept)
+        manifest.pop("frontiers_complete", None)
+        self._write_manifest(manifest)
+
     def bind_game(self, name: str) -> None:
         """Record/validate which game this directory belongs to.
 
@@ -124,12 +242,17 @@ class LevelCheckpointer:
         cells = np.asarray(
             pack_cells(jnp.asarray(table.values), jnp.asarray(table.remoteness))
         )
-        _savez(
-            self._level_path(level), states=table.states, cells=cells
-        )
+        path = self._level_path(level)
+        _savez(path, states=table.states, cells=cells)
         manifest = self.load_manifest()
         manifest["levels"] = sorted(set(manifest.get("levels", [])) | {level})
+        # Seal + crc land in ONE manifest write: a death in between could
+        # otherwise leave a sealed level whose crc is missing (fine — crc
+        # checks are best-effort for pre-integrity files) but never a crc
+        # for an unsealed level.
+        manifest.setdefault("crc", {})[path.name] = file_crc32(path)
         self._write_manifest(manifest)
+        faults.fire("ckpt.save_level", path=str(path), level=level)
 
     def load_manifest(self) -> dict:
         if self.manifest_path.exists():
@@ -138,11 +261,21 @@ class LevelCheckpointer:
 
     def load_level(self, level: int):
         """Global (sorted) table of one level — from the global file, or
-        assembled from per-shard files when the level was saved sharded."""
+        assembled from per-shard files when the level was saved sharded.
+
+        Verifies the manifest crc first; a mismatch quarantines the
+        level and raises CorruptCheckpointError (a TORN_NPZ_ERRORS
+        member), which resume paths degrade to a recompute."""
         from gamesmanmpi_tpu.solve.engine import LevelTable
 
+        faults.fire("ckpt.load_level", level=level)
         path = self._level_path(level)
         if path.exists():
+            try:
+                self._check_crc(path)
+            except CorruptCheckpointError:
+                self.quarantine_level(level)
+                raise
             with np.load(path) as z:
                 states = z["states"]
                 values, remoteness = unpack_cells(jnp.asarray(z["cells"]))
@@ -151,12 +284,13 @@ class LevelCheckpointer:
                 values=np.asarray(values),
                 remoteness=np.asarray(remoteness),
             )
-        num = self.level_shard_count(level)
+        manifest = self.load_manifest()
+        num = manifest.get("sharded_levels", {}).get(str(level))
         if num is None:
             raise FileNotFoundError(f"no checkpoint for level {level}")
         gs, gc = [], []
         for s in range(num):
-            states, cells = self.load_level_shard(level, s)
+            states, cells = self.load_level_shard(level, s, manifest)
             gs.append(states)
             gc.append(cells)
         states = np.concatenate(gs)
@@ -213,15 +347,37 @@ class LevelCheckpointer:
     def finish_level_shards(self, level: int, num_shards: int) -> None:
         manifest = self.load_manifest()
         manifest.setdefault("sharded_levels", {})[str(level)] = num_shards
+        # The sealer (process 0, post-barrier) records every shard file's
+        # crc — the files live on the shared checkpoint filesystem, and
+        # sealing is the one moment the set is known complete.
+        crc = manifest.setdefault("crc", {})
+        for s in range(num_shards):
+            p = self._shard_level_path(level, s)
+            if p.exists():
+                crc[p.name] = file_crc32(p)
         self._write_manifest(manifest)
+        faults.fire(
+            "ckpt.save_level",
+            path=str(self._shard_level_path(level, 0)),
+            level=level,
+        )
 
     def level_shard_count(self, level: int):
         """Shards the level was saved with, or None if not saved sharded."""
         return self.load_manifest().get("sharded_levels", {}).get(str(level))
 
-    def load_level_shard(self, level: int, shard: int):
-        """-> (states, packed cells) of one shard of one level."""
-        with np.load(self._shard_level_path(level, shard)) as z:
+    def load_level_shard(self, level: int, shard: int, manifest=None):
+        """-> (states, packed cells) of one shard of one level (crc-
+        verified; a mismatch quarantines the whole level and raises).
+        Callers looping over a level's shards pass one pre-loaded
+        ``manifest`` instead of paying a read per shard."""
+        path = self._shard_level_path(level, shard)
+        try:
+            self._check_crc(path, manifest)
+        except CorruptCheckpointError:
+            self.quarantine_level(level)
+            raise
+        with np.load(path) as z:
             return z["states"], z["cells"]
 
     def lookup_level_state(self, level: int, state):
@@ -430,22 +586,33 @@ class LevelCheckpointer:
         known. The manifest records the level only after the file is fully
         written, so a death mid-write never yields a listed-but-corrupt
         entry (same discipline as save_level)."""
-        _savez(
-            self.dir / f"frontier_{level:04d}.npz", states=np.asarray(states)
-        )
+        path = self.dir / f"frontier_{level:04d}.npz"
+        _savez(path, states=np.asarray(states))
         manifest = self.load_manifest()
         manifest["forward_levels"] = sorted(
             set(manifest.get("forward_levels", [])) | {level}
         )
+        manifest.setdefault("crc", {})[path.name] = file_crc32(path)
         self._write_manifest(manifest)
+        faults.fire("ckpt.save_frontier", path=str(path), level=level)
 
     def load_forward_levels(self) -> dict:
         """-> {level: sorted packed states} saved incrementally during a
-        (possibly interrupted) forward sweep; {} when none exist."""
+        (possibly interrupted) forward sweep; {} when none exist. A
+        torn or crc-mismatching level quarantines there and keeps the
+        intact prefix below it (re-expansion resumes from its deepest),
+        exactly like the sharded loader's torn-directory handling."""
         out = {}
-        for k in self.load_manifest().get("forward_levels", []):
-            with np.load(self.dir / f"frontier_{int(k):04d}.npz") as z:
-                out[int(k)] = z["states"]
+        for k in sorted(self.load_manifest().get("forward_levels", []),
+                        key=int):
+            path = self.dir / f"frontier_{int(k):04d}.npz"
+            try:
+                self._check_crc(path)
+                with np.load(path) as z:
+                    out[int(k)] = z["states"]
+            except TORN_NPZ_ERRORS:
+                self._quarantine_frontier(int(k))
+                break
         return out
 
     def mark_frontiers_complete(self) -> None:
@@ -462,10 +629,13 @@ class LevelCheckpointer:
         arrays = {
             f"level_{k:04d}": np.asarray(v) for k, v in pools.items()
         }
-        _savez(self.dir / "frontiers.npz", **arrays)
+        path = self.dir / "frontiers.npz"
+        _savez(path, **arrays)
         manifest = self.load_manifest()
         manifest["frontiers"] = True
+        manifest.setdefault("crc", {})[path.name] = file_crc32(path)
         self._write_manifest(manifest)
+        faults.fire("ckpt.save_frontier", path=str(path))
 
     def load_frontiers(self):
         """-> {level: sorted packed states} or None if no snapshot exists.
@@ -478,13 +648,29 @@ class LevelCheckpointer:
         if manifest.get("frontiers"):
             path = self.dir / "frontiers.npz"
             if path.exists():
-                out = {}
-                with np.load(path) as z:
-                    for name in z.files:
-                        out[int(name.split("_")[1])] = z[name]
-                return out
+                try:
+                    self._check_crc(path)
+                    out = {}
+                    with np.load(path) as z:
+                        for name in z.files:
+                            out[int(name.split("_")[1])] = z[name]
+                    return out
+                except TORN_NPZ_ERRORS:
+                    # Corrupt global snapshot: quarantine it and fall
+                    # through to the other resume sources (or a fresh
+                    # forward) instead of dying on resume.
+                    path.rename(path.with_name(path.name + ".corrupt"))
+                    manifest.pop("frontiers", None)
+                    manifest.get("crc", {}).pop(path.name, None)
+                    self._write_manifest(manifest)
         if manifest.get("frontiers_complete"):
-            return self.load_forward_levels()
+            out = self.load_forward_levels()
+            if self.load_manifest().get("frontiers_complete"):
+                return out
+            # A frontier level quarantined mid-load: the snapshot is no
+            # longer complete — resume as a partial forward instead
+            # (load_forward_levels serves the intact prefix).
+            return None
         num = manifest.get("frontier_shards")
         if num is None:
             return None

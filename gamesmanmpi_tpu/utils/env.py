@@ -1,0 +1,31 @@
+"""Shared env-knob parsing: warn-and-default numeric reads.
+
+One home for the degradation contract every numeric `GAMESMAN_*` knob
+follows (malformed values must not break package import or a running
+server — they warn and fall back). solve/engine.py predates this module
+and keeps local twins for its public `_env_int`/`_env_float` (imported
+by the sharded engine); new subsystems import from here.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+
+def env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, str(default))
+    try:
+        return int(raw)
+    except ValueError:
+        warnings.warn(f"{name}={raw!r} is not an integer; using {default}")
+        return default
+
+
+def env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, str(default))
+    try:
+        return float(raw)
+    except ValueError:
+        warnings.warn(f"{name}={raw!r} is not a number; using {default}")
+        return default

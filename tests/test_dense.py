@@ -262,6 +262,7 @@ def test_dense_no_tables_mode():
         rd.lookup(int(g.initial_state()))
 
 
+@pytest.mark.slow  # ~47 s CPU: full-solve A/B of the fused rank lowering
 def test_dense_fused_rank_matches_simple(monkeypatch):
     # GAMESMAN_DENSE_RANK=fused is a pure lowering change (one walk for
     # all moves instead of per-move walks): every table cell must match.
@@ -286,6 +287,7 @@ def test_dense_fused_rank_matches_simple(monkeypatch):
         np.testing.assert_array_equal(f2.cells[L], cells)
 
 
+@pytest.mark.slow  # ~42 s CPU: full-solve A/B of the sorted-gather lowering
 def test_dense_sorted_gather_matches_plain(monkeypatch):
     # GAMESMAN_DENSE_GATHER=sorted is a lowering hint (monotone fill for
     # invalid rows + pad lanes, indices_are_sorted gather): every cell of
@@ -306,6 +308,7 @@ def test_dense_sorted_gather_matches_plain(monkeypatch):
         np.testing.assert_array_equal(both.cells[L], cells)
 
 
+@pytest.mark.slow  # ~147 s: pallas kernel emulated on CPU, full-solve A/B
 def test_dense_pallas_gather_matches_plain(monkeypatch):
     # GAMESMAN_DENSE_GATHER=pallas routes the monotone fill through the
     # Mosaic monotone-window gather (interpret mode on CPU) with the
@@ -324,6 +327,7 @@ def test_dense_pallas_gather_matches_plain(monkeypatch):
         np.testing.assert_array_equal(pal.cells[L], cells)
 
 
+@pytest.mark.slow  # ~85 s: pallas int64 path emulated on CPU, full-solve A/B
 def test_dense_pallas_gather_int64_flat_matches_plain(monkeypatch):
     # int64 flat index spaces (6x6+, where the gather win matters most)
     # are pallas-eligible since r5: the kernel wrapper derives

@@ -37,6 +37,7 @@ def test_hybrid_full_parity_3x3c3():
     _full_parity("connect4:w=3,h=3,connect=3", (0, 3, default_cutover(9), 8))
 
 
+@pytest.mark.slow  # ~64 s CPU full-board parity; 3x3c3 covers the seam fast
 def test_hybrid_full_parity_4x3():
     _full_parity("connect4:w=4,h=3", (5, 8))
 

@@ -8,6 +8,7 @@ load) and the LRU cache hit on repeats.
 
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -232,6 +233,138 @@ def test_batcher_coalesces_and_caches(nim_db):
         batcher.close()
     with pytest.raises(RuntimeError, match="closed"):
         batcher.submit([0])
+
+
+def test_batcher_close_rejects_parked_submitters(nim_db):
+    """Submitters parked in the coalescing window when close() lands must
+    receive BatcherClosed — not hang forever on an event nobody sets."""
+    from gamesmanmpi_tpu.serve import BatcherClosed
+
+    reader, oracle = nim_db
+    batcher = Batcher(reader, window=60.0, cache_size=0)  # park "forever"
+    errors = []
+
+    def worker():
+        try:
+            batcher.submit(sorted(oracle)[:3])
+            errors.append("answered")  # should NOT be flushed
+        except BatcherClosed:
+            errors.append("closed")
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    deadline = threading.Event()
+    for _ in range(500):  # wait until the request is parked
+        if batcher.metrics()["cache_misses"] >= 3 and not deadline.wait(0.01):
+            break
+    batcher.close()
+    t.join(timeout=10)
+    assert not t.is_alive(), "parked submitter hung across close()"
+    assert errors == ["closed"]
+
+
+def test_batcher_burst_splits_across_probes(nim_db):
+    """A synchronized burst larger than max_batch must split into
+    multiple probes with every request answered (none starved behind an
+    oversized batch)."""
+    reader, oracle = nim_db
+    positions = sorted(oracle)[:24]
+    batcher = Batcher(reader, window=0.05, cache_size=0, max_batch=8)
+    try:
+        barrier = threading.Barrier(6)
+        outs = [None] * 6
+
+        def worker(i):
+            barrier.wait()
+            outs[i] = batcher.submit(positions[i * 4:(i + 1) * 4])
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i in range(6):
+            assert outs[i] is not None
+            for pos, (v, r, found, _) in zip(
+                positions[i * 4:(i + 1) * 4], outs[i]
+            ):
+                assert found and (v, r) == oracle[pos]
+        m = batcher.metrics()
+        # 24 positions with an 8-position flush threshold: >= 3 probes,
+        # none above the threshold.
+        assert m["batches"] >= 3
+        assert m["max_batch_size"] <= 8
+    finally:
+        batcher.close()
+
+
+def test_batcher_sheds_when_queue_full(nim_db):
+    """max_queue requests parked -> further submits answer
+    BatcherOverloaded immediately instead of deepening the pile."""
+    from gamesmanmpi_tpu.serve import BatcherOverloaded
+
+    reader, oracle = nim_db
+    positions = sorted(oracle)
+    batcher = Batcher(reader, window=60.0, cache_size=0, max_queue=1)
+
+    def _park():
+        with pytest.raises(RuntimeError):  # BatcherClosed at teardown
+            batcher.submit(positions[:2], timeout=15)
+
+    try:
+        parked = threading.Thread(target=_park)
+        parked.start()
+        for _ in range(500):
+            if batcher.metrics()["cache_misses"] >= 2:
+                break
+            time.sleep(0.01)
+        with pytest.raises(BatcherOverloaded):
+            batcher.submit(positions[2:4])
+        assert batcher.metrics()["shed"] >= 1
+    finally:
+        batcher.close()  # parked request gets BatcherClosed
+        parked.join(timeout=10)
+        assert not parked.is_alive()
+
+
+def test_client_abort_is_counted_not_crashed(nim_db):
+    """A client that hangs up mid-response increments http_client_aborts
+    instead of dumping a handler-thread traceback."""
+    import socket
+
+    reader, oracle = nim_db
+    with QueryServer(reader, window=0.001) as server:
+        # Large response (many positions) so the server's write
+        # overflows the socket buffer and hits the closed peer. 6000
+        # repeats of one position keep the probe kernel at a modest
+        # capacity bucket while the response stays a few hundred KB.
+        positions = [sorted(oracle)[0]] * 6000
+        body = json.dumps({"positions": positions}).encode()
+        req = (
+            b"POST /query HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n"
+        ) + body
+        s = socket.create_connection(("127.0.0.1", server.port), timeout=10)
+        # RST on close so the server's write fails loudly and promptly.
+        s.setsockopt(
+            socket.SOL_SOCKET, socket.SO_LINGER,
+            __import__("struct").pack("ii", 1, 0),
+        )
+        s.sendall(req)
+        s.close()
+        deadline = time.monotonic() + 10
+        aborts = 0
+        while time.monotonic() < deadline:
+            aborts = server.metrics()["http_client_aborts"]
+            if aborts:
+                break
+            time.sleep(0.05)
+        assert aborts >= 1
 
 
 def test_serve_jsonl_metrics(nim_db, tmp_path):

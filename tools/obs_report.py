@@ -54,7 +54,12 @@ def summarize_levels(records: list[dict]) -> list[dict]:
         phase = rec.get("phase")
         # backward_edges is the sharded engine's edge-cached resolve of a
         # level (GAMESMAN_BACKWARD=edges) — same schema, same bwd column.
-        if (phase not in ("forward", "backward", "backward_edges")
+        # retry / ckpt_degraded are the resilience layer's per-level
+        # records (absorbed transients, quarantined checkpoint levels):
+        # folded into the retries column so an operator sees WHERE a
+        # flaky run flaked.
+        if (phase not in ("forward", "backward", "backward_edges",
+                          "retry", "ckpt_degraded")
                 or "level" not in rec):
             continue
         row = levels.setdefault(
@@ -64,10 +69,14 @@ def summarize_levels(records: list[dict]) -> list[dict]:
                 "positions": 0,
                 "fwd_secs": 0.0,
                 "bwd_secs": 0.0,
+                "retries": 0,
                 "bytes_sorted": 0,
                 "bytes_gathered": 0,
             },
         )
+        if phase in ("retry", "ckpt_degraded"):
+            row["retries"] += 1
+            continue
         secs = float(rec.get("secs", 0.0))
         row["bytes_sorted"] += int(rec.get("bytes_sorted", 0))
         row["bytes_gathered"] += int(rec.get("bytes_gathered", 0))
@@ -89,11 +98,12 @@ def summarize_levels(records: list[dict]) -> list[dict]:
 def format_table(rows: list[dict]) -> str:
     header = (
         f"{'level':>5}  {'positions':>10}  {'fwd_s':>8}  {'bwd_s':>8}  "
-        f"{'total_s':>8}  {'pos/s':>12}  {'sort_MB':>9}  {'gather_MB':>9}"
+        f"{'total_s':>8}  {'pos/s':>12}  {'retries':>7}  {'sort_MB':>9}  "
+        f"{'gather_MB':>9}"
     )
     lines = [header]
     tot = {
-        "positions": 0, "fwd_secs": 0.0, "bwd_secs": 0.0,
+        "positions": 0, "fwd_secs": 0.0, "bwd_secs": 0.0, "retries": 0,
         "bytes_sorted": 0, "bytes_gathered": 0,
     }
     for r in rows:
@@ -102,16 +112,18 @@ def format_table(rows: list[dict]) -> str:
         lines.append(
             f"{r['level']:>5}  {r['positions']:>10}  {r['fwd_secs']:>8.3f}  "
             f"{r['bwd_secs']:>8.3f}  {total:>8.3f}  {pps:>12.1f}  "
+            f"{r.get('retries', 0):>7}  "
             f"{r['bytes_sorted'] / 1e6:>9.1f}  "
             f"{r['bytes_gathered'] / 1e6:>9.1f}"
         )
         for k in tot:
-            tot[k] += r[k]
+            tot[k] += r.get(k, 0)
     total = tot["fwd_secs"] + tot["bwd_secs"]
     pps = tot["positions"] / total if total > 0 else 0.0
     lines.append(
         f"{'TOTAL':>5}  {tot['positions']:>10}  {tot['fwd_secs']:>8.3f}  "
         f"{tot['bwd_secs']:>8.3f}  {total:>8.3f}  {pps:>12.1f}  "
+        f"{tot['retries']:>7}  "
         f"{tot['bytes_sorted'] / 1e6:>9.1f}  "
         f"{tot['bytes_gathered'] / 1e6:>9.1f}"
     )
@@ -135,7 +147,12 @@ def report(records: list[dict]) -> str:
     aux = {}
     for rec in records:
         phase = rec.get("phase")
-        if phase not in ("forward", "backward", "backward_edges", "done"):
+        # retry/ckpt_degraded already rolled into the level table's
+        # retries column; a retry without a level (serving) still lands
+        # here.
+        if phase not in ("forward", "backward", "backward_edges", "done") \
+                and not (phase in ("retry", "ckpt_degraded")
+                         and "level" in rec):
             aux[phase] = aux.get(phase, 0) + 1
     if aux:
         out.append(
