@@ -1,0 +1,30 @@
+"""gamesman-lint: project-aware static analysis (docs/ANALYSIS.md).
+
+The repo's correctness rests on conventions no generic linter knows:
+jitted/shard_map'd kernels must stay trace-pure (host impurity inside a
+traced function silently forces recompiles or host syncs — the class of
+bug that sinks retrograde-solver ports), the serve/obs/resilience layers
+are thread+lock code, and three registries (env vars vs docs/CONFIG.md,
+metrics vs docs/OBSERVABILITY.md, fault points vs the chaos matrix)
+drift unless a machine checks them. This package is that machine: an
+AST-based checker suite run clean over the whole package as a tier-1
+test (tests/test_lint.py), with inline suppressions and a checked-in
+baseline for accepted findings.
+
+Run it:
+
+    python -m tools.lint              # or the gamesman-lint script
+
+Checker families (ids are stable; catalogue in docs/ANALYSIS.md):
+
+* ``GM1xx`` — JAX tracing safety (analysis/jax_tracing.py)
+* ``GM2xx`` — lock discipline / race detection (analysis/locks.py)
+* ``GM3xx`` — env-var registry parity (analysis/env_parity.py)
+* ``GM4xx`` — metrics registry parity (analysis/metrics_parity.py)
+* ``GM5xx`` — fault-point registry parity (analysis/faults_parity.py)
+"""
+
+from gamesmanmpi_tpu.analysis.diagnostics import Diagnostic
+from gamesmanmpi_tpu.analysis.runner import run_project
+
+__all__ = ["Diagnostic", "run_project"]

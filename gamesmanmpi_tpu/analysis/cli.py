@@ -1,0 +1,125 @@
+"""gamesman-lint command line (also ``python -m tools.lint``).
+
+Exit status: 0 clean (no new findings), 1 new findings, 2 bad usage.
+The default baseline is ``<root>/lint_baseline.json``; a missing file
+is an empty baseline, which is the steady state this repo holds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from gamesmanmpi_tpu.analysis.diagnostics import write_baseline
+from gamesmanmpi_tpu.analysis.runner import run_project
+
+DEFAULT_BASELINE = "lint_baseline.json"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="gamesman-lint",
+        description="Project-aware static analysis for gamesmanmpi_tpu "
+                    "(checker catalogue: docs/ANALYSIS.md).",
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="files/directories to lint (default: every top-level "
+             "package plus tools/)",
+    )
+    ap.add_argument(
+        "--root", default=".",
+        help="project root for discovery, registry docs, and "
+             "path-relative reporting (default: cwd)",
+    )
+    ap.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help=f"baseline file (default: <root>/{DEFAULT_BASELINE})",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline: every finding is new",
+    )
+    ap.add_argument(
+        "--update-baseline", action="store_true",
+        help="accept all current findings into the baseline file and "
+             "exit 0",
+    )
+    ap.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="diagnostic output format",
+    )
+    ap.add_argument(
+        "--show-all", action="store_true",
+        help="also list baselined and suppressed findings",
+    )
+    args = ap.parse_args(argv)
+
+    import pathlib
+
+    default_baseline = str(pathlib.Path(args.root) / DEFAULT_BASELINE)
+    baseline_path = args.baseline
+    if baseline_path is None and not args.no_baseline:
+        baseline_path = default_baseline
+    if args.no_baseline:
+        baseline_path = None
+
+    if args.update_baseline and args.paths:
+        # A partial run sees a subset of findings; writing it back would
+        # silently drop every accepted entry outside the scanned paths.
+        print(
+            "gamesman-lint: error: --update-baseline requires a "
+            "whole-project run (no explicit paths)",
+            file=sys.stderr,
+        )
+        return 2
+
+    try:
+        result = run_project(args.root, paths=args.paths or None,
+                             baseline_path=baseline_path)
+    except (FileNotFoundError, ValueError) as e:
+        # Missing/outside-root targets and malformed baseline files are
+        # usage errors, not tracebacks.
+        print(f"gamesman-lint: error: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        # Always anchored at --root (or the explicit --baseline), never
+        # the process cwd — '--no-baseline --update-baseline' must not
+        # scatter baseline files wherever the command happened to run.
+        target = args.baseline or default_baseline
+        write_baseline(target, result.fingerprints)
+        print(
+            f"wrote {len(result.fingerprints)} finding(s) to {target}",
+            file=sys.stderr,
+        )
+        return 0
+
+    if args.format == "json":
+        payload = {
+            "new": [d.to_json() for d in result.new],
+            "baselined": [d.to_json() for d in result.baselined],
+            "suppressed": [d.to_json() for d in result.suppressed],
+            "ok": result.ok,
+        }
+        print(json.dumps(payload, indent=2))
+    else:
+        for d in result.new:
+            print(d.format())
+        if args.show_all:
+            for d in result.baselined:
+                print(f"{d.format()}  [baselined]")
+            for d in result.suppressed:
+                print(f"{d.format()}  [suppressed]")
+        summary = (
+            f"{len(result.new)} new, {len(result.baselined)} baselined, "
+            f"{len(result.suppressed)} suppressed finding(s) over "
+            f"{len(result.project.files)} file(s)"
+        )
+        print(summary, file=sys.stderr)
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,155 @@
+"""Diagnostic records, inline suppressions, and the findings baseline.
+
+One shared shape for every checker's output, plus the two escape
+hatches a lint that gates tier-1 must have:
+
+* inline suppression — ``# lint: disable=GM301`` on the flagged line
+  (or the line directly above it) silences those ids there; a
+  ``# lint: disable-file=GM301`` anywhere in a file's first
+  ``FILE_DIRECTIVE_LINES`` lines silences the ids for the whole file.
+  Suppressions are for findings that are *wrong or deliberate at that
+  site* (say why in the same comment);
+* baseline — a checked-in JSON file of accepted pre-existing findings.
+  Baselined findings are reported as suppressed, everything new fails
+  the run. Matching is by (id, path, fingerprint-of-source-line), not
+  line number, so unrelated edits don't churn the file; duplicates are
+  matched multiset-style.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import re
+
+#: How deep into a file a ``disable-file`` directive may sit.
+FILE_DIRECTIVE_LINES = 25
+
+_INLINE_RE = re.compile(r"#\s*lint:\s*disable=([A-Za-z0-9_,\s]+)")
+_FILE_RE = re.compile(r"#\s*lint:\s*disable-file=([A-Za-z0-9_,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Diagnostic:
+    """One finding: stable checker id + location + message."""
+
+    path: str  # project-root-relative, posix separators
+    line: int  # 1-based
+    id: str  # "GM301"
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.id} {self.message}"
+
+    def to_json(self) -> dict:
+        return {
+            "path": self.path, "line": self.line,
+            "id": self.id, "message": self.message,
+        }
+
+
+def _ids(match_group: str) -> set:
+    return {t.strip() for t in match_group.split(",") if t.strip()}
+
+
+def directive_lines(lines: list, line: int) -> list:
+    """The lines a comment directive may sit on to apply to 1-based
+    ``line``: the line itself, and a comment-ONLY line directly above.
+    The shared placement rule for ``# lint: disable`` and the lock
+    checker's ``# guarded-by``/``# requires-lock`` annotations — a
+    trailing directive on the previous statement's line never bleeds
+    onto the next."""
+    out = []
+    if 1 <= line <= len(lines):
+        out.append(lines[line - 1])
+    above = line - 1
+    if 1 <= above <= len(lines) and lines[above - 1].lstrip().startswith("#"):
+        out.append(lines[above - 1])
+    return out
+
+
+def suppressed_ids(lines: list, line: int) -> set:
+    """Ids silenced at 1-based ``line``: inline directives (placement per
+    ``directive_lines``) plus file-level directives. ``all`` silences
+    everything (use sparingly)."""
+    out: set = set()
+    for text in directive_lines(lines, line):
+        m = _INLINE_RE.search(text)
+        if m:
+            out |= _ids(m.group(1))
+    for text in lines[:FILE_DIRECTIVE_LINES]:
+        m = _FILE_RE.search(text)
+        if m:
+            out |= _ids(m.group(1))
+    return out
+
+
+def is_suppressed(diag: Diagnostic, lines: list) -> bool:
+    ids = suppressed_ids(lines, diag.line)
+    return diag.id in ids or "all" in ids
+
+
+# ------------------------------------------------------------------ baseline
+
+
+def fingerprint(diag: Diagnostic, lines: list) -> str:
+    """Line-number-independent identity of a finding: the checker id,
+    the file, and the whitespace-normalized source line it points at.
+    Messages are excluded — wording improvements must not churn the
+    baseline."""
+    src = ""
+    if 1 <= diag.line <= len(lines):
+        src = " ".join(lines[diag.line - 1].split())
+    digest = hashlib.sha256(
+        f"{diag.id}\n{diag.path}\n{src}".encode()
+    ).hexdigest()
+    return digest[:16]
+
+
+def load_baseline(path) -> list:
+    """[{id, path, fingerprint}, ...]; a missing file is an empty
+    baseline (the desired steady state)."""
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except FileNotFoundError:
+        return []
+    if not isinstance(data, dict) or not isinstance(
+        data.get("findings"), list
+    ):
+        raise ValueError(f"malformed baseline file {path}")
+    return data["findings"]
+
+
+def write_baseline(path, diags_with_fp) -> None:
+    findings = [
+        {
+            "id": d.id, "path": d.path, "fingerprint": fp,
+            # line + message are documentation for the human reading the
+            # baseline; matching ignores them.
+            "line": d.line, "message": d.message,
+        }
+        for d, fp in sorted(diags_with_fp, key=lambda t: t[0])
+    ]
+    with open(path, "w") as fh:
+        json.dump({"version": 1, "findings": findings}, fh, indent=2)
+        fh.write("\n")
+
+
+def split_by_baseline(diags_with_fp, baseline: list):
+    """Partition findings into (new, baselined). Baseline entries are a
+    multiset: two identical findings need two entries."""
+    budget: dict = {}
+    for e in baseline:
+        key = (e.get("id"), e.get("path"), e.get("fingerprint"))
+        budget[key] = budget.get(key, 0) + 1
+    new, old = [], []
+    for d, fp in diags_with_fp:
+        key = (d.id, d.path, fp)
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            old.append(d)
+        else:
+            new.append(d)
+    return new, old

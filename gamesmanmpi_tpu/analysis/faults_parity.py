@@ -1,0 +1,119 @@
+"""GM5xx — fault-point registry parity.
+
+``resilience/faults.py`` KNOWN_POINTS is the chaos contract: every
+woven ``faults.fire("point")`` call site must be registered, every
+registered point must actually be woven somewhere, and every point must
+be exercised by the chaos matrix (tests/test_resilience.py) — a fault
+point without chaos coverage is failure handling that has never run.
+
+| id | finding |
+|---|---|
+| GM501 | ``fire()`` on a point not in KNOWN_POINTS |
+| GM502 | KNOWN_POINTS entry with no ``fire()`` site anywhere |
+| GM503 | duplicate key in the KNOWN_POINTS dict literal (silently collapses) |
+| GM504 | registered point never referenced by the chaos matrix |
+| GM505 | ``fire()`` whose point is not statically resolvable |
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from gamesmanmpi_tpu.analysis.diagnostics import Diagnostic
+from gamesmanmpi_tpu.analysis.project import (
+    CHAOS_TEST,
+    Project,
+    SourceFile,
+    call_name,
+    const_str,
+    module_string_consts,
+)
+
+
+def _find_registry(
+    project: Project,
+) -> Tuple[Optional[SourceFile], Dict[str, int], List[Diagnostic]]:
+    """Locate the module-level ``KNOWN_POINTS = {...}`` dict: returns
+    (file, {point: line}, duplicate-key findings)."""
+    diags: List[Diagnostic] = []
+    for src in project.files:
+        if src.tree is None:
+            continue
+        for node in src.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "KNOWN_POINTS"
+                and isinstance(node.value, ast.Dict)
+            ):
+                points: Dict[str, int] = {}
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant) and isinstance(
+                        k.value, str
+                    ):
+                        if k.value in points:
+                            diags.append(Diagnostic(
+                                src.rel, k.lineno, "GM503",
+                                f"duplicate fault point {k.value!r} in "
+                                "KNOWN_POINTS — the first entry is "
+                                "silently overwritten",
+                            ))
+                        points[k.value] = k.lineno
+                return src, points, diags
+    return None, {}, diags
+
+
+def check(project: Project) -> List[Diagnostic]:
+    reg_src, points, diags = _find_registry(project)
+    if reg_src is None:
+        return diags  # project without a fault registry: nothing to check
+    fired: Dict[str, Tuple[str, int]] = {}
+    for src in project.files:
+        if src.tree is None or src is reg_src:
+            continue
+        consts = module_string_consts(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node).rsplit(".", 1)[-1] != "fire":
+                continue
+            if not node.args:
+                continue
+            point = const_str(node.args[0], consts)
+            if point is None:
+                diags.append(Diagnostic(
+                    src.rel, node.lineno, "GM505",
+                    "fire() with a non-literal fault point — the chaos "
+                    "registry can't be audited statically",
+                ))
+            elif point not in points:
+                diags.append(Diagnostic(
+                    src.rel, node.lineno, "GM501",
+                    f"fire({point!r}) is not registered in "
+                    "KNOWN_POINTS — it can never be armed and gets no "
+                    "chaos coverage",
+                ))
+            else:
+                fired.setdefault(point, (src.rel, node.lineno))
+    for point, line in sorted(points.items()):
+        if point not in fired:
+            diags.append(Diagnostic(
+                reg_src.rel, line, "GM502",
+                f"fault point {point!r} is registered but never "
+                "woven into any call site",
+            ))
+        # Exact-token match (dot/word boundaries): 'engine.forward' must
+        # not count as covered because 'engine.forward_edges' appears.
+        covered = re.search(
+            rf"(?<![\w.]){re.escape(point)}(?![\w.])", project.chaos_text
+        )
+        if not covered:
+            diags.append(Diagnostic(
+                reg_src.rel, line, "GM504",
+                f"fault point {point!r} has no chaos coverage — "
+                f"{CHAOS_TEST} never references it",
+            ))
+    return diags

@@ -1,0 +1,403 @@
+"""GM2xx — lock discipline / race detection.
+
+Opt-in annotations (comments, so the runtime never sees them):
+
+* ``# guarded-by: _lock`` on a field's declaring assignment (usually in
+  ``__init__``; same line or the line above) — every later read/write
+  of that attribute in the module must happen inside a
+  ``with self._lock:`` region (any receiver whose attribute chain ends
+  in the lock's name counts: ``with reg._lock:`` guards
+  ``fam.values``);
+* ``# requires-lock: _lock`` on a ``def`` line (or the line above) —
+  the method's body is checked as if the lock were held, and *callers*
+  must hold it.
+
+Lock inventory is read from ``__init__``: ``threading.Lock()`` /
+``RLock()`` / ``Condition(self._lock)``; a Condition constructed over a
+lock is an alias for it (holding the condition holds the lock — the
+batcher's ``_cond`` pattern). ``__init__`` itself is exempt from
+guarded-field checks: construction is single-threaded by contract.
+
+| id | finding |
+|---|---|
+| GM201 | guarded field accessed without its lock held |
+| GM202 | non-reentrant lock re-acquired while held (with-block or a call that acquires it) — deadlock |
+| GM203 | blocking call (queue.get / socket I/O / np.load / .result() / thread join / sleep / subprocess) while a lock is held |
+| GM204 | method annotated requires-lock called without the lock held |
+
+Analysis is lexical and name-based per module (the repo convention:
+one lock name means one lock), so it needs no imports and no types.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from gamesmanmpi_tpu.analysis.diagnostics import Diagnostic, directive_lines
+from gamesmanmpi_tpu.analysis.project import (
+    Project,
+    SourceFile,
+    attr_chain,
+    call_name,
+)
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
+_REQUIRES_RE = re.compile(r"#\s*requires-lock:\s*([A-Za-z_]\w*)")
+
+#: Call shapes that block the calling thread. Receiver-name patterns
+#: keep dict.get and str.join out of the match.
+_BLOCKING_SIMPLE = {
+    "time.sleep", "np.load", "numpy.load", "subprocess.run",
+    "subprocess.check_call", "subprocess.check_output", "os.waitpid",
+}
+_SOCKET_METHODS = {"recv", "recvfrom", "accept", "connect", "sendall",
+                   "makefile"}
+_QUEUEISH_RE = re.compile(r"(queue|_q$|^q$)", re.IGNORECASE)
+_THREADISH_RE = re.compile(
+    r"(thread|worker|proc|process|child|future)", re.IGNORECASE
+)
+
+
+def _comment_annotation(lines: List[str], lineno: int, rx) -> Optional[str]:
+    """First annotation applying to ``lineno`` (placement rule shared
+    with inline suppressions: diagnostics.directive_lines)."""
+    for text in directive_lines(lines, lineno):
+        m = rx.search(text)
+        if m:
+            return m.group(1)
+    return None
+
+
+def _final_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class _ModuleLocks:
+    """Per-module inventory: locks, aliases, guarded fields, and which
+    locks each function/method/property may acquire."""
+
+    def __init__(self, src: SourceFile):
+        self.src = src
+        self.lock_kind: Dict[str, str] = {}  # name -> lock|rlock
+        self.alias: Dict[str, str] = {}  # condition name -> lock name
+        self.guarded: Dict[str, Tuple[str, int]] = {}  # field -> (lock, line)
+        self.requires: Dict[ast.AST, str] = {}  # function node -> lock
+        #: class name -> {method name: node}; properties included.
+        self.methods: Dict[str, Dict[str, ast.AST]] = {}
+        self.properties: Dict[str, Set[str]] = {}
+        self.acquires: Dict[ast.AST, Set[str]] = {}
+        self._collect()
+
+    def canonical(self, name: str) -> str:
+        return self.alias.get(name, name)
+
+    def _collect(self) -> None:
+        lines = self.src.lines
+        for node in ast.walk(self.src.tree):
+            if isinstance(node, ast.ClassDef):
+                ms: Dict[str, ast.AST] = {}
+                props: Set[str] = set()
+                for item in node.body:
+                    if isinstance(
+                        item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        ms[item.name] = item
+                        if any(
+                            (attr_chain(d) or [])[-1:] == ["property"]
+                            for d in item.decorator_list
+                        ):
+                            props.add(item.name)
+                self.methods[node.name] = ms
+                self.properties[node.name] = props
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                req = _comment_annotation(lines, node.lineno, _REQUIRES_RE)
+                if req is not None:
+                    self.requires[node] = req
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                self._collect_assign(node)
+            if isinstance(node, ast.AnnAssign):
+                self._collect_target(node.target, node, node.value)
+
+    def _collect_assign(self, node: ast.Assign) -> None:
+        self._collect_target(node.targets[0], node, node.value)
+
+    def _collect_target(self, target, node, value) -> None:
+        field = None
+        if (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+        ):
+            field = target.attr
+        elif isinstance(target, ast.Name):
+            field = target.id
+        if field is None:
+            return
+        guard = _comment_annotation(
+            self.src.lines, node.lineno, _GUARDED_RE
+        )
+        if guard is not None:
+            self.guarded[field] = (guard, node.lineno)
+        if isinstance(value, ast.Call):
+            name = call_name(value)
+            last = name.rsplit(".", 1)[-1]
+            if last == "Lock":
+                self.lock_kind[field] = "lock"
+            elif last == "RLock":
+                self.lock_kind[field] = "rlock"
+            elif last == "Condition":
+                self.lock_kind[field] = "lock"  # Condition wraps a Lock
+                if value.args:
+                    inner = _final_name(value.args[0])
+                    if inner is not None:
+                        self.alias[field] = inner
+                        self.lock_kind.setdefault(inner, "lock")
+
+    # -------------------------------------------------- acquire-set closure
+
+    def compute_acquires(self) -> None:
+        """Which canonical locks each function may take (via ``with``),
+        closed transitively over same-class method calls."""
+        funcs = [
+            n for n in ast.walk(self.src.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        direct: Dict[ast.AST, Set[str]] = {}
+        calls: Dict[ast.AST, Set[str]] = {}
+        for fn in funcs:
+            acq: Set[str] = set()
+            called: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        ln = self.with_lock(item.context_expr)
+                        if ln is not None:
+                            acq.add(ln)
+                elif isinstance(node, ast.Call):
+                    chain = attr_chain(node.func)
+                    if (
+                        chain
+                        and len(chain) == 3
+                        and chain[0] == "self"
+                        and chain[2] == "acquire"
+                        and self.canonical(chain[1]) in self.lock_kind
+                    ):
+                        acq.add(self.canonical(chain[1]))
+                    if chain and chain[:1] == ["self"] and len(chain) == 2:
+                        called.add(chain[1])
+                elif (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                ):
+                    # property loads count as calls to their getter
+                    called.add(node.attr)
+            direct[fn] = acq
+            calls[fn] = called
+        name_map: Dict[str, List[ast.AST]] = {}
+        for cls, ms in self.methods.items():
+            for mname, mnode in ms.items():
+                name_map.setdefault(mname, []).append(mnode)
+        changed = True
+        while changed:
+            changed = False
+            for fn in funcs:
+                acq = direct[fn]
+                for callee_name in calls[fn]:
+                    for callee in name_map.get(callee_name, []):
+                        extra = direct.get(callee, set()) - acq
+                        if extra:
+                            acq |= extra
+                            changed = True
+        self.acquires = direct
+
+    def with_lock(self, ctx_expr) -> Optional[str]:
+        """Canonical lock name acquired by ``with <expr>:`` when the
+        expression's attribute chain ends in a known lock name."""
+        name = _final_name(ctx_expr)
+        if name is None:
+            return None
+        canon = self.canonical(name)
+        if canon in self.lock_kind or name in self.lock_kind:
+            return canon
+        return None
+
+
+class _FunctionWalker:
+    def __init__(self, mod: _ModuleLocks, fn, cls_name: Optional[str],
+                 diags: List[Diagnostic]):
+        self.mod = mod
+        self.fn = fn
+        self.cls = cls_name
+        self.diags = diags
+        held: Set[str] = set()
+        req = mod.requires.get(fn)
+        if req is not None:
+            held.add(mod.canonical(req))
+        self.exempt_fields = fn.name in ("__init__", "__new__", "__del__")
+        self.walk_body(fn.body, held)
+
+    def report(self, id_: str, node, msg: str) -> None:
+        self.diags.append(
+            Diagnostic(self.mod.src.rel, node.lineno, id_, msg)
+        )
+
+    def walk_body(self, stmts, held: Set[str]) -> None:
+        for s in stmts:
+            self.stmt(s, held)
+
+    def stmt(self, node, held: Set[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs are separate functions
+        if isinstance(node, ast.With):
+            inner = set(held)
+            for item in node.items:
+                ln = self.mod.with_lock(item.context_expr)
+                if ln is not None:
+                    if (
+                        ln in held
+                        and self.mod.lock_kind.get(ln) != "rlock"
+                    ):
+                        self.report(
+                            "GM202", node,
+                            f"re-acquiring non-reentrant lock {ln!r} "
+                            "already held here — self-deadlock",
+                        )
+                    inner.add(ln)
+                else:
+                    self.expr(item.context_expr, held)
+            self.walk_body(node.body, inner)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self.stmt(child, held)
+            elif isinstance(child, ast.expr):
+                self.expr(child, held)
+
+    # ------------------------------------------------------------------ expr
+
+    def expr(self, node, held: Set[str]) -> None:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Attribute):
+                self.check_field(n, held)
+            elif isinstance(n, ast.Call):
+                self.check_call(n, held)
+
+    def check_field(self, node: ast.Attribute, held: Set[str]) -> None:
+        info = self.mod.guarded.get(node.attr)
+        if info is None or self.exempt_fields:
+            return
+        lock, decl_line = info
+        if node.lineno == decl_line:
+            return  # the declaring assignment itself
+        if self.mod.canonical(lock) in held:
+            return
+        self.report(
+            "GM201", node,
+            f"field {node.attr!r} is guarded-by {lock!r} but accessed "
+            "without it held",
+        )
+
+    def check_call(self, node: ast.Call, held: Set[str]) -> None:
+        name = call_name(node)
+        chain = attr_chain(node.func)
+        # GM202/GM204 through same-class calls and property loads are
+        # handled via acquire/requires sets:
+        if chain and chain[:1] == ["self"] and len(chain) == 2:
+            self._check_self_call(node, chain[1], held)
+        if not held:
+            return
+        # ---- GM203: blocking while holding any lock
+        if name in _BLOCKING_SIMPLE:
+            self.report(
+                "GM203", node,
+                f"blocking call {name}() while holding a lock",
+            )
+            return
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            recv = _final_name(node.func.value)
+            if attr == "get" and recv and _QUEUEISH_RE.search(recv):
+                self.report(
+                    "GM203", node,
+                    f"queue get on {recv!r} while holding a lock",
+                )
+            elif attr in _SOCKET_METHODS and recv not in ("requests",):
+                self.report(
+                    "GM203", node,
+                    f"socket I/O .{attr}() while holding a lock",
+                )
+            elif attr == "result":
+                self.report(
+                    "GM203", node,
+                    "future .result() while holding a lock",
+                )
+            elif attr == "join" and recv and _THREADISH_RE.search(recv):
+                self.report(
+                    "GM203", node,
+                    f"thread join on {recv!r} while holding a lock",
+                )
+            elif attr == "wait":
+                # Condition.wait releases the lock it wraps — only an
+                # Event-style wait blocks with the lock held.
+                canon = self.mod.with_lock(node.func.value)
+                if canon is None:
+                    self.report(
+                        "GM203", node,
+                        "event wait while holding a lock (a Condition "
+                        "over the lock would release it)",
+                    )
+
+    def _check_self_call(self, node, mname: str, held: Set[str]) -> None:
+        if self.cls is None:
+            return
+        callee = self.mod.methods.get(self.cls, {}).get(mname)
+        if callee is None:
+            return
+        req = self.mod.requires.get(callee)
+        if req is not None and self.mod.canonical(req) not in held:
+            self.report(
+                "GM204", node,
+                f"call to {mname}() which requires-lock {req!r} "
+                "without holding it",
+            )
+        if held:
+            for ln in self.mod.acquires.get(callee, set()):
+                if ln in held and self.mod.lock_kind.get(ln) != "rlock":
+                    self.report(
+                        "GM202", node,
+                        f"call to {mname}() acquires non-reentrant "
+                        f"lock {ln!r} already held here — deadlock",
+                    )
+
+
+def _walk_functions(mod: _ModuleLocks, diags: List[Diagnostic]) -> None:
+    def visit(body, cls_name):
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                visit(node.body, node.name)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _FunctionWalker(mod, node, cls_name, diags)
+                visit(node.body, cls_name)
+
+    visit(mod.src.tree.body, None)
+
+
+def check(project: Project) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for src in project.files:
+        if src.tree is None:
+            continue
+        mod = _ModuleLocks(src)
+        if not mod.guarded and not mod.requires and not mod.lock_kind:
+            continue
+        mod.compute_acquires()
+        _walk_functions(mod, diags)
+    return diags

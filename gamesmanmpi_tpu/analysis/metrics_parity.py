@@ -1,0 +1,92 @@
+"""GM4xx — metrics registry parity.
+
+Every series the package emits (``reg.counter("...")`` /
+``.gauge("...")`` / ``.histogram("...")``) must follow the naming rules
+and be documented in docs/OBSERVABILITY.md — a metric an operator
+cannot look up is a metric nobody alerts on.
+
+| id | finding |
+|---|---|
+| GM401 | metric name breaks the naming rules (``gamesman_`` prefix, lowercase snake, counters end ``_total``, gauges/histograms don't) |
+| GM402 | emitted metric not documented in docs/OBSERVABILITY.md |
+| GM403 | metric name not statically resolvable (not a literal or module constant) — the registry can't be audited |
+
+Definition sites (the ``obs/registry.py`` methods themselves) are
+skipped; names may be string literals or module-level constants
+(``SPAN_SECONDS``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from gamesmanmpi_tpu.analysis.diagnostics import Diagnostic
+from gamesmanmpi_tpu.analysis.project import (
+    OBSERVABILITY_MD,
+    Project,
+    const_str,
+    module_string_consts,
+)
+
+_EMIT_METHODS = {"counter", "gauge", "histogram"}
+_NAME_RE = re.compile(r"^gamesman_[a-z][a-z0-9_]*$")
+
+
+def check(project: Project) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    doc = project.observability_md
+    # Exact-token matching: 'gamesman_retries' must not count as
+    # documented because 'gamesman_retries_total' appears in the doc.
+    documented = set(re.findall(r"gamesman_[a-z][a-z0-9_]*", doc))
+    for src in project.files:
+        if src.tree is None or src.rel.endswith("obs/registry.py"):
+            continue
+        consts = module_string_consts(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            kind = node.func.attr
+            if kind not in _EMIT_METHODS or not node.args:
+                continue
+            # Registry emission only: the receiver is a registry (reg /
+            # self.registry / default_registry()); a positional-string
+            # first arg is the series name either way.
+            name = const_str(node.args[0], consts)
+            if name is None:
+                diags.append(Diagnostic(
+                    src.rel, node.lineno, "GM403",
+                    f".{kind}() metric name is not statically "
+                    "resolvable — use a literal or a module-level "
+                    "string constant so the registry stays auditable",
+                ))
+                continue
+            if not _NAME_RE.match(name):
+                diags.append(Diagnostic(
+                    src.rel, node.lineno, "GM401",
+                    f"metric {name!r} breaks naming rules: "
+                    "gamesman_ prefix, lowercase snake_case",
+                ))
+                continue  # a misnamed series can't be documented per-token
+            if kind == "counter" and not name.endswith("_total"):
+                diags.append(Diagnostic(
+                    src.rel, node.lineno, "GM401",
+                    f"counter {name!r} must end in _total "
+                    "(Prometheus counter convention)",
+                ))
+            elif kind != "counter" and name.endswith("_total"):
+                diags.append(Diagnostic(
+                    src.rel, node.lineno, "GM401",
+                    f"{kind} {name!r} must not end in _total — that "
+                    "suffix promises a counter",
+                ))
+            if name not in documented:
+                diags.append(Diagnostic(
+                    src.rel, node.lineno, "GM402",
+                    f"metric {name!r} is emitted here but not "
+                    f"documented in {OBSERVABILITY_MD}",
+                ))
+    return diags

@@ -1,0 +1,209 @@
+"""Project model: which files the checkers see, parsed once.
+
+Discovery is convention-based so the same runner lints both the real
+repo and the miniature fixture projects tests/test_lint.py builds:
+
+* lint scope — every ``*.py`` under top-level packages (directories
+  with an ``__init__.py``) plus ``tools/``;
+* collect-only scope — top-level driver scripts (``bench.py``,
+  ``solve_launcher.py``, ...): scanned by the registry-parity checkers
+  (their env reads count) but never linted themselves — bench.py's
+  parent process deliberately avoids importing this package (jax import
+  cost), so it cannot use the utils/env helpers the lint enforces;
+* registries — ``docs/CONFIG.md``, ``docs/OBSERVABILITY.md``, the
+  module defining ``KNOWN_POINTS`` (fault points), and the chaos matrix
+  ``tests/test_resilience.py``, located by those relative names.
+
+Everything is parsed exactly once here; checkers share the index.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+from typing import List, Optional
+
+from gamesmanmpi_tpu.analysis.diagnostics import Diagnostic
+
+EXCLUDED_DIRS = {"__pycache__", ".git", ".jax_compile_cache", "artifacts"}
+
+#: Top-level scripts whose env reads feed the parity checkers without the
+#: files themselves being lint targets (see module docstring).
+COLLECT_ONLY = ("bench.py", "solve_launcher.py")
+
+CONFIG_MD = "docs/CONFIG.md"
+OBSERVABILITY_MD = "docs/OBSERVABILITY.md"
+CHAOS_TEST = "tests/test_resilience.py"
+
+
+@dataclasses.dataclass
+class SourceFile:
+    rel: str  # root-relative posix path
+    text: str
+    lines: List[str]
+    tree: Optional[ast.AST]  # None when the file fails to parse
+    parse_error: Optional[Diagnostic]
+
+
+@dataclasses.dataclass
+class Project:
+    root: pathlib.Path
+    files: List[SourceFile]  # lint scope
+    collect_only: List[SourceFile]  # registry-parity scope only
+    config_md: str  # "" when absent
+    observability_md: str
+    chaos_text: str
+
+    def file(self, rel: str) -> Optional[SourceFile]:
+        for f in self.files + self.collect_only:
+            if f.rel == rel:
+                return f
+        return None
+
+
+def _load(root: pathlib.Path, p: pathlib.Path) -> SourceFile:
+    rel = p.relative_to(root).as_posix()
+    text = p.read_text(encoding="utf-8", errors="replace")
+    lines = text.splitlines()
+    tree, err = None, None
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        err = Diagnostic(rel, e.lineno or 1, "GM001",
+                         f"syntax error: {e.msg}")
+    return SourceFile(rel, text, lines, tree, err)
+
+
+def _read(root: pathlib.Path, rel: str) -> str:
+    p = root / rel
+    try:
+        return p.read_text(encoding="utf-8", errors="replace")
+    except FileNotFoundError:
+        return ""
+
+
+def _iter_py(d: pathlib.Path):
+    for p in sorted(d.rglob("*.py")):
+        if not any(part in EXCLUDED_DIRS for part in p.parts):
+            yield p
+
+
+def load_project(root, paths=None) -> Project:
+    """Build the project index.
+
+    ``paths``: explicit lint targets (files or directories) overriding
+    the default scope — the registry files and collect-only scripts are
+    still picked up from ``root`` so parity checks stay whole-project.
+    """
+    root = pathlib.Path(root).resolve()
+    targets: List[pathlib.Path] = []
+    if paths:
+        for raw in paths:
+            p = pathlib.Path(raw)
+            if not p.is_absolute():
+                p = root / p
+            p = p.resolve()
+            if not p.exists():
+                # A typo'd explicit target is a usage error the CLI turns
+                # into exit 2 — never a traceback from read_text.
+                raise FileNotFoundError(f"lint target not found: {raw}")
+            if not p.is_relative_to(root):
+                # Everything reports root-relative paths; a target outside
+                # the root has no spelling in that scheme.
+                raise ValueError(
+                    f"lint target {raw} is outside --root {root}"
+                )
+            if p.is_dir():
+                targets.extend(_iter_py(p))
+            else:
+                targets.append(p)
+    else:
+        for child in sorted(root.iterdir()):
+            if child.name in EXCLUDED_DIRS or not child.is_dir():
+                continue
+            if (child / "__init__.py").exists() or child.name == "tools":
+                targets.extend(_iter_py(child))
+    seen = set()
+    files = []
+    for p in targets:
+        rel = p.relative_to(root).as_posix()
+        if rel not in seen:
+            seen.add(rel)
+            files.append(_load(root, p))
+    collect = [
+        _load(root, root / name)
+        for name in COLLECT_ONLY
+        if (root / name).exists() and name not in seen
+    ]
+    return Project(
+        root=root,
+        files=files,
+        collect_only=collect,
+        config_md=_read(root, CONFIG_MD),
+        observability_md=_read(root, OBSERVABILITY_MD),
+        chaos_text=_read(root, CHAOS_TEST),
+    )
+
+
+# ---------------------------------------------------------- shared AST utils
+
+
+def attr_chain(node: ast.AST) -> Optional[List[str]]:
+    """["os", "environ", "get"] for os.environ.get; None when the
+    expression is not a pure Name/Attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call's callee ("" when not a name chain)."""
+    chain = attr_chain(node.func)
+    return ".".join(chain) if chain else ""
+
+
+def const_str(node: ast.AST, module_consts=None) -> Optional[str]:
+    """A string literal, or a Name resolving to a module-level string
+    constant (``module_consts``: {name: value})."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if (
+        module_consts is not None
+        and isinstance(node, ast.Name)
+        and isinstance(module_consts.get(node.id), str)
+    ):
+        return module_consts[node.id]
+    return None
+
+
+def module_string_consts(tree: ast.AST) -> dict:
+    """Module-level NAME = "literal" assignments (single target, assigned
+    exactly once — reassigned names are dropped as unreliable)."""
+    out: dict = {}
+    dropped = set()
+    for node in getattr(tree, "body", []):
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            target = node.target
+            value = node.value
+        else:
+            continue
+        if not isinstance(target, ast.Name):
+            continue
+        name = target.id
+        if name in out or name in dropped:
+            out.pop(name, None)
+            dropped.add(name)
+            continue
+        if isinstance(value, ast.Constant) and isinstance(value.value, str):
+            out[name] = value.value
+    return out

@@ -1,0 +1,117 @@
+"""Checker orchestration: one pass over the project, one result.
+
+``run_project`` loads the project index once, runs every checker family
+over it, then applies the two escape hatches in order: inline
+``# lint: disable=...`` suppressions drop a finding entirely (the
+author vouched for that site), the baseline file demotes a finding from
+*new* (fails the run) to *baselined* (reported, tolerated). The runner
+never imports the code under analysis — linting kernel modules must not
+grab an accelerator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from gamesmanmpi_tpu.analysis import (
+    env_parity,
+    faults_parity,
+    jax_tracing,
+    locks,
+    metrics_parity,
+)
+from gamesmanmpi_tpu.analysis.diagnostics import (
+    Diagnostic,
+    fingerprint,
+    is_suppressed,
+    load_baseline,
+    split_by_baseline,
+)
+from gamesmanmpi_tpu.analysis.project import Project, load_project
+
+#: Checker families in reporting order. Each is ``check(project) ->
+#: [Diagnostic]``; parse failures (GM001) come from the loader itself.
+CHECKERS = (
+    jax_tracing.check,
+    locks.check,
+    env_parity.check,
+    metrics_parity.check,
+    faults_parity.check,
+)
+
+
+@dataclasses.dataclass
+class LintResult:
+    """Findings partitioned by disposition.
+
+    * ``new`` — fail the run (exit 1);
+    * ``baselined`` — matched an accepted-findings entry;
+    * ``suppressed`` — silenced by an inline directive;
+    * ``fingerprints`` — fingerprint per non-suppressed finding, the
+      material ``--update-baseline`` writes back.
+    """
+
+    new: List[Diagnostic]
+    baselined: List[Diagnostic]
+    suppressed: List[Diagnostic]
+    fingerprints: List[Tuple[Diagnostic, str]]
+    project: Project
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+
+def _lines_for(project: Project, cache: Dict[str, List[str]],
+               rel: str) -> List[str]:
+    """Source lines for any path a diagnostic may point at — lint-scope
+    files from the index, registry docs (CONFIG.md rows for GM303) read
+    off disk once."""
+    if rel not in cache:
+        src = project.file(rel)
+        if src is not None:
+            cache[rel] = src.lines
+        else:
+            try:
+                cache[rel] = (
+                    (project.root / rel)
+                    .read_text(encoding="utf-8", errors="replace")
+                    .splitlines()
+                )
+            except OSError:
+                cache[rel] = []
+    return cache[rel]
+
+
+def run_project(root, paths=None,
+                baseline_path: Optional[str] = None) -> LintResult:
+    project = load_project(root, paths)
+    diags: List[Diagnostic] = []
+    for src in project.files:
+        if src.parse_error is not None:
+            diags.append(src.parse_error)
+    for check in CHECKERS:
+        diags.extend(check(project))
+    diags.sort()
+
+    lines_cache: Dict[str, List[str]] = {}
+    kept: List[Diagnostic] = []
+    suppressed: List[Diagnostic] = []
+    for d in diags:
+        lines = _lines_for(project, lines_cache, d.path)
+        (suppressed if is_suppressed(d, lines) else kept).append(d)
+
+    with_fp = [
+        (d, fingerprint(d, _lines_for(project, lines_cache, d.path)))
+        for d in kept
+    ]
+    baseline = load_baseline(baseline_path) if baseline_path else []
+    new, old = split_by_baseline(with_fp, baseline)
+    return LintResult(
+        new=new,
+        baselined=old,
+        suppressed=suppressed,
+        fingerprints=with_fp,
+        project=project,
+    )

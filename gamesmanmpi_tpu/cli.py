@@ -36,6 +36,8 @@ import os
 import sys
 import time
 
+from gamesmanmpi_tpu.utils.env import env_float, env_opt, env_str
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
@@ -341,7 +343,7 @@ def main(argv=None) -> int:
         (args.backward, "GAMESMAN_BACKWARD"),
     ):
         if flag is not None:
-            saved_env[env] = os.environ.get(env)
+            saved_env[env] = env_opt(env)
             os.environ[env] = str(flag)
     try:
         return _main(args)
@@ -367,9 +369,9 @@ def _maybe_probe_backend() -> bool:
     already initialized in this process (too late to help), or the first
     platform to initialize is the CPU (cannot wedge on a relay).
     """
-    if os.environ.get("GAMESMAN_PROBE", "auto") in ("0", "off", "false"):
+    if env_str("GAMESMAN_PROBE", "auto") in ("0", "off", "false"):
         return True
-    if os.environ.get("GAMESMAN_PLATFORM"):
+    if env_opt("GAMESMAN_PLATFORM"):
         return True
     import jax
     from jax._src import xla_bridge
@@ -383,16 +385,13 @@ def _maybe_probe_backend() -> bool:
     # subprocess per solve for nothing.
     first_cfg = str(getattr(jax.config, "jax_platforms", None) or "") \
         .split(",")[0].strip().lower()
-    first_env = os.environ.get("JAX_PLATFORMS", "") \
+    first_env = env_str("JAX_PLATFORMS", "") \
         .split(",")[0].strip().lower()
     if first_cfg in ("", "cpu") and first_env in ("", "cpu"):
         return True
     from gamesmanmpi_tpu.utils.platform import probe_backend
 
-    try:
-        timeout = float(os.environ.get("GAMESMAN_PROBE_TIMEOUT", 120.0))
-    except ValueError:
-        timeout = 120.0
+    timeout = env_float("GAMESMAN_PROBE_TIMEOUT", 120.0)
     if probe_backend(timeout) is not None:
         return True
     print(

@@ -177,8 +177,8 @@ class _Family:
         self.kind = kind  # "counter" | "gauge" | "histogram"
         #: histogram boundaries, always ending in +Inf; None otherwise
         self.buckets = buckets
-        self.values: dict = {}
-        self.children: dict = {}
+        self.values: dict = {}  # guarded-by: _lock (the registry's)
+        self.children: dict = {}  # guarded-by: _lock (the registry's)
 
 
 class MetricsRegistry:
@@ -191,7 +191,7 @@ class MetricsRegistry:
 
     def __init__(self, *, clock=time.time):
         self._lock = threading.RLock()
-        self._families: Dict[str, _Family] = {}
+        self._families: Dict[str, _Family] = {}  # guarded-by: _lock
         self._clock = clock
 
     # -------------------------------------------------------- registration
@@ -243,24 +243,28 @@ class MetricsRegistry:
     def histogram(self, name: str, help_: str = "",
                   buckets: Optional[Iterable[float]] = None,
                   **labels) -> Histogram:
-        fam = self._families.get(name)
-        if fam is None:
-            bounds = sorted(
-                float(b)
-                for b in (buckets if buckets is not None
-                          else DEFAULT_TIME_BUCKETS)
-            )
-            if not bounds:
-                raise ValueError("histogram needs at least one bucket")
-            if not math.isinf(bounds[-1]):
-                bounds.append(math.inf)
-            fam = self._family(name, help_, "histogram", tuple(bounds))
-        elif fam.kind != "histogram":
-            raise ValueError(
-                f"metric {name!r} already registered as {fam.kind}, "
-                "not histogram"
-            )
-        return self._child(fam, labels, Histogram)
+        # The whole get-or-create must hold the (reentrant) lock: a
+        # racing first-registration pair would otherwise both miss the
+        # family check and disagree about the bucket set.
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                bounds = sorted(
+                    float(b)
+                    for b in (buckets if buckets is not None
+                              else DEFAULT_TIME_BUCKETS)
+                )
+                if not bounds:
+                    raise ValueError("histogram needs at least one bucket")
+                if not math.isinf(bounds[-1]):
+                    bounds.append(math.inf)
+                fam = self._family(name, help_, "histogram", tuple(bounds))
+            elif fam.kind != "histogram":
+                raise ValueError(
+                    f"metric {name!r} already registered as {fam.kind}, "
+                    "not histogram"
+                )
+            return self._child(fam, labels, Histogram)
 
     # -------------------------------------------------------------- reading
 

@@ -47,17 +47,16 @@ flip it for accelerators.
 
 from __future__ import annotations
 
-import os
-
 import jax.numpy as jnp
 import numpy as np
 
 from gamesmanmpi_tpu.core.bitops import sentinel_for
+from gamesmanmpi_tpu.utils.env import env_int, env_str
 
 
 def use_merge_sort() -> bool:
     """Engines consult this flag at trace time (GAMESMAN_SORT=merge)."""
-    return os.environ.get("GAMESMAN_SORT", "xla") == "merge"
+    return env_str("GAMESMAN_SORT", "xla") == "merge"
 
 
 def backend_key():
@@ -72,7 +71,7 @@ def backend_key():
     """
     if not use_merge_sort():
         return "xla"
-    return ("merge", os.environ.get("GAMESMAN_SORT_ROW", "2048"))
+    return ("merge", env_str("GAMESMAN_SORT_ROW", "2048"))
 
 
 def _pay_max(dtype):
@@ -86,10 +85,7 @@ def _row_width(n: int) -> int:
     GAMESMAN_SORT_ROW tunes it; default 2048 keeps each row's sort network
     shallow while leaving most of the work to the merge ladder.
     """
-    try:
-        w = int(os.environ.get("GAMESMAN_SORT_ROW", "2048"))
-    except ValueError:
-        w = 2048
+    w = env_int("GAMESMAN_SORT_ROW", 2048)
     w = 1 << max(int(w).bit_length() - 1, 0)  # round down to a power of two
     return max(min(w, n), 1)
 

@@ -65,7 +65,6 @@ replacing the reference's `mpirun -np 1` vs `-np N` (SURVEY.md §4.2).
 
 from __future__ import annotations
 
-import os
 import time
 from typing import Dict, List
 
@@ -107,6 +106,7 @@ from gamesmanmpi_tpu.resilience import faults
 from gamesmanmpi_tpu.resilience.retry import retry_call
 from gamesmanmpi_tpu.resilience.supervisor import maybe_watchdog
 from gamesmanmpi_tpu.utils.checkpoint import TORN_NPZ_ERRORS
+from gamesmanmpi_tpu.utils.env import env_opt, env_str
 from gamesmanmpi_tpu.solve.engine import (
     LevelTable,
     SolveResult,
@@ -568,7 +568,7 @@ class ShardedSolver:
         self.backward_block = _backward_block()
         # Route-capacity headroom (strict parse, fail-fast like the other
         # capacity knobs): see _initial_route_cap.
-        raw = os.environ.get("GAMESMAN_ROUTE_HEADROOM")
+        raw = env_opt("GAMESMAN_ROUTE_HEADROOM")
         try:
             self.route_headroom = float(raw) if raw else 2.0
         except ValueError:
@@ -591,7 +591,7 @@ class ShardedSolver:
         # games, budget-evicted big runs resumed without edge files);
         # 'lookup' = always the owner-routed sort-merge/binary-search join.
         # Strict parse, fail-fast at construction like the other knobs.
-        raw = os.environ.get("GAMESMAN_BACKWARD", "edges")
+        raw = env_str("GAMESMAN_BACKWARD", "edges")
         if raw not in ("edges", "lookup"):
             raise SolverError(
                 f"GAMESMAN_BACKWARD={raw!r}: expected 'edges' or 'lookup'"
@@ -608,7 +608,7 @@ class ShardedSolver:
         # Background compiles of the edge-backward shapes (same policy as
         # the single-device engine: only worth it where compiles are
         # remote ~15 s RPCs; on CPU they would just slow the suite).
-        flag = os.environ.get("GAMESMAN_PRECOMPILE", "auto")
+        flag = env_str("GAMESMAN_PRECOMPILE", "auto")
         if flag == "auto":
             self.precompile = jax.default_backend() != "cpu"
         else:

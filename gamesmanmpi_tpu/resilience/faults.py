@@ -50,6 +50,7 @@ import time
 import warnings
 
 from gamesmanmpi_tpu.obs import default_registry
+from gamesmanmpi_tpu.utils.env import env_opt
 
 
 class FaultError(RuntimeError):
@@ -225,6 +226,6 @@ def fire(point: str, path=None, **ctx) -> None:
 # as the engine's _env_int knobs) — in a chaos run the harness notices
 # because the expected death never happens.
 try:
-    configure(os.environ.get("GAMESMAN_FAULTS"))
+    configure(env_opt("GAMESMAN_FAULTS"))
 except ValueError as e:  # pragma: no cover - env misuse
     warnings.warn(f"GAMESMAN_FAULTS ignored: {e}")

@@ -24,13 +24,13 @@ Knobs: ``GAMESMAN_RETRY_ATTEMPTS`` (total tries per step, default 3;
 
 from __future__ import annotations
 
-import os
 import time
 
 from gamesmanmpi_tpu.obs import default_registry
 from gamesmanmpi_tpu.resilience.faults import FatalFault, TransientFault
 from gamesmanmpi_tpu.utils.env import env_float as _env_float
 from gamesmanmpi_tpu.utils.env import env_int as _env_int
+from gamesmanmpi_tpu.utils.env import env_str
 
 #: Message substrings (matched case-insensitively) that mark a runtime
 #: error as transient. Conservative: transport/scheduling words only,
@@ -63,7 +63,7 @@ def is_transient(exc: BaseException) -> bool:
     msg = str(exc).lower()
     extra = tuple(
         m.strip().lower()
-        for m in os.environ.get("GAMESMAN_RETRY_MARKERS", "").split(",")
+        for m in env_str("GAMESMAN_RETRY_MARKERS", "").split(",")
         if m.strip()
     )
     return any(m in msg for m in TRANSIENT_MARKERS + extra)

@@ -129,19 +129,20 @@ class Batcher:
         self.breaker_threshold = max(1, int(breaker_threshold))
         self.breaker_cooldown = float(breaker_cooldown)
         self.logger = logger
-        self._cache: OrderedDict = OrderedDict()
+        self._cache: OrderedDict = OrderedDict()  # guarded-by: _lock
         # Clamp: a negative size (the conventional "unlimited" spelling
         # elsewhere) would make the eviction loop pop an empty dict.
         self._cache_size = max(0, int(cache_size))
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._pending: list[_Request] = []
-        self._closed = False
+        self._pending: list[_Request] = []  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
         #: breaker: "ok" | "open" | "half_open" (+ the fault streak and
         #: when the circuit opened), all mutated under the one lock.
-        self._breaker = "ok"
-        self._consecutive_faults = 0
-        self._opened_at = 0.0
+        self._breaker = "ok"  # guarded-by: _lock
+        self._consecutive_faults = 0  # guarded-by: _lock
+        self._opened_at = 0.0  # guarded-by: _lock
+        # guarded-by: _lock
         self.counters = {
             "requests": 0,
             "queries": 0,
@@ -332,9 +333,10 @@ class Batcher:
         with self._lock:
             self.counters["reader_faults"] += 1
             self._consecutive_faults += 1
+            streak = self._consecutive_faults
             opened = (
                 self._breaker == "ok"
-                and self._consecutive_faults >= self.breaker_threshold
+                and streak >= self.breaker_threshold
             )
             if opened or self._breaker == "half_open":
                 self._breaker = "open"
@@ -347,7 +349,7 @@ class Batcher:
             if self.logger is not None:
                 self.logger.log({
                     "phase": "breaker_open",
-                    "consecutive_faults": self._consecutive_faults,
+                    "consecutive_faults": streak,
                 })
         elif self.state == "open":
             self._m_breaker_state.set(2)
@@ -364,9 +366,11 @@ class Batcher:
             if self.logger is not None:
                 self.logger.log({"phase": "breaker_closed"})
 
+    # requires-lock: _lock
     def _breaker_wait(self) -> float | None:
         """Seconds the idle worker may sleep before it owes a half-open
-        re-probe; None when the breaker is closed (sleep until work)."""
+        re-probe; None when the breaker is closed (sleep until work).
+        Called with the lock held (from the worker's _cond wait loop)."""
         if self._breaker == "ok":
             return None
         return max(

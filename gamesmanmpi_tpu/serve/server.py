@@ -251,12 +251,13 @@ class _QueryHTTPServer(ThreadingHTTPServer):
         self.draining = False
         self._stats_lock = threading.Lock()
         self._t0 = time.time()
-        self._http_requests = 0
-        self._http_errors = 0
-        self._http_client_aborts = 0
-        self._inflight = 0  # POSTs between entry and response written
-        self._latency_total = 0.0
-        self._latency_max = 0.0
+        self._http_requests = 0  # guarded-by: _stats_lock
+        self._http_errors = 0  # guarded-by: _stats_lock
+        self._http_client_aborts = 0  # guarded-by: _stats_lock
+        # POSTs between entry and response written
+        self._inflight = 0  # guarded-by: _stats_lock
+        self._latency_total = 0.0  # guarded-by: _stats_lock
+        self._latency_max = 0.0  # guarded-by: _stats_lock
         # server_start_time makes uptime derivable from any scrape
         # (time() - server_start_time), the Prometheus convention.
         self.registry.gauge(

@@ -83,6 +83,7 @@ from gamesmanmpi_tpu.games.connect4 import Connect4
 from gamesmanmpi_tpu.ops.combine import combine_children
 from gamesmanmpi_tpu.solve.engine import get_kernel, schedule_kernel
 from gamesmanmpi_tpu.solve.precompile import sds
+from gamesmanmpi_tpu.utils.env import env_int, env_opt, env_str
 from gamesmanmpi_tpu.utils.platform import backend_epoch, platform_auto_flag
 
 
@@ -879,7 +880,7 @@ _REACH_COUNTS: Dict[tuple, Dict[int, int]] = {}
 
 
 def _counts_file() -> Optional[str]:
-    path = os.environ.get("GAMESMAN_DENSE_COUNTS_FILE")
+    path = env_opt("GAMESMAN_DENSE_COUNTS_FILE")
     if path == "0":
         return None
     if path:
@@ -1044,17 +1045,16 @@ class DenseSolver:
         else:
             self._mesh = None
         self.tables = tables_for(game.width, game.height, game.connect)
-        self.block_elems = block_elems or int(
-            os.environ.get("GAMESMAN_DENSE_BLOCK", str(64 * 1024 * 1024))
+        self.block_elems = block_elems or env_int(
+            "GAMESMAN_DENSE_BLOCK", 64 * 1024 * 1024
         )
         # Async run-ahead control: the level loop enqueues without syncing
         # (the relay charges ~65 ms per host sync), so on big boards the
         # host can enqueue every level's buffers before any kernel
         # retires — the classic engine OOM'd exactly this way in round 2.
         # Levels bigger than this many cells drain with a 1-byte fetch.
-        self.sync_cells = int(
-            os.environ.get("GAMESMAN_DENSE_SYNC_CELLS",
-                           str(256 * 1024 * 1024))
+        self.sync_cells = env_int(
+            "GAMESMAN_DENSE_SYNC_CELLS", 256 * 1024 * 1024
         )
         # Binom lookup lowering: the one-hot select tree is bounded VPU
         # work (K-1 selects, K <= 23); take_along_axis emits a gather,
@@ -1064,7 +1064,7 @@ class DenseSolver:
         # r04, 5x5): onehot 9.04M pos/s vs take 212k — a 43x collapse,
         # exactly the predicted gather catastrophe. onehot is the default;
         # GAMESMAN_DENSE_BINOM=take re-enables the gather for measurement.
-        self.use_onehot = os.environ.get(
+        self.use_onehot = env_str(
             "GAMESMAN_DENSE_BINOM", "onehot"
         ) != "take"
         # Child-ranking lowering: "fused" = one walk for all moves
@@ -1072,7 +1072,7 @@ class DenseSolver:
         # results (tests pin it). MEASURED on the v5e (chip session r04,
         # 5x5 A/B): simple 9.04M pos/s vs fused 4.83M — simple wins 1.9x
         # and stays the default; the flag remains for re-measurement.
-        self.use_fused = os.environ.get(
+        self.use_fused = env_str(
             "GAMESMAN_DENSE_RANK", "simple"
         ) == "fused"
         # Gather lowering (identical results in all modes, tests pin it):
@@ -1096,7 +1096,7 @@ class DenseSolver:
         )
         if (self.gather_mode == "pallas" and self.devices > 1
                 and jax.default_backend() != "cpu"
-                and os.environ.get(
+                and env_str(
                     "GAMESMAN_DENSE_GATHER_PALLAS_MESH", "0") != "1"):
             # devices>1 + pallas is exercised only in CPU interpret mode
             # (where pallas_call is emulated with plain JAX ops); whether

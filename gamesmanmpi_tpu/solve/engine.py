@@ -59,7 +59,6 @@ in order of importance:
 
 from __future__ import annotations
 
-import os
 import time
 from typing import Dict, NamedTuple, Optional
 
@@ -85,6 +84,7 @@ from gamesmanmpi_tpu.resilience import faults
 from gamesmanmpi_tpu.resilience.retry import retry_call
 from gamesmanmpi_tpu.resilience.supervisor import maybe_watchdog
 from gamesmanmpi_tpu.solve.precompile import global_precompiler, sds
+from gamesmanmpi_tpu.utils.env import env_float, env_int, env_str
 from gamesmanmpi_tpu.utils.platform import backend_epoch, platform_auto_bool
 
 
@@ -408,27 +408,15 @@ def resolve_level(game: TensorGame, states, window,
 
 def _env_int(name: str, default: int) -> int:
     """Read an integer env knob lazily; malformed values degrade to the
-    default with a warning instead of breaking package import."""
-    raw = os.environ.get(name, str(default))
-    try:
-        return int(raw)
-    except ValueError:
-        import warnings
-
-        warnings.warn(f"{name}={raw!r} is not an integer; using {default}")
-        return default
+    default with a warning instead of breaking package import. (Public
+    re-export of utils.env.env_int — the sharded engine imports these
+    names from here; the body lives in the one module GM301 audits.)"""
+    return env_int(name, default)
 
 
 def _env_float(name: str, default: float) -> float:
     """Float twin of _env_int (same degradation contract)."""
-    raw = os.environ.get(name, str(default))
-    try:
-        return float(raw)
-    except ValueError:
-        import warnings
-
-        warnings.warn(f"{name}={raw!r} is not a number; using {default}")
-        return default
+    return env_float(name, default)
 
 
 def _backward_block() -> int:
@@ -544,7 +532,7 @@ class Solver:
         self.bytes_gathered = 0
         # Background compiles only pay off where compiles are expensive
         # (remote accelerator); on CPU they would just slow the test suite.
-        flag = os.environ.get("GAMESMAN_PRECOMPILE", "auto")
+        flag = env_str("GAMESMAN_PRECOMPILE", "auto")
         if flag == "auto":
             self.precompile = jax.default_backend() != "cpu"
         else:

@@ -49,9 +49,8 @@ in tests/test_hybrid.py.
 
 from __future__ import annotations
 
-import os
 import time
-from typing import Dict, Optional
+from typing import Optional
 
 import numpy as np
 
@@ -59,7 +58,7 @@ import jax
 import jax.numpy as jnp
 
 from gamesmanmpi_tpu.core.bitops import sentinel_for
-from gamesmanmpi_tpu.core.values import LOSE, TIE, UNDECIDED
+from gamesmanmpi_tpu.core.values import LOSE, UNDECIDED
 from gamesmanmpi_tpu.games.connect4 import Connect4
 from gamesmanmpi_tpu.ops.combine import combine_children
 from gamesmanmpi_tpu.ops.dedup import sort_unique
@@ -71,19 +70,9 @@ from gamesmanmpi_tpu.solve.dense import (
     n1_of_level,
 )
 from gamesmanmpi_tpu.solve.engine import Solver, get_kernel
+from gamesmanmpi_tpu.utils.env import env_int_strict as _env_int_strict
+from gamesmanmpi_tpu.utils.env import env_opt
 from gamesmanmpi_tpu.utils.platform import platform_auto_bool
-
-
-def _env_int_strict(name: str, default: int) -> int:
-    """Integer env knob that fails fast with a clear message (same
-    convention as the GAMESMAN_HYBRID_CUTOVER parse below)."""
-    raw = os.environ.get(name)
-    if not raw:
-        return default
-    try:
-        return int(raw)
-    except ValueError:
-        raise ValueError(f"{name}={raw!r} is not an integer") from None
 
 
 def default_cutover(ncells: int) -> int:
@@ -449,7 +438,7 @@ class HybridSolver:
         self.tables = self.dense.tables
         nc = self.tables.ncells
         if cutover is None:
-            env = os.environ.get("GAMESMAN_HYBRID_CUTOVER")
+            env = env_opt("GAMESMAN_HYBRID_CUTOVER")
             if env:
                 try:
                     cutover = int(env)

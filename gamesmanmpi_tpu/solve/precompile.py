@@ -24,7 +24,6 @@ imported once, not per message.
 
 from __future__ import annotations
 
-import os
 import queue
 import threading
 import time
@@ -33,13 +32,11 @@ from typing import Dict, Hashable
 
 import jax
 
+from gamesmanmpi_tpu.utils.env import env_float, env_int
+
 
 def _workers() -> int:
-    raw = os.environ.get("GAMESMAN_COMPILE_WORKERS", "8")
-    try:
-        return max(1, int(raw))
-    except ValueError:
-        return 8
+    return max(1, env_int("GAMESMAN_COMPILE_WORKERS", 8))
 
 
 def _heavy_slots() -> int:
@@ -50,11 +47,7 @@ def _heavy_slots() -> int:
     uint64 board, while the same programs compile fine serially. Heavy jobs
     therefore share a small semaphore; light jobs keep the full pool.
     """
-    raw = os.environ.get("GAMESMAN_HEAVY_COMPILES", "2")
-    try:
-        return max(1, int(raw))
-    except ValueError:
-        return 2
+    return max(1, env_int("GAMESMAN_HEAVY_COMPILES", 2))
 
 
 class Precompiler:
@@ -269,10 +262,7 @@ def _atexit_drain() -> None:
     if pre is None:
         return
     pre.close()
-    try:
-        grace = float(os.environ.get("GAMESMAN_COMPILE_EXIT_GRACE", "120"))
-    except ValueError:
-        grace = 120.0
+    grace = env_float("GAMESMAN_COMPILE_EXIT_GRACE", 120.0)
     deadline = time.time() + grace
     for t in pre._threads:
         t.join(timeout=max(0.0, deadline - time.time()))

@@ -26,6 +26,7 @@ from gamesmanmpi_tpu.core.codec import (
     unpack_cells_np,
 )
 from gamesmanmpi_tpu.resilience import faults
+from gamesmanmpi_tpu.utils.env import env_str
 
 
 class CorruptCheckpointError(ValueError):
@@ -61,7 +62,7 @@ def file_crc32(path, chunk: int = 1 << 20) -> int:
 
 
 def _verify_enabled() -> bool:
-    return os.environ.get("GAMESMAN_CKPT_VERIFY", "1") not in (
+    return env_str("GAMESMAN_CKPT_VERIFY", "1") not in (
         "0", "off", "false"
     )
 
@@ -82,7 +83,7 @@ def _savez(path, **arrays) -> None:
     at disk speed. Override with GAMESMAN_CKPT_COMPRESS=0/1.
     """
     total = sum(a.nbytes for a in arrays.values())
-    flag = os.environ.get("GAMESMAN_CKPT_COMPRESS", "auto")
+    flag = env_str("GAMESMAN_CKPT_COMPRESS", "auto")
     if flag == "auto":
         compress = total < (64 << 20)
     else:

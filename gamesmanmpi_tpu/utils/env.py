@@ -1,16 +1,27 @@
-"""Shared env-knob parsing: warn-and-default numeric reads.
+"""Shared env-knob parsing: the one home for os.environ *reads*.
 
-One home for the degradation contract every numeric `GAMESMAN_*` knob
-follows (malformed values must not break package import or a running
-server — they warn and fall back). solve/engine.py predates this module
-and keeps local twins for its public `_env_int`/`_env_float` (imported
-by the sharded engine); new subsystems import from here.
+Two degradation contracts live here:
+
+* warn-and-default (``env_int``/``env_float``) — malformed values must
+  not break package import or a running server; they warn and fall
+  back. Every numeric ``GAMESMAN_*`` knob follows it.
+* fail-fast (``env_int_strict``) — knobs that exist for chip A/B runs,
+  where a typo silently falling back would record two identical
+  configurations; they raise with a clear message.
+
+``env_str``/``env_opt`` are the string forms (trivial on purpose: the
+point is that gamesman-lint's GM301 forbids raw ``os.environ`` reads
+everywhere else, so every read is greppable here and auditable against
+docs/CONFIG.md). solve/engine.py predates this module and re-exports
+``_env_int``/``_env_float`` for the sharded engine; new subsystems
+import from here.
 """
 
 from __future__ import annotations
 
 import os
 import warnings
+from typing import Optional
 
 
 def env_int(name: str, default: int) -> int:
@@ -29,3 +40,25 @@ def env_float(name: str, default: float) -> float:
     except ValueError:
         warnings.warn(f"{name}={raw!r} is not a number; using {default}")
         return default
+
+
+def env_int_strict(name: str, default: int) -> int:
+    """Integer env knob that fails fast with a clear message (A/B knobs
+    where a silent fallback would measure the wrong configuration)."""
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r} is not an integer") from None
+
+
+def env_str(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+def env_opt(name: str) -> Optional[str]:
+    """The unset-able string form: None when the var is absent (or
+    empty-meaning-unset is the caller's call to make)."""
+    return os.environ.get(name)

@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import os
 
+from gamesmanmpi_tpu.utils.env import env_opt, env_str
+
 # Bumped every time force_platform actually clears initialized backends.
 # Kernel caches (solve/engine.py _cache_key) mix this into their keys:
 # executables closed over pre-clear device/Mesh objects would otherwise be
@@ -38,7 +40,7 @@ def force_platform(platform: str, fake_devices: int | None = None) -> None:
     """
     flags_changed = False
     if fake_devices is not None and platform == "cpu":
-        flags = os.environ.get("XLA_FLAGS", "")
+        flags = env_str("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
                 flags + f" --xla_force_host_platform_device_count={fake_devices}"
@@ -87,7 +89,7 @@ def force_cpu_if_requested(fake_devices: int | None = None) -> bool:
     """
     requested = [
         p.strip().lower()
-        for p in os.environ.get("JAX_PLATFORMS", "").split(",")
+        for p in env_str("JAX_PLATFORMS", "").split(",")
     ]
     if "cpu" not in requested:
         return False
@@ -97,10 +99,10 @@ def force_cpu_if_requested(fake_devices: int | None = None) -> bool:
 
 def apply_platform_env(default_fake_devices: int | None = None) -> None:
     """Honor GAMESMAN_PLATFORM (and GAMESMAN_FAKE_DEVICES) if set."""
-    platform = os.environ.get("GAMESMAN_PLATFORM")
+    platform = env_opt("GAMESMAN_PLATFORM")
     if not platform:
         return
-    fake = os.environ.get("GAMESMAN_FAKE_DEVICES")
+    fake = env_opt("GAMESMAN_FAKE_DEVICES")
     fake_devices = int(fake) if fake else default_fake_devices
     force_platform(platform, fake_devices)
 
@@ -187,7 +189,7 @@ def platform_auto_flag(name: str, accel: str, cpu: str,
     auto default — these knobs exist for chip A/B runs, where a typo that
     falls back to auto records two identical configurations.
     """
-    raw = os.environ.get(name, "auto")
+    raw = env_str(name, "auto")
     if raw in choices:
         return raw
     if raw != "auto":
@@ -203,7 +205,7 @@ def platform_auto_bool(name: str, accel: bool, cpu: bool) -> bool:
     """Boolean twin of platform_auto_flag ("1"/"on"/"true", "0"/"off"/
     "false", "auto"/unset; anything else raises)."""
     on, off = ("1", "on", "true"), ("0", "off", "false")
-    raw = os.environ.get(name, "auto").lower()
+    raw = env_str(name, "auto").lower()
     if raw in on:
         return True
     if raw in off:

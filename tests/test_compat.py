@@ -1,6 +1,5 @@
 """compat/: unmodified reference-style modules through both paths."""
 
-import numpy as np
 import pytest
 
 from gamesmanmpi_tpu.compat import TensorizedModule, load_game_module, solve_module

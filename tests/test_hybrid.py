@@ -6,7 +6,6 @@ classic solver's full tables across cutover placements, including the
 degenerate ends where one engine does almost all the work.
 """
 
-import numpy as np
 import pytest
 
 from gamesmanmpi_tpu.games import get_game

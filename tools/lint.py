@@ -1,0 +1,20 @@
+"""``python -m tools.lint`` — run gamesman-lint over this repo.
+
+Thin wrapper: the implementation lives in
+``gamesmanmpi_tpu.analysis.cli`` (also installed as the
+``gamesman-lint`` console script); this module only defaults ``--root``
+to the repository the file sits in, so the command works from any cwd.
+"""
+
+import os
+import sys
+
+from gamesmanmpi_tpu.analysis.cli import main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+if __name__ == "__main__":
+    argv = sys.argv[1:]
+    if "--root" not in argv:
+        argv = ["--root", REPO, *argv]
+    raise SystemExit(main(argv))

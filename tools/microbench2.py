@@ -88,7 +88,9 @@ def main():
     dev = jax.devices()[0]
     print(f"device: {dev.platform} ({dev})", file=sys.stderr)
 
-    N = int(os.environ.get("GAMESMAN_MB_N", 32 * 1024 * 1024))
+    from gamesmanmpi_tpu.utils.env import env_int
+
+    N = env_int("GAMESMAN_MB_N", 32 * 1024 * 1024)
     rng = np.random.default_rng(0)
     keys_np = rng.integers(0, 1 << 30, size=N, dtype=np.uint32)
     keys = jnp.asarray(keys_np)
@@ -200,7 +202,7 @@ def main():
     if not quick:
         try:
             from jax.experimental import pallas as pl
-            from jax.experimental.pallas import tpu as pltpu
+            from jax.experimental.pallas import tpu as pltpu  # noqa: F401 - availability probe
 
             def k_copy(x_ref, o_ref):
                 o_ref[:] = x_ref[:] * jnp.uint32(2)

@@ -26,6 +26,8 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# jax-free by design (watches the relay that jax clients wedge on), so
+# it cannot import the package's env helpers.  # lint: disable=GM301
 RELAY_PORT = int(os.environ.get("GAMESMAN_RELAY_PORT", "8103"))
 
 
