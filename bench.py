@@ -158,6 +158,111 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
+def _multiprocess_smoke() -> dict | None:
+    """BENCH_PROCESSES=2: a real N-process sharded solve through
+    tools/launch_multihost.py, folded into a MULTICHIP_r06.json-style
+    artifact (BENCH_PROCESSES_OUT) with per-rank level times — the
+    distributed path's perf trajectory before the big multi-host runs.
+
+    Runs in the PARENT (the harness is subprocess-only, so this side
+    never touches jax) and must never kill the bench: any failure is
+    recorded in the artifact and the summary, not raised.
+    """
+    try:
+        procs = int(os.environ.get("BENCH_PROCESSES", "0"))
+    except ValueError:
+        print("BENCH_PROCESSES is not a number; skipping", file=sys.stderr)
+        return None
+    if procs <= 1:
+        return None
+    import tempfile
+
+    from tools.launch_multihost import DEFAULT_LOCAL_DEVICES, launch
+
+    spec = os.environ.get("BENCH_MP_GAME", "connect4:w=4,h=4")
+    out_path = os.environ.get("BENCH_PROCESSES_OUT", "MULTICHIP_mp.json")
+    shards = procs * DEFAULT_LOCAL_DEVICES
+    artifact = {
+        "processes": procs, "shards": shards, "game": spec, "ok": False,
+    }
+    t0 = time.perf_counter()
+    try:
+        with tempfile.TemporaryDirectory(prefix="bench_mp_") as td:
+            jsonl = os.path.join(td, "m.jsonl")
+            ranks = launch(
+                [spec, "--devices", str(shards), "--no-tables",
+                 "--jsonl", jsonl],
+                processes=procs, timeout=_env_float(
+                    "GAMESMAN_BENCH_DEADLINE", 3000.0),
+                log_dir=td,
+            )
+            artifact["rc_by_rank"] = [r.returncode for r in ranks]
+            artifact["secs_wall"] = round(time.perf_counter() - t0, 3)
+            for r in ranks:
+                if r.returncode != 0:
+                    artifact["error"] = (
+                        f"rank {r.rank} rc={r.returncode}: "
+                        + r.stderr[-1500:]
+                    )
+                    return artifact
+            # Per-rank level times from the rank-stamped JSONL streams
+            # (the rank label is why they merge unambiguously).
+            levels: dict = {}
+            done: dict = {}
+            for rank in range(procs):
+                path = os.path.join(td, f"m.rank{rank}.jsonl")
+                with open(path) as fh:
+                    for line in fh:
+                        try:
+                            rec = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue
+                        phase = rec.get("phase")
+                        if phase in ("forward", "backward",
+                                     "backward_edges") and "level" in rec:
+                            row = levels.setdefault(
+                                int(rec["level"]),
+                                {"fwd_secs": {}, "bwd_secs": {}},
+                            )
+                            col = ("fwd_secs" if phase == "forward"
+                                   else "bwd_secs")
+                            row[col][str(rank)] = round(
+                                row[col].get(str(rank), 0.0)
+                                + float(rec.get("secs", 0.0)), 4)
+                        elif phase == "done":
+                            done[str(rank)] = {
+                                "positions": rec.get("positions"),
+                                "secs_total": round(
+                                    rec.get("secs_total", 0.0), 3),
+                            }
+            artifact["levels"] = [
+                {"level": k, **levels[k]} for k in sorted(levels)
+            ]
+            artifact["done_by_rank"] = done
+            positions = max(
+                (d.get("positions") or 0 for d in done.values()),
+                default=0,
+            )
+            artifact["positions"] = positions
+            artifact["positions_per_sec"] = round(
+                positions / max(artifact["secs_wall"], 1e-9), 1)
+            artifact["ok"] = True
+    except Exception as e:  # noqa: BLE001 - the bench must survive this
+        artifact["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        artifact.setdefault("secs_wall",
+                            round(time.perf_counter() - t0, 3))
+        try:
+            with open(out_path, "w") as fh:
+                json.dump(artifact, fh, indent=1)
+            print(f"multiprocess smoke: wrote {out_path} "
+                  f"(ok={artifact['ok']})", file=sys.stderr)
+        except OSError as e:
+            print(f"multiprocess smoke: cannot write {out_path}: {e}",
+                  file=sys.stderr)
+    return artifact
+
+
 def main() -> int:
     # The parent never touches jax — platform selection (GAMESMAN_PLATFORM)
     # is honored by the probe and measurement children, which inherit the
@@ -214,6 +319,16 @@ def main() -> int:
     # The parent is authoritative for fallback_cpu: a forced CPU run is a
     # deliberate baseline, not a fallback.
     record["fallback_cpu"] = bool(fallback)
+    mp = _multiprocess_smoke()
+    if mp is not None:
+        # Summary only — the per-rank level times live in the artifact
+        # file (BENCH_PROCESSES_OUT); the one-line record stays one line.
+        record["multiprocess"] = {
+            k: mp.get(k) for k in
+            ("processes", "shards", "ok", "positions",
+             "positions_per_sec", "secs_wall", "error")
+            if k in mp
+        }
     print(json.dumps(record))
     return 0
 

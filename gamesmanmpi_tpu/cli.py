@@ -412,26 +412,46 @@ def _main(args) -> int:
     apply_platform_env(default_fake_devices=max(args.devices, 1))
     if not _maybe_probe_backend():
         return 3
-    if args.coordinator:
+    # Multi-host identity: the flags win, the env (GAMESMAN_COORDINATOR /
+    # GAMESMAN_NUM_PROCESSES / GAMESMAN_PROCESS_ID — how tools/
+    # launch_multihost.py configures its children) fills the gaps.
+    coordinator = args.coordinator or env_opt("GAMESMAN_COORDINATOR")
+    if coordinator:
         # Must run before the first backend touch so every process joins the
         # same PJRT world; the mesh then spans all addressable devices.
-        if args.num_processes is None or args.process_id is None:
+        # Either spelling needs the full identity triple: without it,
+        # init_distributed's env_int defaults (1 process, rank 0) would
+        # quietly give every host its own one-process world all claiming
+        # rank 0 — an obscure bind/handshake failure instead of this.
+        if (args.num_processes is None
+                and env_opt("GAMESMAN_NUM_PROCESSES") is None) or (
+                args.process_id is None
+                and env_opt("GAMESMAN_PROCESS_ID") is None):
             print(
-                "error: --coordinator requires --num-processes and "
-                "--process-id",
+                "error: a coordinator (--coordinator / "
+                "GAMESMAN_COORDINATOR) requires --num-processes and "
+                "--process-id (or their GAMESMAN_* env twins)",
                 file=sys.stderr,
             )
             return 2
         from gamesmanmpi_tpu.parallel.mesh import init_distributed
 
         init_distributed(
-            coordinator_address=args.coordinator,
+            coordinator_address=coordinator,
             num_processes=args.num_processes,
             process_id=args.process_id,
         )
+        _configure_rank_env(coordinator, args)
     t0 = time.perf_counter()
 
     logger = _build_logger(args)
+    if logger is not None and coordinator:
+        import jax
+
+        if jax.process_count() > 1:
+            from gamesmanmpi_tpu.utils.metrics import RankLogger
+
+            logger = RankLogger(logger, jax.process_index())
     # Loggers are context managers: the JSONL handle closes even when a
     # solve aborts mid-level (partial metrics beat a lost buffered tail).
     # The obs scope nests inside so both artifacts (--trace-events,
@@ -439,6 +459,52 @@ def _main(args) -> int:
     with _logger_scope(logger):
         with _obs_scope(args):
             return _solve_main(args, t0, logger)
+
+
+def _rank_path(path: str, rank: int) -> str:
+    """``out.jsonl`` -> ``out.rank0.jsonl``: per-rank artifact names.
+
+    N processes handed one ``--jsonl``/``--metrics-out`` path must not
+    race each other onto the same file; rank-qualified siblings keep
+    every rank's stream intact and tools/obs_report.py merges them.
+    """
+    root, ext = os.path.splitext(path)
+    return f"{root}.rank{rank}{ext}"
+
+
+def _configure_rank_env(coordinator: str, args) -> None:
+    """Post-initialize rank plumbing for a multi-process run.
+
+    * ``GAMESMAN_COORD_ADDR`` (the retry-consensus coordinator,
+      resilience/coordination.py) defaults to the jax coordinator's host
+      at port+1 so a bare two-flag launch gets coordinated retry for
+      free; an explicit env value wins.
+    * Every ``gamesman_*`` series and JSONL record this process emits
+      gains a ``rank`` label (docs/OBSERVABILITY.md) — without it the
+      per-rank metrics of an N-process run are indistinguishable.
+    * File artifacts (--jsonl/--metrics-out/--trace-events/--table-out)
+      become rank-qualified siblings so ranks never race onto one path.
+    """
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    rank = jax.process_index()
+    for field in ("jsonl", "metrics_out", "trace_events", "table_out"):
+        val = getattr(args, field, None)
+        if val:
+            setattr(args, field, _rank_path(val, rank))
+    if not env_opt("GAMESMAN_COORD_ADDR"):
+        host, _, port = coordinator.rpartition(":")
+        try:
+            os.environ["GAMESMAN_COORD_ADDR"] = (
+                f"{host or '127.0.0.1'}:{int(port) + 1}"
+            )
+        except ValueError:
+            pass  # unparsable port: coordination stays unconfigured
+    from gamesmanmpi_tpu.obs import default_registry
+
+    default_registry().set_constant_labels(rank=str(jax.process_index()))
 
 
 def _solve_main(args, t0: float, logger) -> int:
@@ -665,8 +731,35 @@ def _solve_main(args, t0: float, logger) -> int:
             checkpointer=checkpointer,
             store_tables=not args.no_tables,
         )
-    with maybe_profile(args.profile_dir):
-        result = solver.solve()
+    from gamesmanmpi_tpu.resilience.coordination import CoordinatedAbort
+
+    try:
+        with maybe_profile(args.profile_dir):
+            result = solver.solve()
+    except CoordinatedAbort as e:
+        # The fleet agreed to stop (a peer died, diverged, or timed out):
+        # same resumable-abort contract as the watchdog — diagnostics to
+        # stderr, exit 124, checkpoint prefix intact, restart resumes.
+        from gamesmanmpi_tpu.resilience.supervisor import WATCHDOG_EXIT_CODE
+
+        progress = getattr(solver, "progress", {})
+        print(f"coordinated abort: {e}\nprogress: {progress}",
+              file=sys.stderr)
+        sys.stderr.flush()
+        if logger is not None:
+            # progress carries its own "phase" (forward/backward) — keep
+            # it under a different key or this record masquerades as a
+            # normal level row in obs_report's table.
+            logger.log({"phase": "coordinated_abort", "error": str(e)[:200],
+                        **{("in_phase" if k == "phase" else k): v
+                           for k, v in progress.items()
+                           if isinstance(v, (int, str, float))}})
+            logger.close()
+        # os._exit, not return: a clean interpreter exit would run jax's
+        # distributed-shutdown barrier, which blocks on the dead peer
+        # until the coordination service SIGABRTs this process ~100 s
+        # later — the watchdog contract is "gone within the deadline".
+        os._exit(WATCHDOG_EXIT_CODE)
     _report(result, args.devices, time.perf_counter() - t0, args)
     return 0
 

@@ -51,6 +51,29 @@ def rss_bytes() -> int:
         return 0
 
 
+def process_rank():
+    """This process's rank in a multi-process run, None single-process.
+
+    Reads sys.modules instead of importing jax: the heartbeat must work
+    (and stay cheap) in jax-free consumers like the query server, and
+    must never be the thing that first initializes a backend — which is
+    why an imported-but-untouched jax is ALSO left alone: process_count
+    itself triggers backend init, so we only ask once xla_bridge already
+    holds a live backend."""
+    try:
+        import sys
+
+        jax = sys.modules.get("jax")
+        xb = sys.modules.get("jax._src.xla_bridge")
+        if (jax is not None and xb is not None
+                and getattr(xb, "_backends", None)
+                and jax.process_count() > 1):
+            return int(jax.process_index())
+    except Exception:  # uninitialized distributed state: single-process
+        pass
+    return None
+
+
 def device_memory_stats() -> dict:
     """{device label: {bytes_in_use, bytes_limit}} for devices that
     report them; {} when jax is unavailable/uninitialized or the backend
@@ -142,6 +165,12 @@ class Heartbeat:
             "uptime_secs": round(self._clock() - self._t0, 3),
             "rss_bytes": rss_bytes(),
         }
+        rank = process_rank()
+        if rank is not None:
+            # Rank-stamped so N processes' interleaved heartbeat streams
+            # stay attributable (docs/DISTRIBUTED.md); single-process
+            # records are byte-identical to before.
+            rec["rank"] = rank
         if self.progress is not None:
             try:
                 # Nested, not merged: the solver's progress dict carries

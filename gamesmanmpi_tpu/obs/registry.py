@@ -192,7 +192,20 @@ class MetricsRegistry:
     def __init__(self, *, clock=time.time):
         self._lock = threading.RLock()
         self._families: Dict[str, _Family] = {}  # guarded-by: _lock
+        self._constant_labels: Dict[str, str] = {}  # guarded-by: _lock
         self._clock = clock
+
+    def set_constant_labels(self, **labels) -> None:
+        """Labels stamped onto every child created afterwards (explicit
+        per-call labels win on collision). The multi-process rank label
+        rides here: one call after jax.distributed.initialize and every
+        ``gamesman_*`` series this process emits carries
+        ``rank="<process_index>"`` — call sites stay unchanged, and a
+        single-process run's exposition is byte-identical to before."""
+        with self._lock:
+            self._constant_labels.update(
+                {str(k): str(v) for k, v in labels.items()}
+            )
 
     # -------------------------------------------------------- registration
 
@@ -213,10 +226,12 @@ class MetricsRegistry:
             return fam
 
     def _child(self, fam: _Family, labels: dict, cls):
-        key = _labels_key(labels)
-        for k, _ in key:
-            _check_name(k)
         with self._lock:
+            if self._constant_labels:
+                labels = {**self._constant_labels, **labels}
+            key = _labels_key(labels)
+            for k, _ in key:
+                _check_name(k)
             child = fam.children.get(key)
             if child is None:
                 child = fam.children[key] = cls(fam, key)

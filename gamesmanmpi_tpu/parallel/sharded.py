@@ -100,13 +100,25 @@ from gamesmanmpi_tpu.ops.provenance import (
     dedup_provenance,
     provenance_sort_bytes,
 )
-from gamesmanmpi_tpu.obs import Span
+from gamesmanmpi_tpu.obs import Span, default_registry
 from gamesmanmpi_tpu.parallel.mesh import AXIS, make_mesh, shard_map
 from gamesmanmpi_tpu.resilience import faults
-from gamesmanmpi_tpu.resilience.retry import retry_call
+from gamesmanmpi_tpu.resilience.coordination import (
+    ABORT,
+    OK,
+    RETRY,
+    CoordinatedAbort,
+    CoordinationError,
+    coordination_from_env,
+)
+from gamesmanmpi_tpu.resilience.retry import is_transient, retry_call
 from gamesmanmpi_tpu.resilience.supervisor import maybe_watchdog
 from gamesmanmpi_tpu.utils.checkpoint import TORN_NPZ_ERRORS
-from gamesmanmpi_tpu.utils.env import env_opt, env_str
+from gamesmanmpi_tpu.utils.env import (
+    env_float as _env_float,
+    env_opt,
+    env_str,
+)
 from gamesmanmpi_tpu.solve.engine import (
     LevelTable,
     SolveResult,
@@ -150,6 +162,24 @@ def _pad_shards(shard_arrays: List[np.ndarray], cap: int) -> np.ndarray:
     for s, arr in enumerate(shard_arrays):
         out[s, : arr.shape[0]] = arr
     return out
+
+
+def _fetch_global(arr) -> np.ndarray:
+    """np.asarray that works across processes.
+
+    A P(AXIS)-sharded array under multi-process execution spans
+    non-addressable devices, which plain np.asarray refuses; the gather
+    collective (multihost_utils.process_allgather) fetches the
+    fully-replicated value instead — every rank ends up holding the
+    global copy, which is exactly what the callers (level
+    materialization, whole-level host spill) need to stay byte-identical
+    with the single-process engine.
+    """
+    if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(arr))
+    return np.asarray(arr)
 
 
 def _route_by_owner(flat, S: int, cap_out: int, sentinel):
@@ -531,7 +561,7 @@ class _SLevel:
 
     def host_shards(self) -> List[np.ndarray]:
         if self.host is None:
-            stacked = np.asarray(self.dev)
+            stacked = _fetch_global(self.dev)
             self.host = [
                 stacked[s, : int(self.counts[s])]
                 for s in range(stacked.shape[0])
@@ -638,24 +668,246 @@ class ShardedSolver:
         self.bytes_gathered = 0
         #: transient level-step failures absorbed by retry (stats field).
         self.retries = 0
+        #: this process's rank in the multi-process run (0 single-process).
+        self.rank = jax.process_index()
+        self.num_processes = jax.process_count()
+        # Cross-rank retry/abort consensus (resilience/coordination.py):
+        # built from GAMESMAN_COORD_ADDR under multi-process execution so
+        # transient faults at collective fault points are retried by ALL
+        # ranks together or aborted by all ranks together — a lone rank
+        # re-entering a step that contains an all_to_all while its peers
+        # proceed would wedge the job forever. None = rank-local retry
+        # (single process, or coordination unconfigured).
+        self.coord = coordination_from_env(self.rank, self.num_processes)
+        #: per-collective deadline (GAMESMAN_COLLECTIVE_TIMEOUT, seconds):
+        #: under multi-process execution a peer's death leaves this rank
+        #: BLOCKED inside the collective — uninterruptible from Python —
+        #: so the only honest recovery is the watchdog contract: dump
+        #: per-rank progress and exit 124 with the checkpoint prefix
+        #: intact. 0 = off.
+        self.collective_timeout = _env_float(
+            "GAMESMAN_COLLECTIVE_TIMEOUT", 0.0
+        )
         #: phase/level progress for the watchdog (replaced atomically,
         #: never mutated — same contract as the single-device engine's).
-        self.progress: dict = {"phase": "init"}
+        self.progress: dict = {"phase": "init", "rank": self.rank}
         # Mesh identity participates in the process-wide kernel cache key
         # (same shard count over different device sets must not share).
         self._mesh_key = tuple(d.id for d in self.mesh.devices.flat)
         self._sharding = NamedSharding(self.mesh, P(AXIS))
 
-    def _retry(self, point: str, fn, reset=None, level=None):
+    def _retry(self, point: str, fn, reset=None, level=None, entry=None):
         """Level-step retry wrapper (see resilience.retry): the sharded
         steps' inputs — frontier, window triples, edge arrays — stay
-        referenced across the step, so re-dispatch is idempotent."""
+        referenced across the step, so re-dispatch is idempotent.
 
-        def on_retry(attempt, exc):
-            self.retries += 1
+        ``entry`` is the step's host-side prelude — the call site's
+        literal ``faults.fire`` — evaluated BEFORE any collective
+        dispatches: under multi-process execution that is the one
+        program point where a rank-local failure is still safely
+        retryable, because no rank has entered the collective yet. With
+        a coordination handle the whole retry decision is a cross-rank
+        consensus round (_retry_collective); without one (single
+        process) the behavior is exactly PR 4's rank-local retry_call.
+        """
+        if self.coord is None:
 
-        return retry_call(fn, point=point, reset=reset, level=level,
-                          logger=self.logger, on_retry=on_retry)
+            def unit():
+                if entry is not None:
+                    entry()
+                return fn()
+
+            def on_retry(attempt, exc):
+                self.retries += 1
+
+            return retry_call(unit, point=point, reset=reset, level=level,
+                              logger=self.logger, on_retry=on_retry)
+        return self._retry_collective(point, fn, reset, level, entry)
+
+    def _retry_collective(self, point: str, fn, reset, level, entry=None):
+        """Collective-safe retry: all ranks enter, retry, or abort a
+        level step TOGETHER.
+
+        Protocol per attempt: every rank evaluates the step's entry
+        (fault points fire here, before any collective dispatches),
+        proposes ok/retry/abort for the shared epoch
+        ``<seq>:<point>:L<level>:a<attempt>:pre``, and acts on the
+        fleet's decision — so a transient injected on ONE rank turns
+        into a retry on EVERY rank (each counts it: the
+        ``gamesman_retries_total`` criterion), and a fatal anywhere
+        aborts everywhere. A failure DURING the dispatched step (a
+        collective transport error) goes through a ``post`` round
+        instead: peers that already completed the step will never join
+        it, so the round resolves by deadline into a coordinated abort
+        — the one correct answer once ranks have diverged — while a
+        symmetric failure (all ranks raised) agrees to retry.
+        Consensus-service failures (coordinator death) convert to
+        CoordinatedAbort, never a hang.
+        """
+        attempts = max(1, _env_int("GAMESMAN_RETRY_ATTEMPTS", 3))
+        base = _env_float("GAMESMAN_RETRY_BASE_SECS", 0.25)
+        for attempt in range(1, attempts + 1):
+            err = None
+            try:
+                faults.fire("sharded.collective", step=point, level=level)
+                if entry is not None:
+                    entry()
+            except Exception as e:  # noqa: BLE001 - classified below
+                err = e
+            verdict = self._verdict_for(err, attempt, attempts)
+            decision = self._propose_step(point, level, attempt, "pre",
+                                          verdict, err)
+            if decision == RETRY:
+                self._note_coordinated_retry(point, level, attempt, err)
+                if base > 0:
+                    time.sleep(base * (2 ** (attempt - 1)))
+                if reset is not None:
+                    reset()
+                continue
+            if decision != OK:
+                self._coordinated_abort(point, level, err, verdict)
+            try:
+                with self._collective_deadline(point, level):
+                    return fn()
+            except Exception as e:  # noqa: BLE001 - classified below
+                if not is_transient(e) or attempt >= attempts:
+                    raise
+                decision = self._propose_step(point, level, attempt,
+                                              "post", RETRY, e)
+                if decision == RETRY:
+                    self._note_coordinated_retry(point, level, attempt, e)
+                    if base > 0:
+                        time.sleep(base * (2 ** (attempt - 1)))
+                    if reset is not None:
+                        reset()
+                    continue
+                self._coordinated_abort(point, level, e, RETRY)
+        raise SolverError(
+            f"retry loop for {point} level {level} exhausted without a "
+            "decision"
+        )  # pragma: no cover - every branch returns, continues, or raises
+
+    @staticmethod
+    def _verdict_for(err, attempt: int, attempts: int) -> str:
+        if err is None:
+            return OK
+        if is_transient(err) and attempt < attempts:
+            return RETRY
+        return ABORT
+
+    def _propose_step(self, point: str, level, attempt: int, phase: str,
+                      verdict: str, err) -> str:
+        tag = f"{point}:L{level}:a{attempt}:{phase}"
+        try:
+            return self.coord.propose(tag, verdict)
+        except CoordinationError as e:
+            # The consensus service itself failed (coordinator death,
+            # wire junk): abort — a guess here could strand a peer
+            # inside a collective.
+            raise CoordinatedAbort(
+                f"coordination failed at {tag} (rank {self.rank}): {e}"
+            ) from (err or e)
+
+    def _note_coordinated_retry(self, point: str, level, attempt: int,
+                                err) -> None:
+        """Every rank records the fleet-wide retry decision — the
+        counters must AGREE across ranks, whichever rank hosted the
+        fault (rank-labelled via the registry's constant labels)."""
+        self.retries += 1
+        default_registry().counter(
+            "gamesman_retries_total",
+            "transient step failures absorbed by retry",
+            point=point,
+        ).inc()
+        if self.logger is not None:
+            rec = {
+                "phase": "retry",
+                "point": point,
+                "attempt": attempt,
+                "rank": self.rank,
+                "coordinated": True,
+                "error": str(err)[:200] if err is not None else "peer",
+            }
+            if level is not None:
+                rec["level"] = int(level)
+            self.logger.log(rec)
+
+    def _coordinated_abort(self, point: str, level, err, verdict):
+        """ABORT decision: raise this rank's own error only when IT was
+        the abort cause (verdict ABORT — fail fast with the real fatal).
+        A rank whose local failure was retryable (or absent) aborts
+        because of a PEER: that must surface as CoordinatedAbort — the
+        exception the CLI maps to the exit-124 resumable-abort contract
+        — not as a transient traceback that misattributes the abort to
+        a fault the fleet would have retried."""
+        if err is not None and verdict == ABORT:
+            raise err
+        detail = (f"rank {self.rank} was healthy" if err is None
+                  else f"rank {self.rank} proposed retry for: "
+                  f"{str(err)[:200]}")
+        raise CoordinatedAbort(
+            f"fleet aborted at {point} level {level} ({detail})"
+        ) from err
+
+    def _collective_deadline(self, point: str, level):
+        """Deadline guard around one dispatched collective step: when a
+        peer dies mid-collective this rank blocks forever inside the
+        runtime, so a daemon timer dumps this rank's progress and exits
+        124 — the watchdog's abort contract, checkpoint prefix intact,
+        and every surviving rank does the same within the deadline
+        (the 'coordinated resumable abort'). Off unless
+        GAMESMAN_COLLECTIVE_TIMEOUT > 0 and the run is multi-process.
+        """
+        import contextlib
+
+        secs = self.collective_timeout
+        if secs <= 0 or self.num_processes <= 1:
+            return contextlib.nullcontext()
+
+        @contextlib.contextmanager
+        def guard():
+            import threading
+
+            def expire():
+                from gamesmanmpi_tpu.resilience.supervisor import (
+                    WATCHDOG_EXIT_CODE,
+                )
+                import os
+                import sys
+
+                rec = {
+                    "phase": "collective_abort",
+                    "point": point,
+                    "level": level,
+                    "rank": self.rank,
+                    "deadline_secs": secs,
+                    "progress": dict(self.progress),
+                }
+                sys.stderr.write(
+                    f"[coordination] collective deadline expired: {rec}\n"
+                )
+                sys.stderr.flush()
+                default_registry().counter(
+                    "gamesman_collective_deadline_expired_total",
+                    "collectives aborted by the per-collective deadline",
+                    point=point,
+                ).inc()
+                if self.logger is not None:
+                    try:
+                        self.logger.log(rec)
+                    except Exception:  # noqa: BLE001 - exiting anyway
+                        pass
+                os._exit(WATCHDOG_EXIT_CODE)
+
+            timer = threading.Timer(secs, expire)
+            timer.daemon = True
+            timer.start()
+            try:
+                yield
+            finally:
+                timer.cancel()
+
+        return guard()
 
     # ------------------------------------------------------------- jit builds
 
@@ -1168,7 +1420,7 @@ class ShardedSolver:
         while True:
             t0 = time.perf_counter()
             self.progress = {
-                "phase": "forward", "level": k,
+                "phase": "forward", "level": k, "rank": self.rank,
                 "frontier": int(levels[k].counts.sum()),
             }
             b0 = (self.bytes_routed, self.bytes_sorted)
@@ -1178,9 +1430,7 @@ class ShardedSolver:
                 # The whole dispatch+counts-sync is the retried unit: a
                 # transient collective failure re-dispatches from the
                 # frontier, which stays referenced across the step.
-                def _step(cap=cap, route_cap=route_cap, frontier=frontier,
-                          k=k):
-                    faults.fire("sharded.forward", level=k)
+                def _step(cap=cap, route_cap=route_cap, frontier=frontier):
                     if self.use_edges:
                         u, e, sl, c, sc = self._forward_fn(
                             cap, route_cap, provenance=True
@@ -1191,7 +1441,9 @@ class ShardedSolver:
                     return u, e, sl, c, int(np.asarray(sc).max())
 
                 uniq, eidx, slot, count, max_sent = self._retry(
-                    "sharded.forward", _step, level=k
+                    "sharded.forward", _step, level=k,
+                    entry=lambda k=k: faults.fire("sharded.forward",
+                                                  level=k),
                 )
                 if max_sent <= route_cap:
                     break
@@ -1294,7 +1546,8 @@ class ShardedSolver:
         while pools:
             k = min(pools)
             t0 = time.perf_counter()
-            self.progress = {"phase": "forward", "level": k}
+            self.progress = {"phase": "forward", "level": k,
+                             "rank": self.rank}
             b0 = (self.bytes_routed, self.bytes_sorted)
             frontier, counts = pools.pop(k)
             rec = _SLevel(counts, frontier, None)
@@ -1312,14 +1565,14 @@ class ShardedSolver:
             cap = frontier.shape[1]
             route_cap = self._initial_route_cap(cap)
             while True:
-                def _step(cap=cap, route_cap=route_cap, frontier=frontier,
-                          k=k):
-                    faults.fire("sharded.forward", level=k)
+                def _step(cap=cap, route_cap=route_cap, frontier=frontier):
                     u, c, sc = self._forward_fn(cap, route_cap)(frontier)
                     return u, c, int(np.asarray(sc).max())
 
                 uniq, count, max_sent = self._retry(
-                    "sharded.forward", _step, level=k
+                    "sharded.forward", _step, level=k,
+                    entry=lambda k=k: faults.fire("sharded.forward",
+                                                  level=k),
                 )
                 if max_sent <= route_cap:
                     break
@@ -1558,7 +1811,7 @@ class ShardedSolver:
             b0 = (self.bytes_routed, self.bytes_sorted, self.bytes_gathered)
             rec = levels[k]
             self.progress = {
-                "phase": "backward", "level": k,
+                "phase": "backward", "level": k, "rank": self.rank,
                 "n": int(rec.counts.sum()),
             }
             from_checkpoint = k in completed
@@ -1617,19 +1870,20 @@ class ShardedSolver:
 
                 def _resolve_e(eidx=eidx, slot=slot, ecap=ecap, rec=rec,
                                k=k):
-                    faults.fire("sharded.backward", level=k)
                     return self._resolve_edges_level(
                         rec, eidx, slot, ecap,
                         dev_cache.get(k + 1), host_cache.get(k + 1),
                     )
 
                 values_dev, rem_dev, misses = self._retry(
-                    "sharded.backward", _resolve_e, level=k
+                    "sharded.backward", _resolve_e, level=k,
+                    entry=lambda k=k: faults.fire("sharded.backward",
+                                                  level=k),
                 )
                 self.backward_edges_levels += 1
                 del eidx, slot
                 rec.eidx = rec.slot = None  # release the edge arrays
-                if self.paranoid and int(np.asarray(misses).sum()) > 0:
+                if self.paranoid and int(_fetch_global(misses).sum()) > 0:
                     raise SolverError(
                         f"level {k}: consistency failures (zero-move "
                         "non-primitive positions)"
@@ -1652,14 +1906,15 @@ class ShardedSolver:
                         window_flat.extend(dev_cache[L])
 
                     def _resolve_l(rec=rec, window_caps=window_caps,
-                                   window_flat=window_flat, k=k):
-                        faults.fire("sharded.backward", level=k)
+                                   window_flat=window_flat):
                         return self._resolve_blocked(
                             rec.dev, window_caps, window_flat
                         )
 
                     values_dev, rem_dev, misses = self._retry(
-                        "sharded.backward", _resolve_l, level=k
+                        "sharded.backward", _resolve_l, level=k,
+                        entry=lambda k=k: faults.fire("sharded.backward",
+                                                      level=k),
                     )
                 else:
                     # At least one window level was spilled: stream ALL of
@@ -1678,16 +1933,17 @@ class ShardedSolver:
                             del dev_cache[L]
                         windows.append(host_cache[L])
 
-                    def _resolve_s(rec=rec, windows=windows, k=k):
-                        faults.fire("sharded.backward", level=k)
+                    def _resolve_s(rec=rec, windows=windows):
                         return self._resolve_blocked_streamed(
                             rec.dev, windows
                         )
 
                     values_dev, rem_dev, misses = self._retry(
-                        "sharded.backward", _resolve_s, level=k
+                        "sharded.backward", _resolve_s, level=k,
+                        entry=lambda k=k: faults.fire("sharded.backward",
+                                                      level=k),
                     )
-                if self.paranoid and int(np.asarray(misses).sum()) > 0:
+                if self.paranoid and int(_fetch_global(misses).sum()) > 0:
                     raise SolverError(
                         f"level {k}: consistency failures (missed child "
                         "lookups or zero-move non-primitive positions)"
@@ -1828,8 +2084,8 @@ class ShardedSolver:
         # Global table for this level (kept sharded on device during the
         # solve; materialized for the result).
         shards = rec.host_shards()
-        values = np.asarray(values_dev)
-        remoteness = np.asarray(rem_dev)
+        values = _fetch_global(values_dev)
+        remoteness = _fetch_global(rem_dev)
         gs, gv, gr = [], [], []
         for s in range(self.S):
             n = int(rec.counts[s])
@@ -1939,6 +2195,12 @@ class ShardedSolver:
         self.bytes_routed += S * S * ecap * 4  # packed cells back
         return self._ereply_fn(rec.dev.shape[1], ecap)(rec.dev, acc, slot)
 
+    def _shard_ranks(self) -> List[int]:
+        """shard index -> owning process rank (all zeros single-host):
+        the rank-set stamp each seal records so resume can tell WHICH
+        process was responsible for a torn or missing shard file."""
+        return [int(d.process_index) for d in self.mesh.devices.flat]
+
     @staticmethod
     def _shard_id(shard) -> int:
         """Global shard index of an addressable shard.
@@ -1961,15 +2223,15 @@ class ShardedSolver:
                 if self._shard_id(sh) == s:
                     return np.asarray(sh.data)[0][: int(rec.counts[s])]
             return None
-        if jax.process_count() > 1:
-            # A host-spilled level under multi-host cannot be attributed to
-            # one writer per shard (the spill itself is single-process);
-            # refuse rather than write racy snapshot files.
-            raise SolverError(
-                "frontier checkpointing of host-spilled levels is not "
-                "supported under multi-host execution — raise "
-                "GAMESMAN_DEVICE_STORE_MB or checkpoint from a single host"
-            )
+        if self.num_processes > 1:
+            # Host-resident level under multi-process execution (a
+            # resumed checkpoint prefix, or a budget spill fetched via
+            # the gather collective): every rank holds the full copy, so
+            # write-ownership follows the mesh — the rank owning the
+            # shard's device writes its file, everyone else defers. One
+            # writer per shard, no racy duplicate snapshot files.
+            if self._shard_ranks()[s] != self.rank:
+                return None
         return rec.host_shards()[s]
 
     @staticmethod
@@ -1999,7 +2261,9 @@ class ShardedSolver:
                 self.checkpointer.save_forward_level_shard(k, s, rows)
         self._sync_processes(f"forward_level_{k}_shards_written")
         if jax.process_index() == 0:
-            self.checkpointer.finish_forward_level(k, self.S)
+            self.checkpointer.finish_forward_level(
+                k, self.S, ranks=self._shard_ranks()
+            )
 
     def _checkpoint_frontier_shards(self, levels) -> None:
         """Per-shard frontier snapshot files, one shard at a time.
@@ -2044,7 +2308,9 @@ class ShardedSolver:
             self.checkpointer.save_level_shard(k, s, states[:n], cells)
         self._sync_processes(f"level_{k}_shards_written")
         if jax.process_index() == 0:
-            self.checkpointer.finish_level_shards(k, self.S)
+            self.checkpointer.finish_level_shards(
+                k, self.S, ranks=self._shard_ranks()
+            )
 
     @staticmethod
     def _rows_of(arr, s: int):
@@ -2084,7 +2350,8 @@ class ShardedSolver:
             slot_len = (rec.slot.cap if isinstance(rec.slot, _HostSpill)
                         else rec.slot.shape[1])
             self.checkpointer.finish_edges_level(
-                k, self.S, rec.ecap, int(slot_len)
+                k, self.S, rec.ecap, int(slot_len),
+                ranks=self._shard_ranks(),
             )
 
     # ------------------------------------------------------------------ solve
@@ -2100,6 +2367,8 @@ class ShardedSolver:
         finally:
             if wd is not None:
                 wd.stop()
+            if self.coord is not None:
+                self.coord.close()
 
     def _solve_impl(self) -> SolveResult:
         g = self.game
@@ -2107,6 +2376,26 @@ class ShardedSolver:
         init, start_level = canonical_scalar(g, g.initial_state())
         if self.checkpointer is not None:
             self.checkpointer.bind_game(g.name)
+            if self.coord is not None:
+                # Rank-consistent resume: every rank independently reads
+                # the manifest and digests its resume state (deepest
+                # mutually-sealed level + the sealed sets). Identical
+                # digests meet at one epoch and pass; ANY divergence —
+                # a rank seeing a different checkpoint directory or a
+                # half-synced filesystem — lands on different epochs,
+                # which the barrier deadline turns into a coordinated
+                # abort instead of a silently-forking solve.
+                digest = self.checkpointer.resume_digest(self.S)
+                self.coord.barrier(f"resume:{digest}")
+            if self.rank == 0:
+                # Stamp the run AFTER the agreement (the stamp mutates
+                # the manifest the digest reads): seals taken this run
+                # carry this epoch + the rank that owns each shard.
+                self.checkpointer.stamp_run(
+                    self.num_processes, self._shard_ranks()
+                )
+            if self.coord is not None:
+                self.coord.barrier("run_stamped")
         saved_shards = (
             self.checkpointer.load_frontier_shards(self.S)
             if self.checkpointer is not None
@@ -2182,7 +2471,7 @@ class ShardedSolver:
             "bytes_sorted": self.bytes_sorted,
             "bytes_gathered": self.bytes_gathered,
         }
-        self.progress = {"phase": "done"}
+        self.progress = {"phase": "done", "rank": self.rank}
         if self.logger is not None:
             self.logger.log({"phase": "done", **stats})
         return SolveResult(g, root_value, root_rem, resolved, stats)

@@ -79,6 +79,11 @@ KNOWN_POINTS = {
     "engine.backward": "single-device backward: per-level resolve",
     "sharded.forward": "sharded forward: per-level all_to_all expand step",
     "sharded.backward": "sharded backward: per-level owner-routed resolve",
+    "sharded.collective": "sharded multi-process: collective entry, before "
+                          "the pre-step consensus round",
+    "coord.barrier": "coordination: top of every epoch-barrier proposal",
+    "coord.handshake": "coordination: client dial of the rank-0 "
+                       "coordinator socket",
     "ckpt.save_frontier": "checkpoint: after a frontier level is sealed",
     "ckpt.save_level": "checkpoint: after a solved level is sealed",
     "ckpt.load_level": "checkpoint: at the top of a resume level load",

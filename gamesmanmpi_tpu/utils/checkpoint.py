@@ -220,6 +220,71 @@ class LevelCheckpointer:
         manifest.pop("frontiers_complete", None)
         self._write_manifest(manifest)
 
+    # -------------------------------------------- cross-rank consistency
+    # Multi-process seal stamps (ISSUE 6): each sealed artifact records
+    # the run epoch it was taken in and which process rank owned each
+    # shard file. Resume verifies every rank digests the SAME state
+    # (ShardedSolver barriers on resume_digest) and can attribute a torn
+    # or missing per-rank shard file to its writer instead of guessing.
+
+    def stamp_run(self, num_processes: int, ranks=None) -> int:
+        """Increment the manifest's run epoch (process 0, solve start).
+
+        The epoch distinguishes seals taken by the current attempt from
+        a previous (possibly differently-shaped) run's: a resumed solve
+        after a rank death carries epoch N+1 while the surviving prefix
+        keeps N — both valid, both loadable, but auditable."""
+        manifest = self.load_manifest()
+        run = manifest.get("run", {})
+        epoch = int(run.get("epoch", 0)) + 1
+        manifest["run"] = {
+            "epoch": epoch,
+            "num_processes": int(num_processes),
+            "ranks": list(ranks) if ranks is not None else [],
+        }
+        self._write_manifest(manifest)
+        return epoch
+
+    def run_info(self) -> dict:
+        """{"epoch", "num_processes", "ranks"} of the latest stamped run
+        ({} for pre-distributed directories)."""
+        return self.load_manifest().get("run", {})
+
+    @staticmethod
+    def _stamp_seal(manifest: dict, table: str, level: int,
+                    ranks=None) -> None:
+        """Record one seal's (epoch, rank-set) stamp in ``manifest``
+        (caller writes the manifest — seal + stamp land atomically)."""
+        manifest.setdefault(table, {})[str(level)] = {
+            "epoch": int(manifest.get("run", {}).get("epoch", 0)),
+            "ranks": list(ranks) if ranks is not None else [],
+        }
+
+    def resume_digest(self, num_shards: int) -> str:
+        """Stable digest of everything resume decisions read: the
+        deepest mutually-sealed solved level, the sealed level sets,
+        the frontier snapshots, and the run epoch. Every rank computes
+        it independently and barriers on it — agreement means the ranks
+        share one view of the checkpoint directory; divergence aborts
+        the fleet before any rank loads a different prefix."""
+        import hashlib
+
+        manifest = self.load_manifest()
+        completed = self.completed_levels()
+        view = {
+            "deepest_sealed": max(completed) if completed else None,
+            "completed": completed,
+            "sharded": sorted(manifest.get("sharded_levels", {})),
+            "forward": sorted(manifest.get("forward_level_shards", {})),
+            "frontier_shards": manifest.get("frontier_shards"),
+            "frontiers": bool(manifest.get("frontiers")),
+            "edges": sorted(manifest.get("edge_levels", {})),
+            "epoch": manifest.get("run", {}).get("epoch", 0),
+            "num_shards": num_shards,
+        }
+        blob = json.dumps(view, sort_keys=True).encode()
+        return hashlib.sha1(blob).hexdigest()
+
     def bind_game(self, name: str) -> None:
         """Record/validate which game this directory belongs to.
 
@@ -345,7 +410,8 @@ class LevelCheckpointer:
             self._shard_level_path(level, shard), states=states, cells=cells
         )
 
-    def finish_level_shards(self, level: int, num_shards: int) -> None:
+    def finish_level_shards(self, level: int, num_shards: int,
+                            ranks=None) -> None:
         manifest = self.load_manifest()
         manifest.setdefault("sharded_levels", {})[str(level)] = num_shards
         # The sealer (process 0, post-barrier) records every shard file's
@@ -356,6 +422,7 @@ class LevelCheckpointer:
             p = self._shard_level_path(level, s)
             if p.exists():
                 crc[p.name] = file_crc32(p)
+        self._stamp_seal(manifest, "level_seals", level, ranks)
         self._write_manifest(manifest)
         faults.fire(
             "ckpt.save_level",
@@ -451,13 +518,14 @@ class LevelCheckpointer:
         )
 
     def finish_edges_level(self, level: int, num_shards: int, ecap: int,
-                           slot_len: int) -> None:
+                           slot_len: int, ranks=None) -> None:
         """Seal one level's edge-shard set (process 0, post-barrier)."""
         manifest = self.load_manifest()
         manifest.setdefault("edge_levels", {})[str(level)] = {
             "shards": num_shards, "ecap": int(ecap),
             "slot_len": int(slot_len),
         }
+        self._stamp_seal(manifest, "edge_seals", level, ranks)
         self._write_manifest(manifest)
 
     def edge_level_info(self, level: int):
@@ -482,13 +550,54 @@ class LevelCheckpointer:
             states=np.asarray(states),
         )
 
-    def finish_forward_level(self, level: int, num_shards: int) -> None:
+    def finish_forward_level(self, level: int, num_shards: int,
+                             ranks=None) -> None:
         """Seal one forward level's shard set (process 0, post-barrier —
-        same write discipline as finish_level_shards)."""
+        same write discipline as finish_level_shards, including the
+        per-file crc so a torn per-rank frontier file is caught and
+        quarantined on resume rather than silently resuming a holed
+        discovery prefix)."""
         manifest = self.load_manifest()
         manifest.setdefault("forward_level_shards", {})[str(level)] = (
             num_shards
         )
+        crc = manifest.setdefault("crc", {})
+        for s in range(num_shards):
+            p = self.dir / f"frontier_{level:04d}.shard_{s:04d}.npz"
+            if p.exists():
+                crc[p.name] = file_crc32(p)
+        self._stamp_seal(manifest, "forward_seals", level, ranks)
+        self._write_manifest(manifest)
+
+    def _quarantine_forward_shard_level(self, level: int,
+                                        num_shards: int) -> None:
+        """Quarantine one sealed forward level's shard files and unseal
+        it together with every deeper forward level (the resume contract
+        is contiguous-from-root): the run degrades to the longest
+        rank-consistent prefix and re-expands from its deepest level.
+
+        Idempotent and concurrency-tolerant: under multi-process resume
+        EVERY rank walks the same torn directory (the resume-digest
+        barrier runs before loads, but the tear itself is discovered
+        during them), so a peer may rename a file between this rank's
+        exists() and rename() — losing that race is fine (the file IS
+        quarantined), and the manifest rewrite is atomic with identical
+        content on every rank."""
+        manifest = self.load_manifest()
+        crc = manifest.get("crc", {})
+        rec = manifest.get("forward_level_shards", {})
+        dropped = [k for k in rec if int(k) >= level]
+        for k in dropped:
+            rec.pop(k, None)
+            manifest.get("forward_seals", {}).pop(k, None)
+            for s in range(num_shards):
+                p = self.dir / f"frontier_{int(k):04d}.shard_{s:04d}.npz"
+                if int(k) == level and p.exists():
+                    try:
+                        p.rename(p.with_name(p.name + ".corrupt"))
+                    except OSError:
+                        pass  # a peer rank won the rename race
+                crc.pop(p.name, None)
         self._write_manifest(manifest)
 
     def load_forward_level_shards(self, num_shards: int) -> dict:
@@ -496,7 +605,8 @@ class LevelCheckpointer:
         (possibly partial) discovery prefix; {} when none exist or any
         level was sealed at a different shard count (shard-to-shard resume
         only — a changed mesh re-runs forward)."""
-        rec = self.load_manifest().get("forward_level_shards", {})
+        manifest = self.load_manifest()
+        rec = manifest.get("forward_level_shards", {})
         out: dict = {}
         # Levels in ascending order: the consumer (_forward_fast) resumes
         # only a contiguous-from-root prefix, so a torn level truncates
@@ -510,15 +620,19 @@ class LevelCheckpointer:
                     path = self.dir / (
                         f"frontier_{int(k):04d}.shard_{s:04d}.npz"
                     )
+                    self._check_crc(path, manifest)
                     with np.load(path) as z:
                         arrs.append(z["states"])
             except TORN_NPZ_ERRORS:
-                # Torn directory (a death between unlink and manifest
-                # write in an older layout, or mid-resave before _savez
-                # became atomic — BadZipFile/short-read OSError/KeyError,
-                # ADVICE r5): keep the intact prefix below this level —
-                # at big-run scale the prefix is hours of re-discovery —
-                # and re-run forward from its deepest.
+                # Torn or crc-mismatching per-rank file (a death between
+                # unlink and manifest write in an older layout, a
+                # mid-resave before _savez became atomic, or a rank's
+                # write the filesystem lied about — BadZipFile/short-read
+                # OSError/KeyError/CorruptCheckpointError, ADVICE r5):
+                # quarantine this level and keep the intact prefix below
+                # it — at big-run scale the prefix is hours of
+                # re-discovery — and re-run forward from its deepest.
+                self._quarantine_forward_shard_level(int(k), num_shards)
                 break
             out[int(k)] = arrs
         return out

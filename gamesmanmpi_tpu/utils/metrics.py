@@ -72,6 +72,28 @@ class StdoutLogger(_ClosingLogger):
         pass
 
 
+class RankLogger(_ClosingLogger):
+    """Stamp every record with the emitting process's rank.
+
+    A multi-process solve writes one JSONL stream per rank (same
+    schema); without the stamp the merged streams are rank-ambiguous and
+    tools/obs_report.py cannot tell "two ranks timed the same level"
+    (wall-clock: take the max) from "one rank retried it" (accumulate).
+    """
+
+    def __init__(self, inner, rank: int):
+        self.inner = inner
+        self.rank = int(rank)
+
+    def log(self, record: dict) -> None:
+        if "rank" not in record:
+            record = {**record, "rank": self.rank}
+        self.inner.log(record)
+
+    def close(self) -> None:
+        self.inner.close()
+
+
 class TeeLogger(_ClosingLogger):
     """Fan a record out to several loggers."""
 

@@ -64,6 +64,38 @@ def test_bench_dense_happy_path():
 
 
 @pytest.mark.slow
+def test_bench_multiprocess_smoke_artifact(tmp_path):
+    """BENCH_PROCESSES=2 (ISSUE 6): the bench additionally drives a REAL
+    2-process sharded solve through tools/launch_multihost.py and emits
+    a MULTICHIP-style artifact with per-rank level times, while stdout
+    stays exactly one JSON line with a multiprocess summary."""
+    out = tmp_path / "MULTICHIP_mp.json"
+    record, stderr = _run_bench({
+        "BENCH_ENGINE": "classic",
+        "BENCH_PROCESSES": "2",
+        "BENCH_MP_GAME": "connect4:w=3,h=3,connect=3",
+        "BENCH_PROCESSES_OUT": str(out),
+    })
+    assert record["positions"] == 694  # the primary metric still ran
+    mp = record["multiprocess"]
+    artifact = json.loads(out.read_text())
+    if not mp["ok"] and "Multiprocess computations" in artifact.get(
+            "error", ""):
+        pytest.skip("backend cannot run multiprocess collectives")
+    assert mp["ok"] is True, artifact.get("error")
+    assert mp["processes"] == 2 and mp["shards"] == 4
+    assert mp["positions"] == 694
+    assert artifact["rc_by_rank"] == [0, 0]
+    # Per-rank level times: every level row carries BOTH ranks' forward
+    # seconds (the point of the artifact — a perf trajectory per rank).
+    assert artifact["levels"], artifact
+    for row in artifact["levels"]:
+        assert set(row["fwd_secs"]) == {"0", "1"} or \
+            set(row["bwd_secs"]) == {"0", "1"}, row
+    assert set(artifact["done_by_rank"]) == {"0", "1"}
+
+
+@pytest.mark.slow
 def test_bench_demotes_to_classic_when_dense_breaks():
     # A malformed dense-only knob breaks DenseSolver's constructor; the
     # bench must demote to the classic engine on the same platform and

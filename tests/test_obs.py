@@ -442,6 +442,57 @@ def test_obs_report_cli(tmp_path, capsys):
     assert obs_report.main([str(tmp_path / "missing.jsonl")]) == 2
 
 
+@pytest.mark.smoke
+def test_obs_report_merges_rank_streams_without_double_counting(
+        tmp_path, capsys):
+    """Per-rank JSONL merge (ISSUE 6): both ranks of a 2-process run
+    time the SAME wall-clock level, so the merged table must take the
+    slowest rank, not the sum — and a retry that one rank logged first
+    still shows the consensus count (ranks agree by construction)."""
+    def rec(rank, **kw):
+        return json.dumps({"rank": rank, **kw}) + "\n"
+
+    r0 = tmp_path / "m.rank0.jsonl"
+    r1 = tmp_path / "m.rank1.jsonl"
+    r0.write_text(
+        rec(0, phase="forward", level=0, frontier=100, bytes_sorted=10,
+            secs=1.0)
+        + rec(0, phase="retry", level=0, point="sharded.forward")
+        + rec(0, phase="backward", level=0, n=100, bytes_sorted=0,
+              bytes_gathered=4, secs=0.5)
+        + rec(0, phase="done", game="x", positions=100)
+    )
+    r1.write_text(
+        rec(1, phase="forward", level=0, frontier=100, bytes_sorted=10,
+            secs=1.25)  # the slowest rank defines the level's wall-clock
+        + rec(1, phase="retry", level=0, point="sharded.forward")
+        + rec(1, phase="backward", level=0, n=100, bytes_sorted=0,
+              bytes_gathered=4, secs=0.25)
+        + rec(1, phase="done", game="x", positions=100)
+    )
+    obs_report = load_module(REPO / "tools" / "obs_report.py")
+    records = (obs_report.load_records(str(r0))
+               + obs_report.load_records(str(r1)))
+    rows = obs_report.summarize_levels(records)
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["positions"] == 100  # not 200: one level, two observers
+    assert row["fwd_secs"] == 1.25  # max across ranks, not 2.25
+    assert row["bwd_secs"] == 0.5
+    assert row["retries"] == 1      # the consensus count, not 2
+    assert row["bytes_gathered"] == 4
+    # Single-stream behavior unchanged: within one rank seconds still
+    # accumulate (a re-logged level really did run twice there).
+    alone = obs_report.summarize_levels(
+        obs_report.load_records(str(r0)))
+    assert alone[0]["fwd_secs"] == 1.0
+    # CLI accepts the whole per-rank set; done lines stay attributable.
+    assert obs_report.main([str(r0), str(r1)]) == 0
+    out = capsys.readouterr().out
+    assert "done[rank 0]: game=x" in out
+    assert "done[rank 1]: game=x" in out
+
+
 # ------------------------------------------------- server exposition (HTTP)
 
 

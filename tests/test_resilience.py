@@ -622,6 +622,215 @@ def test_chaos_watchdog_aborts_wedged_solve(tmp_path):
     assert "positions: 5478" in resumed.stdout
 
 
+# ------------------------------------ distributed chaos (ISSUE 6)
+#
+# The rank-death scenarios: under REAL 2-process execution (tools/
+# launch_multihost.py) a transient at a collective fault point must be
+# retried by ALL ranks together, a dead rank must turn into a
+# coordinated abort (every survivor exits 124 within the barrier
+# deadline, checkpoint prefix intact), and a full restart must resume
+# to byte-parity — never a hang. Fault points covered here:
+# sharded.collective (collective entry), coord.barrier (epoch-barrier
+# proposal), coord.handshake (coordinator dial).
+
+
+def _coordinated_world1_solver(game_spec, num_shards=2):
+    """A sharded solver driven through the collective-safe retry
+    protocol with a real (world-1) consensus service: every retry
+    decision is a genuine epoch round over a loopback socket, in one
+    process — the tier-1 way to exercise _retry_collective."""
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+    from gamesmanmpi_tpu.resilience.coordination import (
+        Coordination,
+        CoordinatorServer,
+        EpochBarrier,
+    )
+
+    solver = ShardedSolver(get_game(game_spec), num_shards=num_shards)
+    srv = CoordinatorServer(1, deadline=10.0)
+    solver.coord = Coordination(
+        EpochBarrier(srv.address, 0, deadline=10.0), srv
+    )
+    return solver
+
+
+def test_coordinated_retry_at_collective_point(c3_clean):
+    """A transient at sharded.collective (the collective-entry fault
+    point) resolves through a consensus round into a coordinated retry:
+    counter bumped, results oracle-exact."""
+    faults.configure("sharded.collective:transient:2")
+    result = _coordinated_world1_solver(_C3).solve()
+    assert result.stats["retries"] >= 1
+    assert full_table(result) == full_table(c3_clean)
+
+
+def test_coordinated_abort_on_fatal_at_collective_point():
+    """A fatal at the collective entry aborts through the same round —
+    fail fast, no retry loop, coordination torn down cleanly."""
+    faults.configure("sharded.collective:fatal:2")
+    solver = _coordinated_world1_solver(_C3)
+    with pytest.raises(FatalFault):
+        solver.solve()
+    assert solver.retries == 0
+
+
+def test_coordinated_abort_attribution():
+    """ABORT decisions must attribute correctly: a rank whose own
+    verdict was ABORT fails fast with ITS error; a rank that proposed
+    retry (or was healthy) aborts because of a PEER and must raise
+    CoordinatedAbort — the exception the CLI maps to exit 124 — never
+    its own retryable error as if the fleet had refused a retry."""
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+    from gamesmanmpi_tpu.resilience.coordination import (
+        ABORT,
+        RETRY,
+        CoordinatedAbort,
+    )
+
+    solver = ShardedSolver(get_game(_C3), num_shards=2)
+    fatal = FatalFault("own fatal")
+    with pytest.raises(FatalFault):
+        solver._coordinated_abort("sharded.forward", 3, fatal, ABORT)
+    flaky = TransientFault("flaky link")
+    with pytest.raises(CoordinatedAbort) as ei:
+        solver._coordinated_abort("sharded.forward", 3, flaky, RETRY)
+    assert ei.value.__cause__ is flaky
+    assert "proposed retry" in str(ei.value)
+    with pytest.raises(CoordinatedAbort, match="healthy"):
+        solver._coordinated_abort("sharded.forward", 3, None, ABORT)
+
+
+def _launch_world(args, tmp, per_rank_env=None, env=None, timeout=240):
+    from tools import launch_multihost
+
+    return launch_multihost.launch(
+        list(args), processes=2, timeout=timeout, log_dir=str(tmp),
+        per_rank_env=per_rank_env, env=env,
+    )
+
+
+_NO_BACKEND = "Multiprocess computations aren't implemented"
+
+
+def _skip_unless_world_spawned(ranks):
+    if any(r.returncode != 0 and _NO_BACKEND in r.stderr for r in ranks):
+        pytest.skip("backend cannot run multiprocess collectives "
+                    "(no CPU Gloo) — the harness cannot spawn a world")
+
+
+@pytest.mark.slow
+def test_chaos_rank_death_coordinated_abort_and_resume(tmp_path):
+    """THE rank-death acceptance scenario: SIGKILL one rank mid-level on
+    a 2-process sharded connect4 solve. The survivor must abort within
+    the barrier deadline (exit 124, not a harness kill), the checkpoint
+    prefix must stay intact, and a full restart must resume to
+    byte-parity with an uninterrupted solve."""
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+
+    ck = tmp_path / "ck"
+    t0 = time.monotonic()
+    ranks = _launch_world(
+        [_C4, "--devices", "4", "--checkpoint-dir", str(ck)],
+        tmp_path,
+        env={"GAMESMAN_BARRIER_SECS": "10",
+             "GAMESMAN_COLLECTIVE_TIMEOUT": "60"},
+        per_rank_env={1: {"GAMESMAN_FAULTS": "sharded.forward:kill:3"}},
+        timeout=150,
+    )
+    elapsed = time.monotonic() - t0
+    _skip_unless_world_spawned(ranks)
+    by = {r.rank: r for r in ranks}
+    assert by[1].returncode == faults.KILL_EXIT_CODE, (
+        by[1].returncode, by[1].stderr[-2000:]
+    )
+    # The survivor exited THROUGH the coordinated-abort contract — 124
+    # within the deadline — not None (a straggler the harness killed).
+    assert by[0].returncode == 124, (
+        by[0].returncode, by[0].stderr[-2000:]
+    )
+    assert elapsed < 150, "survivor did not abort within the deadline"
+    assert "coordinated abort" in by[0].stderr.lower()
+    # Prefix intact: whatever sealed before the death loads clean.
+    ck_obj = LevelCheckpointer(ck)
+    for k in ck_obj.completed_levels():
+        ck_obj.load_level(k)
+    # Full restart reaches byte-parity with an uninterrupted 4-shard run.
+    ranks2 = _launch_world(
+        [_C4, "--devices", "4", "--checkpoint-dir", str(ck),
+         "--table-out", str(tmp_path / "resumed.npz")],
+        tmp_path,
+    )
+    for r in ranks2:
+        assert r.returncode == 0, (r.rank, r.stderr[-2000:])
+    golden = tmp_path / "golden.npz"
+    save_result_npz(
+        golden, ShardedSolver(get_game(_C4), num_shards=4).solve()
+    )
+    _assert_tables_equal(tmp_path / "resumed.rank0.npz", golden)
+
+
+@pytest.mark.slow
+def test_chaos_transient_on_one_rank_retries_on_all_ranks(tmp_path):
+    """Acceptance: a transient injected at the collective fault point on
+    ONE rank is retried consistently on all ranks — the solve completes
+    and gamesman_retries_total agrees across ranks."""
+    ranks = _launch_world(
+        [_C3, "--devices", "4",
+         "--metrics-out", str(tmp_path / "metrics.json")],
+        tmp_path,
+        env={"GAMESMAN_BARRIER_SECS": "20"},
+        per_rank_env={1: {"GAMESMAN_FAULTS": "sharded.collective:transient:2"}},
+    )
+    _skip_unless_world_spawned(ranks)
+    for r in ranks:
+        assert r.returncode == 0, (r.rank, r.stderr[-2000:])
+        assert "value: TIE" in r.stdout and "remoteness: 9" in r.stdout
+    retries = []
+    for rank in range(2):
+        snap = json.loads(
+            (tmp_path / f"metrics.rank{rank}.json").read_text()
+        )
+        rows = snap["gamesman_retries_total"]["values"]
+        assert all(row["labels"]["rank"] == str(rank) for row in rows)
+        retries.append(sum(int(row["value"]) for row in rows))
+    # The faulted rank AND the healthy rank absorbed the same retry —
+    # the whole point of the consensus round.
+    assert retries[0] == retries[1] >= 1, retries
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "spec", ["coord.handshake:kill:1", "coord.barrier:kill:2"]
+)
+def test_chaos_rank_death_at_coordination_points(spec, tmp_path):
+    """A rank dying INSIDE the coordination layer itself (dialing the
+    coordinator; at an epoch-barrier proposal) still resolves into a
+    coordinated abort of the survivors within the deadline, and a clean
+    restart completes."""
+    ck = tmp_path / "ck"
+    ranks = _launch_world(
+        [_C3, "--devices", "4", "--checkpoint-dir", str(ck)],
+        tmp_path,
+        env={"GAMESMAN_BARRIER_SECS": "8"},
+        per_rank_env={1: {"GAMESMAN_FAULTS": spec}},
+        timeout=150,
+    )
+    _skip_unless_world_spawned(ranks)
+    by = {r.rank: r for r in ranks}
+    assert by[1].returncode == faults.KILL_EXIT_CODE, (
+        by[1].returncode, by[1].stderr[-2000:]
+    )
+    assert by[0].returncode == 124, (
+        by[0].returncode, by[0].stderr[-2000:]
+    )
+    ranks2 = _launch_world(
+        [_C3, "--devices", "4", "--checkpoint-dir", str(ck)], tmp_path
+    )
+    for r in ranks2:
+        assert r.returncode == 0, (r.rank, r.stderr[-2000:])
+        assert "value: TIE" in r.stdout
+
+
 @pytest.mark.slow
 def test_serve_sigterm_drains_gracefully(tmp_path):
     """`cli serve` under SIGTERM: drains (stderr says so) and exits 0

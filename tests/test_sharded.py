@@ -178,14 +178,15 @@ def test_sharded_window_streaming_composes_with_blocked_backward(
     assert full_table(result) == full_table(single)
 
 
-def test_multihost_host_spill_snapshot_refused(monkeypatch):
-    """Under multi-host execution a host-spilled level cannot be attributed
-    to per-shard writers; the frontier snapshot must refuse loudly instead
-    of writing racy files."""
+def test_multihost_host_spill_snapshot_owner_writes(monkeypatch):
+    """Host-resident level under multi-process execution (ISSUE 6): every
+    rank holds the full copy (gather collective), so write-ownership
+    follows the mesh — the rank owning the shard's device writes its
+    file, every other rank defers. Previously this path refused outright;
+    now one writer per shard, no racy duplicate snapshot files."""
     import numpy as np
 
-    from gamesmanmpi_tpu.parallel.sharded import _SLevel, SolverError
-    from gamesmanmpi_tpu.parallel import sharded as sh
+    from gamesmanmpi_tpu.parallel.sharded import _SLevel
 
     solver = ShardedSolver(get_game("nim:heaps=2-3"), num_shards=2)
     rec = _SLevel(
@@ -193,9 +194,15 @@ def test_multihost_host_spill_snapshot_refused(monkeypatch):
         None,
         [np.array([3], dtype=np.uint32), np.empty(0, dtype=np.uint32)],
     )
-    monkeypatch.setattr(sh.jax, "process_count", lambda: 2)
-    with pytest.raises(SolverError, match="multi-host"):
-        solver._shard_rows(rec, 0)
+    solver.num_processes = 2
+    # This single-host mesh owns every shard (process_index 0 on all
+    # devices): the owning rank writes the rows...
+    assert solver._shard_ranks() == [0, 0]
+    assert solver.rank == 0
+    assert list(solver._shard_rows(rec, 0)) == [3]
+    # ...and a non-owning rank defers instead of writing a duplicate.
+    solver.rank = 1
+    assert solver._shard_rows(rec, 0) is None
 
 
 def test_multihost_manifest_seal_gated_to_process_zero(monkeypatch, tmp_path):

@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Real multi-process launcher: N OS processes, one jax.distributed world.
+
+The reference runs ``mpirun -np N python solver_launcher.py game.py``;
+this is that launcher for the JAX rebuild. It spawns N copies of the
+stock solve CLI, wires the process group through the ENVIRONMENT
+(``GAMESMAN_COORDINATOR`` / ``GAMESMAN_NUM_PROCESSES`` /
+``GAMESMAN_PROCESS_ID`` — the CLI's ``init_distributed`` env fallback,
+so children need no extra argv), enables CPU Gloo collectives via the
+same path, and points every rank at the retry-consensus coordinator
+(``GAMESMAN_COORD_ADDR``, rank 0 hosts it). Per-rank stdout/stderr go
+to files — the children are coupled by cross-process collectives, so
+blocking on one rank's unread pipe can stall the whole world and turn
+any verbose failure into a bare timeout.
+
+CLI::
+
+    python tools/launch_multihost.py [--processes N] [--timeout S]
+        [--log-dir DIR] -- connect4:w=3,h=3,connect=3 --devices 4 ...
+
+Library (tests/test_multihost.py, bench.py)::
+
+    from tools.launch_multihost import launch
+    ranks = launch(["nim:heaps=2-3-4", "--devices", "4"], processes=2)
+    for r in ranks: assert r.returncode == 0
+
+Per-rank chaos: ``per_rank_env={1: {"GAMESMAN_FAULTS": "...:kill:2"}}``
+arms a fault on ONE rank only — the rank-death scenarios of
+tests/test_resilience.py. The equivalent env spelling
+``GAMESMAN_FAULTS_RANK_<i>`` is honored for shell-driven chaos runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # tools/ scripts get sys.path[0]=tools/
+    sys.path.insert(0, REPO)
+
+#: Local (fake) CPU devices per process: 2 keeps the global mesh
+#: genuinely multi-device AND multi-process at the smallest cost.
+DEFAULT_LOCAL_DEVICES = 2
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class RankResult:
+    rank: int
+    returncode: Optional[int]  # None = still running when harness gave up
+    stdout: str
+    stderr: str
+
+
+def _child_env(base: dict, rank: int, processes: int, coordinator: str,
+               coord_addr: str, local_devices: int,
+               per_rank: Optional[dict]) -> dict:
+    env = dict(base)
+    # The invoking suite's own fake-device flag must NOT leak: each child
+    # fakes exactly `local_devices` CPU devices so the global mesh spans
+    # processes.
+    env.pop("XLA_FLAGS", None)
+    env.setdefault("GAMESMAN_PLATFORM", "cpu")
+    env["GAMESMAN_FAKE_DEVICES"] = str(local_devices)
+    env["GAMESMAN_COORDINATOR"] = coordinator
+    env["GAMESMAN_NUM_PROCESSES"] = str(processes)
+    env["GAMESMAN_PROCESS_ID"] = str(rank)
+    env["GAMESMAN_COORD_ADDR"] = coord_addr
+    # GAMESMAN_FAULTS_RANK_<i> -> GAMESMAN_FAULTS for exactly rank i
+    # (a fleet-wide GAMESMAN_FAULTS in the parent env would arm every
+    # rank identically — almost never what a rank-death scenario wants).
+    env.pop("GAMESMAN_FAULTS", None)
+    ranked = base.get(f"GAMESMAN_FAULTS_RANK_{rank}")
+    if ranked:
+        env["GAMESMAN_FAULTS"] = ranked
+    for k in list(env):
+        if k.startswith("GAMESMAN_FAULTS_RANK_"):
+            env.pop(k)
+    if per_rank:
+        env.update({k: str(v) for k, v in per_rank.items()})
+    return env
+
+
+def launch(solver_args: Sequence[str], *, processes: int = 2,
+           timeout: float = 240.0, log_dir: Optional[str] = None,
+           local_devices: int = DEFAULT_LOCAL_DEVICES,
+           coordinator: Optional[str] = None,
+           env: Optional[dict] = None,
+           per_rank_env: Optional[Dict[int, dict]] = None,
+           ) -> List[RankResult]:
+    """Run ``solve_launcher.py solver_args...`` as `processes` ranks.
+
+    Blocks until every rank exits or `timeout` seconds pass, then kills
+    stragglers (their returncode reports None — the caller decides
+    whether a straggler is a failure or the scenario under test).
+    """
+    base = dict(os.environ)
+    if env:
+        base.update({k: str(v) for k, v in env.items()})
+    if coordinator is None:
+        coordinator = base.get("GAMESMAN_COORDINATOR") or \
+            f"127.0.0.1:{free_port()}"
+    host, _, port = coordinator.rpartition(":")
+    coord_addr = base.get("GAMESMAN_COORD_ADDR") or \
+        f"{host or '127.0.0.1'}:{free_port()}"
+    log_dir = log_dir or "/tmp"
+    os.makedirs(log_dir, exist_ok=True)
+    tag = port
+    procs, files = [], []
+    for rank in range(processes):
+        out_f = open(os.path.join(log_dir, f"rank{rank}_{tag}.out"), "w+")
+        err_f = open(os.path.join(log_dir, f"rank{rank}_{tag}.err"), "w+")
+        files.append((out_f, err_f))
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "solve_launcher.py"),
+             *solver_args],
+            cwd=REPO,
+            env=_child_env(base, rank, processes, coordinator, coord_addr,
+                           local_devices,
+                           (per_rank_env or {}).get(rank)),
+            stdout=out_f, stderr=err_f,
+        ))
+    deadline = time.monotonic() + timeout
+    results: List[RankResult] = []
+    for rank, (p, (out_f, err_f)) in enumerate(zip(procs, files)):
+        rc: Optional[int] = None
+        try:
+            rc = p.wait(timeout=max(0.1, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        out_f.seek(0)
+        err_f.seek(0)
+        results.append(RankResult(rank, rc, out_f.read(), err_f.read()))
+        out_f.close()
+        err_f.close()
+    return results
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Spawn an N-process jax.distributed CPU solve "
+        "(docs/DISTRIBUTED.md). Everything after -- goes to the solve "
+        "CLI verbatim.",
+    )
+    p.add_argument("--processes", type=int, default=None,
+                   help="world size (env GAMESMAN_NUM_PROCESSES; "
+                   "default 2)")
+    p.add_argument("--timeout", type=float, default=600.0,
+                   help="harness deadline: stragglers are killed after "
+                   "this many seconds")
+    p.add_argument("--log-dir", default=None,
+                   help="directory for per-rank stdout/stderr files "
+                   "(default /tmp)")
+    p.add_argument("--local-devices", type=int,
+                   default=DEFAULT_LOCAL_DEVICES,
+                   help="fake CPU devices per rank (the mesh is "
+                   "processes x this)")
+    p.add_argument("solver_args", nargs=argparse.REMAINDER,
+                   help="-- then the solve CLI's arguments")
+    args = p.parse_args(argv)
+    solver_args = [a for a in args.solver_args if a != "--"] or None
+    if not solver_args:
+        p.error("no solver arguments (put them after --)")
+    from gamesmanmpi_tpu.utils.env import env_int
+
+    processes = (args.processes if args.processes is not None
+                 else env_int("GAMESMAN_NUM_PROCESSES", 2))
+    results = launch(
+        solver_args, processes=processes, timeout=args.timeout,
+        log_dir=args.log_dir, local_devices=args.local_devices,
+    )
+    worst = 0
+    for r in results:
+        rc = "killed" if r.returncode is None else r.returncode
+        print(f"--- rank {r.rank}: rc={rc} ---")
+        sys.stdout.write(r.stdout)
+        if r.returncode != 0:
+            sys.stderr.write(r.stderr[-4000:])
+        worst = worst or (124 if r.returncode is None else r.returncode)
+    return worst
+
+
+if __name__ == "__main__":
+    sys.exit(main())

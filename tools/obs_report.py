@@ -19,6 +19,19 @@ Works on any stream the engine writes (classic, sharded, dense all share
 the phase/level/secs schema); serve_batch / heartbeat records are
 counted and reported but excluded from the level table. No third-party
 deps — stdlib only, CI-runnable (see tests/test_obs.py).
+
+Multi-process runs write one rank-stamped stream per rank
+(``m.rank0.jsonl``, ``m.rank1.jsonl`` — utils/metrics.RankLogger); pass
+them all and the tool merges WITHOUT double-counting level times:
+
+    python tools/obs_report.py m.rank*.jsonl
+
+Each rank times the same wall-clock level (the step is a collective),
+so within a rank seconds accumulate (a retried level really did run
+twice) and across ranks the per-level figures take the slowest rank —
+summing two ranks' timings of one level would report a 2-process solve
+as twice as slow as it was. Rank-less records (single-process streams)
+keep the pure accumulate behavior.
 """
 
 from __future__ import annotations
@@ -46,9 +59,21 @@ def load_records(path: str) -> list[dict]:
 
 def summarize_levels(records: list[dict]) -> list[dict]:
     """Fold forward/backward records into one row per level, sorted by
-    level. Repeated records for a level (sharded runs emit one per
-    process; retries re-log) accumulate seconds and keep the latest
-    sizes."""
+    level. Within one rank's stream repeated records for a level
+    (retries re-log) accumulate seconds and keep the latest sizes;
+    across ranks every figure takes the slowest rank (`_merge_ranks`) —
+    the level ran ONCE in wall-clock, collectively."""
+    by_rank: dict = {}
+    for rec in records:
+        by_rank.setdefault(rec.get("rank"), []).append(rec)
+    if len(by_rank) > 1 or (by_rank and None not in by_rank):
+        return _merge_ranks({
+            rank: _fold_one_rank(recs) for rank, recs in by_rank.items()
+        })
+    return _fold_one_rank(records)
+
+
+def _fold_one_rank(records: list[dict]) -> list[dict]:
     levels: dict[int, dict] = {}
     for rec in records:
         phase = rec.get("phase")
@@ -95,6 +120,26 @@ def summarize_levels(records: list[dict]) -> list[dict]:
     return [levels[k] for k in sorted(levels)]
 
 
+def _merge_ranks(per_rank: dict) -> list[dict]:
+    """Merge per-rank level tables into one wall-clock view: for every
+    level take each column's MAX across the ranks that timed it.
+
+    Max, not sum — N ranks timing one collective level is one level, and
+    summing would report an N-process solve as N times slower than it
+    was. Max, not rank 0's value — a retrying rank accumulates real
+    extra seconds, and the retries criterion is that the counter AGREES
+    across ranks, so the max is also the consensus value (a discrepancy
+    shows up as the larger figure, never hidden)."""
+    merged: dict[int, dict] = {}
+    for rows in per_rank.values():
+        for r in rows:
+            row = merged.setdefault(r["level"], dict(r))
+            for k, v in r.items():
+                if k != "level":
+                    row[k] = max(row[k], v)
+    return [merged[k] for k in sorted(merged)]
+
+
 def format_table(rows: list[dict]) -> str:
     header = (
         f"{'level':>5}  {'positions':>10}  {'fwd_s':>8}  {'bwd_s':>8}  "
@@ -137,8 +182,10 @@ def report(records: list[dict]) -> str:
         if rec.get("phase") == "done":
             keys = ("game", "positions", "levels", "secs_forward",
                     "secs_backward", "secs_total", "positions_per_sec")
+            label = ("done" if rec.get("rank") is None
+                     else f"done[rank {rec['rank']}]")
             out.append(
-                "done: " + " ".join(
+                f"{label}: " + " ".join(
                     f"{k}={rec[k]:.3f}" if isinstance(rec.get(k), float)
                     else f"{k}={rec.get(k)}"
                     for k in keys if k in rec
@@ -168,10 +215,13 @@ def main(argv=None) -> int:
         description="Per-level time/volume table from a --jsonl metrics "
         "file (docs/OBSERVABILITY.md)."
     )
-    p.add_argument("jsonl", help="metrics file written by --jsonl")
+    p.add_argument("jsonl", nargs="+",
+                   help="metrics file(s) written by --jsonl; pass every "
+                   "per-rank file of a multi-process run and level times "
+                   "merge wall-clock (max across ranks, not sum)")
     args = p.parse_args(argv)
     try:
-        records = load_records(args.jsonl)
+        records = [r for path in args.jsonl for r in load_records(path)]
     except OSError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
