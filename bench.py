@@ -263,6 +263,201 @@ def _multiprocess_smoke() -> dict | None:
     return artifact
 
 
+def _serve_bench() -> dict | None:
+    """BENCH_SERVE=1: the serving-fleet SLO benchmark (ROADMAP item 3).
+
+    Exports a DB (child process), launches the supervised fleet
+    (`cli serve --workers N`), drives concurrent query load through
+    tools/load_gen for BENCH_SERVE_SECS, SIGKILLs one worker mid-load
+    (BENCH_SERVE_CHAOS=0 disables), and gates on the latency SLO:
+    p99-under-load <= BENCH_SERVE_SLO_P99_MS with zero dropped requests
+    beyond the killed worker's in-flight budget and zero answer
+    mismatches. The full record lands in BENCH_SERVE_OUT
+    (BENCH_serve.json) — the p99-under-load trajectory next to the
+    solve-throughput BENCH_*.json one.
+
+    Runs in the PARENT (jax-free: load_gen is stdlib-only and the DB
+    positions are read with plain numpy) and must never kill the bench:
+    failures are recorded in the artifact, not raised.
+    """
+    if os.environ.get("BENCH_SERVE", "0") in ("0", "", "off"):
+        return None
+    import signal
+    import tempfile
+    import threading
+    import urllib.request
+
+    from tools.load_gen import run_load
+
+    spec = os.environ.get("BENCH_SERVE_GAME", "connect4:w=4,h=4")
+    workers = int(_env_float("BENCH_SERVE_WORKERS", 2))
+    duration = _env_float("BENCH_SERVE_SECS", 10.0)
+    conc = int(_env_float("BENCH_SERVE_CONC", 8))
+    slo_ms = _env_float("BENCH_SERVE_SLO_P99_MS", 250.0)
+    chaos = os.environ.get("BENCH_SERVE_CHAOS", "1") not in ("0", "off")
+    out_path = os.environ.get("BENCH_SERVE_OUT", "BENCH_serve.json")
+    deadline = _env_float("GAMESMAN_BENCH_DEADLINE", 3000.0)
+    artifact = {
+        "game": spec, "workers": workers, "concurrency": conc,
+        "slo_p99_ms": slo_ms, "chaos": chaos, "ok": False,
+    }
+
+    def _get_json(url):
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    proc = None
+    t0 = time.perf_counter()
+    try:
+        with tempfile.TemporaryDirectory(prefix="bench_serve_") as td:
+            db = os.path.join(td, "db")
+            export = subprocess.run(
+                [sys.executable, "-m", "gamesmanmpi_tpu.cli", "export-db",
+                 spec, "--out", db],
+                timeout=deadline, capture_output=True, text=True,
+            )
+            if export.returncode != 0:
+                artifact["error"] = "export-db failed: " \
+                    + export.stderr[-1000:]
+                return artifact
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "gamesmanmpi_tpu.cli", "serve", db,
+                 "--port", "0", "--workers", str(workers),
+                 "--control-port", "0"],
+                stdout=subprocess.PIPE, text=True,
+            )
+            # Bounded banner read: a supervisor that wedges before its
+            # banner must fail the bench into the artifact, not hang it
+            # (every other wait here is deadline-bounded too).
+            got: list = []
+            t = threading.Thread(
+                target=lambda: got.append(proc.stdout.readline()),
+                daemon=True,
+            )
+            t.start()
+            t.join(120.0)
+            if not got or not got[0]:
+                artifact["error"] = "fleet supervisor printed no banner"
+                return artifact
+            banner = got[0]
+            port = int(banner.split("http://127.0.0.1:")[1].split(" ")[0])
+            cport = int(banner.split("http://127.0.0.1:")[2].split(" ")[0])
+            control = f"http://127.0.0.1:{cport}"
+            ready_deadline = time.monotonic() + 180.0
+            status = {}
+            while time.monotonic() < ready_deadline:
+                status = _get_json(control + "/healthz")
+                if status.get("status") == "ok":
+                    break
+                time.sleep(0.25)
+            if status.get("status") != "ok":
+                artifact["error"] = f"fleet never became ready: {status}"
+                return artifact
+            artifact["spawn_mode"] = status.get("spawn_mode")
+            positions = _db_sample_positions(db)
+            killed = {}
+
+            def _chaos():
+                try:
+                    time.sleep(max(0.5, min(duration / 2, duration - 1)))
+                    st = _get_json(control + "/healthz")
+                    for idx, w in st.get("workers", {}).items():
+                        if w.get("state") == "ready" and w.get("pid"):
+                            killed["worker"] = idx
+                            killed["pid"] = w["pid"]
+                            killed["at"] = time.monotonic()
+                            os.kill(w["pid"], signal.SIGKILL)
+                            return
+                    killed["error"] = "no ready worker to kill"
+                except Exception as e:  # noqa: BLE001 - recorded below
+                    killed["error"] = f"{type(e).__name__}: {e}"
+
+            if chaos:
+                threading.Thread(target=_chaos, daemon=True).start()
+            load = run_load(
+                f"http://127.0.0.1:{port}", positions,
+                duration=duration, concurrency=conc,
+            )
+            load.pop("answers", None)
+            artifact.update(load)
+            if chaos and "pid" not in killed:
+                # The kill never fired: say WHY the chaos gate fails
+                # instead of an unexplained ok=False.
+                artifact["error"] = "chaos kill did not fire: " + \
+                    killed.get("error", "kill thread never ran")
+            if chaos and killed.get("pid"):
+                recover_deadline = time.monotonic() + 60.0
+                recovered = None
+                while time.monotonic() < recover_deadline:
+                    st = _get_json(control + "/healthz")
+                    w = st["workers"].get(killed["worker"], {})
+                    if w.get("state") == "ready" \
+                            and w.get("pid") != killed["pid"]:
+                        recovered = time.monotonic() - killed["at"]
+                        break
+                    time.sleep(0.2)
+                st = _get_json(control + "/healthz")
+                artifact["worker_restarts"] = sum(
+                    w.get("restarts", 0)
+                    for w in st.get("workers", {}).values()
+                )
+                artifact["killed_worker"] = killed["worker"]
+                artifact["recovered_secs"] = (
+                    None if recovered is None else round(recovered, 2)
+                )
+            artifact["slo_ok"] = artifact.get("p99_ms", 1e9) <= slo_ms
+            # The shed budget: a SIGKILLed worker may drop its in-flight
+            # requests (at most the client concurrency) — more dropped
+            # than that means requests failed that chaos cannot excuse.
+            artifact["drop_budget"] = conc if chaos else 0
+            artifact["ok"] = bool(
+                artifact["slo_ok"]
+                and artifact.get("mismatches", 1) == 0
+                and artifact.get("errors", 1) == 0
+                and artifact.get("dropped", 0) <= artifact["drop_budget"]
+                and (not chaos or artifact.get("recovered_secs") is not None)
+            )
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=60)
+            proc = None
+    except Exception as e:  # noqa: BLE001 - the bench must survive this
+        artifact["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        artifact.setdefault("secs_wall", round(time.perf_counter() - t0, 3))
+        try:
+            with open(out_path, "w") as fh:
+                json.dump(artifact, fh, indent=1)
+            print(f"serve bench: wrote {out_path} (ok={artifact['ok']})",
+                  file=sys.stderr)
+        except OSError as e:
+            print(f"serve bench: cannot write {out_path}: {e}",
+                  file=sys.stderr)
+    return artifact
+
+
+def _db_sample_positions(db: str, per_level: int = 64,
+                         cap: int = 512) -> list:
+    """Sample query positions straight off the DB's key files (plain
+    numpy mmap reads — no DbReader, no jax: this runs in the parent)."""
+    import glob
+
+    import numpy as np
+
+    positions: list = []
+    for path in sorted(glob.glob(os.path.join(db, "level_*.keys.npy"))):
+        keys = np.load(path, mmap_mode="r")
+        n = int(keys.shape[0])
+        step = max(1, n // per_level)
+        positions.extend(int(k) for k in keys[::step][:per_level])
+    if len(positions) > cap:
+        step = len(positions) // cap
+        positions = positions[::step][:cap]
+    return positions
+
+
 def main() -> int:
     # The parent never touches jax — platform selection (GAMESMAN_PLATFORM)
     # is honored by the probe and measurement children, which inherit the
@@ -328,6 +523,18 @@ def main() -> int:
             ("processes", "shards", "ok", "positions",
              "positions_per_sec", "secs_wall", "error")
             if k in mp
+        }
+    sv = _serve_bench()
+    if sv is not None:
+        # Summary only — the full load/chaos record lives in the
+        # artifact file (BENCH_SERVE_OUT); the one-line record stays
+        # one line.
+        record["serve"] = {
+            k: sv.get(k) for k in
+            ("workers", "concurrency", "ok", "slo_ok", "qps",
+             "p50_ms", "p99_ms", "shed", "dropped", "mismatches",
+             "worker_restarts", "recovered_secs", "error")
+            if k in sv
         }
     print(json.dumps(record))
     return 0

@@ -44,7 +44,7 @@ from gamesmanmpi_tpu.analysis.project import (
 #: Helper callables whose first argument is an env-var name.
 ENV_HELPERS = {
     "env_int", "env_float", "env_int_strict", "env_str", "env_opt",
-    "platform_auto_flag", "platform_auto_bool",
+    "env_bool", "platform_auto_flag", "platform_auto_bool",
 }
 
 #: Files allowed to touch os.environ directly: the helper home and the

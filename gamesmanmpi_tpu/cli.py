@@ -799,10 +799,42 @@ def _db_parser() -> argparse.ArgumentParser:
     ps = sub.add_parser(
         "serve", help="serve POST /query, GET /healthz, GET /metrics"
     )
-    ps.add_argument("db", help="DB directory (from export-db)")
+    ps.add_argument("db", nargs="?", default=None,
+                    help="DB directory (from export-db); omit when "
+                    "--fleet-manifest names the DBs")
     ps.add_argument("--host", default="127.0.0.1")
     ps.add_argument("--port", type=int, default=8947,
                     help="0 = ephemeral (the bound port is printed)")
+    ps.add_argument(
+        "--workers",
+        type=int,
+        default=None,
+        metavar="N",
+        help="fleet mode: supervise N worker processes sharing this "
+        "port's accept queue (forked after DbReader open — mmap pages "
+        "shared; heartbeat liveness, backoff restart, rolling reload; "
+        "docs/SERVING.md). 0/unset = single in-process server (env "
+        "GAMESMAN_SERVE_WORKERS)",
+    )
+    ps.add_argument(
+        "--fleet-manifest",
+        default=None,
+        metavar="FILE",
+        help="route multiple game DBs from one fleet manifest JSON "
+        '({"version": 1, "games": [{"name": ..., "db": ...}]}); '
+        "POST /query/<name> selects the game. Implies fleet mode; "
+        "SIGHUP or POST /reload on the control port rolls the fleet "
+        "onto a re-read manifest",
+    )
+    ps.add_argument(
+        "--control-port",
+        type=int,
+        default=0,
+        metavar="P",
+        help="fleet mode: supervisor control endpoint port (fleet-level "
+        "GET /healthz aggregating per-worker state, GET /metrics, "
+        "POST /reload); 0 = ephemeral (printed in the banner)",
+    )
     ps.add_argument(
         "--batch-window-ms",
         type=float,
@@ -960,7 +992,18 @@ def _cmd_serve(args) -> int:
 
     from gamesmanmpi_tpu.db import DbFormatError, DbReader
     from gamesmanmpi_tpu.serve import QueryServer
+    from gamesmanmpi_tpu.utils.env import env_int
 
+    workers = (
+        env_int("GAMESMAN_SERVE_WORKERS", 0)
+        if args.workers is None else args.workers
+    )
+    if args.db is None and not args.fleet_manifest:
+        print("error: serve needs a DB directory (or --fleet-manifest)",
+              file=sys.stderr)
+        return 2
+    if workers > 0 or args.fleet_manifest:
+        return _cmd_serve_fleet(args, max(1, workers))
     try:
         reader = DbReader(args.db)
     except DbFormatError as e:
@@ -1021,6 +1064,95 @@ def _cmd_serve(args) -> int:
             for sig, handler in previous.items():
                 signal.signal(sig, handler)
     return 0
+
+
+def _cmd_serve_fleet(args, workers: int) -> int:
+    """`serve --workers N [--fleet-manifest F]`: the supervised
+    multi-worker fleet (docs/SERVING.md "Fleet serving").
+
+    The supervisor binds the socket, opens every DB's reader, then
+    forks the workers — this parent deliberately never touches a jax
+    backend, which is what keeps the fork spawn path legal (see
+    serve/supervisor.ServeSupervisor._use_fork). SIGTERM/SIGINT drain
+    the whole fleet; SIGHUP rolls it onto a re-read manifest.
+    """
+    import signal
+
+    from gamesmanmpi_tpu.db import DbFormatError
+    from gamesmanmpi_tpu.serve import (
+        ServeSupervisor,
+        load_fleet_manifest,
+        single_db_entries,
+    )
+
+    if args.fleet_manifest and args.db:
+        print("error: pass a DB directory or --fleet-manifest, not both",
+              file=sys.stderr)
+        return 2
+    logger = _build_logger(args)
+    with _logger_scope(logger):
+        try:
+            entries = (
+                load_fleet_manifest(args.fleet_manifest)
+                if args.fleet_manifest else single_db_entries(args.db)
+            )
+            supervisor = ServeSupervisor(
+                entries,
+                workers=workers,
+                host=args.host,
+                port=args.port,
+                control_port=args.control_port,
+                manifest_path=args.fleet_manifest,
+                server_config={
+                    "window": args.batch_window_ms / 1e3,
+                    "cache_size": args.cache_size,
+                    "max_queue": args.max_queue,
+                    "request_timeout": (
+                        args.request_timeout_ms / 1e3
+                        if args.request_timeout_ms is not None else None
+                    ),
+                },
+                jsonl=args.jsonl,
+                logger=logger,
+            )
+        except (ValueError, DbFormatError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        except OSError as e:  # port in use / unbindable host
+            print(
+                f"error: cannot bind {args.host}:{args.port} ({e})",
+                file=sys.stderr,
+            )
+            return 2
+        games = ", ".join(e.name or "default" for e in entries)
+        print(
+            f"serving fleet [{games}] on "
+            f"http://{args.host}:{supervisor.port} with {workers} "
+            f"worker(s) "
+            f"(control http://{args.host}:{supervisor.control_port} — "
+            "GET /healthz, GET /metrics, POST /reload)",
+            flush=True,  # a harness tailing the pipe needs the banner NOW
+        )
+        previous = {}
+
+        def _on_stop(signum, frame):
+            supervisor.request_stop()
+
+        def _on_hup(signum, frame):
+            supervisor.request_reload()
+
+        for sig, handler in ((signal.SIGINT, _on_stop),
+                             (signal.SIGTERM, _on_stop),
+                             (signal.SIGHUP, _on_hup)):
+            try:
+                previous[sig] = signal.signal(sig, handler)
+            except ValueError:  # not the main thread (programmatic use)
+                pass
+        try:
+            return supervisor.run()
+        finally:
+            for sig, handler in previous.items():
+                signal.signal(sig, handler)
 
 
 def _cmd_query(args) -> int:

@@ -21,6 +21,8 @@ import pathlib
 
 import numpy as np
 
+from gamesmanmpi_tpu.utils.env import env_bool
+
 from gamesmanmpi_tpu.core.bitops import sentinel_for
 from gamesmanmpi_tpu.core.codec import unpack_cells_np
 from gamesmanmpi_tpu.core.values import UNDECIDED
@@ -104,3 +106,28 @@ def check_db(directory, verbose=None) -> list[str]:
             f"manifest num_positions {declared} != shard total {total}"
         )
     return problems
+
+
+def verify_for_serving(directory, verbose=None) -> bool:
+    """Warm-start gate: the full :func:`check_db` pass a serving worker
+    runs before it joins the ready set (ROADMAP: "warm replica start
+    verified by check_db").
+
+    Returns True when the DB was checked clean, False when verification
+    is switched off (``GAMESMAN_SERVE_VERIFY=0`` — read-heavy restarts
+    on trusted storage, where re-hashing a multi-GB DB per worker spawn
+    is the wrong trade). Raises :class:`DbFormatError` on any problem:
+    a worker must never start answering from a DB it cannot prove
+    intact — the supervisor treats the failed spawn like any other
+    worker death (backoff, storm breaker), so one rotted replica
+    degrades to a restart loop instead of serving corrupt values.
+    """
+    if not env_bool("GAMESMAN_SERVE_VERIFY", True):
+        return False
+    problems = check_db(directory, verbose=verbose)
+    if problems:
+        raise DbFormatError(
+            f"{directory}: serving verification failed: {problems[0]}"
+            + (f" (+{len(problems) - 1} more)" if len(problems) > 1 else "")
+        )
+    return True

@@ -89,6 +89,12 @@ KNOWN_POINTS = {
     "ckpt.load_level": "checkpoint: at the top of a resume level load",
     "db.probe": "DbReader: at the top of every batched level probe",
     "serve.flush": "Batcher worker: before the coalesced reader probe",
+    "serve.worker_spawn": "fleet worker: at process start, before the "
+                          "warm-start verify/self-probe gate",
+    "serve.heartbeat": "fleet worker: each heartbeat-pipe beat (a delay "
+                       "here is a liveness stall the supervisor kills)",
+    "serve.reload": "supervisor: at the top of a rolling manifest "
+                    "reload, before any worker is drained",
 }
 
 

@@ -1,9 +1,13 @@
-"""serve: batched HTTP query serving over a solved-position database.
+"""serve: batched HTTP query serving over solved-position databases.
 
 The traffic-facing half of the ROADMAP north star: `db/` makes a solve
 persistent, this package makes it servable — a stdlib ThreadingHTTPServer
 whose concurrent requests coalesce through a micro-batching queue (with
-an LRU hot-position cache) into single vectorized DbReader probes.
+an LRU hot-position cache) into single vectorized DbReader probes, and,
+at fleet scale, a supervisor that runs N such servers as supervised
+worker processes over ONE shared listening socket and many game DBs
+(`supervisor.py` / `worker.py` / `manifest.py` — docs/SERVING.md
+"Fleet serving").
 """
 
 from gamesmanmpi_tpu.serve.batcher import (
@@ -14,7 +18,13 @@ from gamesmanmpi_tpu.serve.batcher import (
     BatcherTripped,
     BatcherUnavailable,
 )
+from gamesmanmpi_tpu.serve.manifest import (
+    FleetEntry,
+    load_fleet_manifest,
+    single_db_entries,
+)
 from gamesmanmpi_tpu.serve.server import QueryServer
+from gamesmanmpi_tpu.serve.supervisor import ServeSupervisor
 
 __all__ = [
     "Batcher",
@@ -24,4 +34,8 @@ __all__ = [
     "BatcherOverloaded",
     "BatcherTripped",
     "QueryServer",
+    "ServeSupervisor",
+    "FleetEntry",
+    "load_fleet_manifest",
+    "single_db_entries",
 ]

@@ -1,4 +1,4 @@
-"""Stdlib HTTP front-end over a solved-position database.
+"""Stdlib HTTP front-end over one or many solved-position databases.
 
 A `ThreadingHTTPServer` (one thread per connection — the stdlib answer,
 no framework dependency, matching the repo's plain-npz/no-deps stance)
@@ -6,7 +6,11 @@ exposing:
 
     POST /query         {"positions": ["0x1b", 42, ...]} ->
                         per-position value / remoteness / best child
-    GET  /healthz       liveness + DB identity
+                        (the default route: a single-DB server, or a
+                        fleet whose manifest has exactly one game)
+    POST /query/<name>  the same against the fleet-manifest game <name>
+    GET  /healthz       liveness + DB identity (+ per-game state when
+                        the server routes a fleet)
     GET  /metrics       Prometheus text exposition v0.0.4 (the process
                         metrics registry: request/batch/cache/db series);
                         answers JSON instead when the Accept header
@@ -14,16 +18,25 @@ exposing:
     GET  /metrics.json  the legacy JSON counter dict, retained verbatim
                         for existing consumers
 
-Every request thread funnels through one serve/batcher.Batcher, so
-concurrent requests coalesce into single vectorized DbReader probes; the
-HTTP layer only parses, delegates, and formats. Positions echo back in
-hex (the CLI's --query spelling) so responses are copy-pasteable into
-`cli query` / `--query` for cross-checking.
+Every request thread funnels through one serve/batcher.Batcher per
+routed game, so concurrent requests coalesce into single vectorized
+DbReader probes; the HTTP layer only parses, delegates, and formats.
+Positions echo back in hex (the CLI's --query spelling) so responses are
+copy-pasteable into `cli query` / `--query` for cross-checking.
+
+Fleet mode (docs/SERVING.md "Fleet serving"): a supervisor process binds
+the listening socket ONCE and hands it to N forked workers
+(`serve/supervisor.py`), each of which constructs a QueryServer over the
+inherited socket (``listen_sock=``) — the kernel load-balances accepts
+across the workers, and a worker that stops accepting (drain) simply
+leaves the shared queue to its siblings. The per-worker breaker /
+deadline / shed machinery is exactly the single-process one.
 """
 
 from __future__ import annotations
 
 import json
+import socket
 import sys
 import threading
 import time
@@ -46,6 +59,24 @@ PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 _MAX_BODY_BYTES = 16 << 20
 _MAX_POSITIONS_PER_REQUEST = 1 << 16
 
+#: Accept backlog for the listening socket (also used by the supervisor
+#: when it pre-binds): the stdlib default of 5 overflows under a barrier
+#: burst of clients — observed as ECONNRESET under 8 synchronized
+#: clients — and during a rolling restart the backlog is what holds
+#: arriving connections while a replacement worker warms up.
+LISTEN_BACKLOG = 128
+
+
+class _Route:
+    """One routed game: its reader and the batcher in front of it."""
+
+    __slots__ = ("name", "reader", "batcher")
+
+    def __init__(self, name: str, reader):
+        self.name = name
+        self.reader = reader
+        self.batcher = None  # attached by QueryServer AFTER the bind
+
 
 class _Handler(BaseHTTPRequestHandler):
     server_version = "gamesman-serve/1"
@@ -56,6 +87,18 @@ class _Handler(BaseHTTPRequestHandler):
     timeout = 30
 
     # self.server is the _QueryHTTPServer below.
+
+    def setup(self):
+        super().setup()
+        # Register the connection so a drain can wake handler threads
+        # parked in recv on idle keep-alive sockets (QueryServer.stop).
+        self.server.conn_opened(self.connection)
+
+    def finish(self):
+        try:
+            super().finish()
+        finally:
+            self.server.conn_closed(self.connection)
 
     def _send_json(self, code: int, payload: dict, headers=None) -> int:
         return self._send_text(
@@ -101,23 +144,7 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 - http.server API
         srv = self.server
         if self.path == "/healthz":
-            # Three states, one field: "ok" (serving normally),
-            # "degraded" (reader circuit breaker open — misses answer
-            # 503, cache hits still serve), "draining" (shutdown in
-            # progress; stop routing here). Always 200: a load balancer
-            # reads the body, an operator reads it too.
-            self._send_json(
-                200,
-                {
-                    "status": srv.health_status(),
-                    "breaker": srv.batcher.state
-                    if srv.batcher is not None else "ok",
-                    "game": srv.reader.game.name,
-                    "spec": srv.reader.manifest["spec"],
-                    "positions": srv.reader.num_positions,
-                    "levels": len(srv.reader.levels),
-                },
-            )
+            self._send_json(200, srv.healthz())
         elif self.path == "/metrics":
             if self._wants_json():
                 self._send_json(200, srv.metrics())
@@ -138,12 +165,24 @@ class _Handler(BaseHTTPRequestHandler):
         # busy, and http_errors makes the reject rate derivable.
         t0 = time.perf_counter()
         code = 500
-        self.server.note_inflight(+1)
+        self.server.note_inflight(+1, self.connection)
         try:
             code = self._handle_post()
         finally:
-            self.server.note_inflight(-1)
+            self.server.note_inflight(-1, self.connection)
             self.server.note_request(time.perf_counter() - t0, code)
+
+    def _resolve_route(self):
+        """Route a POST path: "/query" is the default route (single-DB
+        servers and one-game fleets), "/query/<name>" a fleet game."""
+        srv = self.server
+        if self.path == "/query":
+            if srv.default_route is not None:
+                return srv.default_route
+            return None
+        if self.path.startswith("/query/"):
+            return srv.routes.get(self.path[len("/query/"):])
+        return None
 
     def _handle_post(self) -> int:
         srv = self.server
@@ -155,12 +194,15 @@ class _Handler(BaseHTTPRequestHandler):
                 503, {"error": "server is draining"},
                 headers={"Retry-After": "1"},
             )
-        if self.path != "/query":
+        route = self._resolve_route()
+        if route is None:
             # The body (if any) is never read on this branch; its bytes
             # would desync the keep-alive socket (same guard as below).
             self.close_connection = True
             return self._send_json(
-                404, {"error": f"no such path {self.path!r}"}
+                404,
+                {"error": f"no such path {self.path!r}",
+                 "games": sorted(n for n in srv.routes if n)},
             )
         try:
             length = int(self.headers.get("Content-Length", 0))
@@ -195,15 +237,16 @@ class _Handler(BaseHTTPRequestHandler):
                 {"error": f"at most {_MAX_POSITIONS_PER_REQUEST} positions "
                           "per request"},
             )
+        reader = route.reader
         parsed: list = []  # (echo, packed int) or (echo, error string)
         for p in positions:
             try:
-                parsed.append((p, parse_position(srv.reader.game, p)))
+                parsed.append((p, parse_position(reader.game, p)))
             except (ValueError, TypeError) as e:
                 parsed.append((p, f"invalid position ({e})"))
         states = [s for _, s in parsed if isinstance(s, int)]
         try:
-            answers = iter(srv.batcher.submit(states))
+            answers = iter(route.batcher.submit(states))
         except BatcherUnavailable as e:
             # Genuinely transient (shutdown, deadline, shed, breaker):
             # 503 + Retry-After so a well-behaved client backs off
@@ -229,22 +272,42 @@ class _Handler(BaseHTTPRequestHandler):
                 rec["best"] = None if best is None else hex(best)
             results.append(rec)
         return self._send_json(
-            200, {"game": srv.reader.game.name, "results": results}
+            200, {"game": reader.game.name, "results": results}
         )
 
 
 class _QueryHTTPServer(ThreadingHTTPServer):
     daemon_threads = True
     allow_reuse_address = True
-    # The stdlib default accept backlog is 5; a barrier burst of clients
-    # (exactly the traffic the micro-batcher coalesces) overflows it and
-    # the overflow sees ECONNRESET. Observed under 8 synchronized clients.
-    request_queue_size = 128
+    request_queue_size = LISTEN_BACKLOG
 
-    def __init__(self, addr, reader, registry=None):
-        super().__init__(addr, _Handler)
-        self.reader = reader
-        self.batcher = None  # attached by QueryServer AFTER the bind
+    def __init__(self, addr, routes, registry=None, listen_sock=None,
+                 worker_id=None):
+        if listen_sock is None:
+            super().__init__(addr, _Handler)
+        else:
+            # Fleet worker: adopt the supervisor's pre-bound, already-
+            # listening socket instead of binding — N workers share one
+            # accept queue, so the kernel spreads connections across
+            # them and a draining worker's unaccepted backlog is simply
+            # picked up by its siblings.
+            super().__init__(addr, _Handler, bind_and_activate=False)
+            # TCPServer.__init__ unconditionally created a socket we
+            # will never bind; close it rather than leak one fd per
+            # worker for the process lifetime.
+            self.socket.close()
+            self.socket = listen_sock
+            self.server_address = listen_sock.getsockname()
+            # server_bind would also resolve these; it never ran.
+            self.server_name = self.server_address[0]
+            self.server_port = self.server_address[1]
+        #: name -> _Route; "" is the default (bare /query) route.
+        self.routes = dict(routes)
+        self.default_route = (
+            next(iter(self.routes.values()))
+            if len(self.routes) == 1 else self.routes.get("")
+        )
+        self.worker_id = worker_id
         self.registry = registry or default_registry()
         #: flipped by QueryServer.begin_drain(): /healthz says so and new
         #: POST /query work answers 503 while in-flight requests finish.
@@ -256,6 +319,11 @@ class _QueryHTTPServer(ThreadingHTTPServer):
         self._http_client_aborts = 0  # guarded-by: _stats_lock
         # POSTs between entry and response written
         self._inflight = 0  # guarded-by: _stats_lock
+        # Open connections -> POSTs in flight on each. Tracking them is
+        # what lets stop() wake handler threads parked in recv on IDLE
+        # keep-alive sockets instead of waiting out their 30 s socket
+        # timeout one by one during a supervisor-initiated drain.
+        self._conns = {}  # guarded-by: _stats_lock
         self._latency_total = 0.0  # guarded-by: _stats_lock
         self._latency_max = 0.0  # guarded-by: _stats_lock
         # server_start_time makes uptime derivable from any scrape
@@ -280,21 +348,96 @@ class _QueryHTTPServer(ThreadingHTTPServer):
             "(BrokenPipe/ConnectionReset on the write path)",
         )
 
+    # Single-DB back-compat aliases: most callers (tests, the batcher's
+    # half-open probe wiring) speak "the reader"/"the batcher".
+    @property
+    def reader(self):
+        route = self.default_route or next(iter(self.routes.values()))
+        return route.reader
+
+    @property
+    def batcher(self):
+        route = self.default_route or next(iter(self.routes.values()))
+        return route.batcher
+
     def health_status(self) -> str:
         if self.draining:
             return "draining"
-        if self.batcher is not None and self.batcher.state != "ok":
-            return "degraded"
+        for route in self.routes.values():
+            if route.batcher is not None and route.batcher.state != "ok":
+                return "degraded"
         return "ok"
+
+    def healthz(self) -> dict:
+        """The /healthz payload. Three states, one field: "ok" (serving
+        normally), "degraded" (some reader's circuit breaker open —
+        misses answer 503, cache hits still serve), "draining" (shutdown
+        in progress; stop routing here). Always 200: a load balancer
+        reads the body, an operator reads it too. Single-DB servers keep
+        the legacy flat identity fields; every server also carries the
+        per-game "games" map (the fleet view)."""
+        games = {}
+        for name, route in self.routes.items():
+            games[name or "default"] = {
+                "game": route.reader.game.name,
+                "spec": route.reader.manifest["spec"],
+                "positions": route.reader.num_positions,
+                "levels": len(route.reader.levels),
+                "breaker": route.batcher.state
+                if route.batcher is not None else "ok",
+            }
+        payload = {"status": self.health_status(), "games": games}
+        if self.worker_id is not None:
+            payload["worker"] = self.worker_id
+        if self.default_route is not None:
+            r = self.default_route
+            payload.update({
+                "breaker": r.batcher.state if r.batcher is not None
+                else "ok",
+                "game": r.reader.game.name,
+                "spec": r.reader.manifest["spec"],
+                "positions": r.reader.num_positions,
+                "levels": len(r.reader.levels),
+            })
+        return payload
 
     def note_client_abort(self) -> None:
         with self._stats_lock:
             self._http_client_aborts += 1
         self._m_client_aborts.inc()
 
-    def note_inflight(self, delta: int) -> None:
+    def conn_opened(self, conn) -> None:
+        with self._stats_lock:
+            self._conns[conn] = 0
+
+    def conn_closed(self, conn) -> None:
+        with self._stats_lock:
+            self._conns.pop(conn, None)
+
+    def note_inflight(self, delta: int, conn=None) -> None:
         with self._stats_lock:
             self._inflight += delta
+            if conn is not None and conn in self._conns:
+                self._conns[conn] += delta
+
+    def shutdown_idle_conns(self, force: bool = False) -> int:
+        """Shut down tracked connections with no POST in flight (all of
+        them when ``force``), waking their handler threads out of the
+        blocking keep-alive read immediately. Returns how many were
+        closed. A keep-alive client sees a clean connection close
+        between requests — the normal HTTP/1.1 end-of-keep-alive, not a
+        failed request."""
+        with self._stats_lock:
+            victims = [
+                c for c, inflight in self._conns.items()
+                if force or inflight == 0
+            ]
+        for conn in victims:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass  # already dying; the handler's read still returns
+        return len(victims)
 
     @property
     def inflight(self) -> int:
@@ -331,7 +474,7 @@ class _QueryHTTPServer(ThreadingHTTPServer):
             mean = self._latency_total / max(n, 1)
             peak = self._latency_max
             uptime = time.time() - self._t0
-        return {
+        payload = {
             "server_start_time": self._t0,
             "uptime_secs": uptime,
             "status": self.health_status(),
@@ -340,39 +483,85 @@ class _QueryHTTPServer(ThreadingHTTPServer):
             "http_client_aborts": aborts,
             "latency_mean_ms": mean * 1e3,
             "latency_max_ms": peak * 1e3,
-            **self.batcher.metrics(),
         }
+        if self.worker_id is not None:
+            payload["worker"] = self.worker_id
+        if len(self.routes) == 1:
+            # Legacy single-DB shape: batcher counters flat in the dict.
+            payload.update(self.batcher.metrics())
+        else:
+            payload["games"] = {
+                (name or "default"): route.batcher.metrics()
+                for name, route in self.routes.items()
+                if route.batcher is not None
+            }
+        return payload
 
 
 class QueryServer:
-    """Owns the HTTP server + batcher lifecycle.
+    """Owns the HTTP server + per-game batcher lifecycle.
 
-    port=0 binds an ephemeral port (tests); `.port` reports the bound one.
-    Use `.start()` for a background thread (in-process tests) or
+    One positional ``reader`` serves a single DB on the default route
+    (unchanged contract); ``readers={name: DbReader}`` serves a fleet —
+    each game gets its own coalescing batcher (and so its own circuit
+    breaker: one rotting DB degrades one route, not the fleet).
+
+    port=0 binds an ephemeral port (tests); `.port` reports the bound
+    one. ``listen_sock`` adopts a pre-bound, already-listening socket
+    instead of binding (the supervised-worker path). Use `.start()` for
+    a background thread (in-process tests, workers) or
     `.serve_forever()` to block (the CLI `serve` subcommand).
     """
 
-    def __init__(self, reader, *, host: str = "127.0.0.1", port: int = 0,
+    def __init__(self, reader=None, *, readers=None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 listen_sock=None, worker_id=None,
                  window: float = 0.002, cache_size: int = 65536,
                  max_queue: int = 1024, request_timeout: float | None = None,
                  breaker_threshold: int = 3, breaker_cooldown: float = 5.0,
                  logger=None, registry=None):
-        self.reader = reader
+        if (reader is None) == (readers is None):
+            raise ValueError("pass exactly one of reader= or readers=")
+        routes = (
+            {"": _Route("", reader)} if reader is not None
+            else {name: _Route(name, r) for name, r in readers.items()}
+        )
+        if not routes:
+            raise ValueError("readers= must name at least one DB")
         self.logger = logger
         self.registry = registry or default_registry()
-        # Bind FIRST: a bind failure (port in use) must raise before the
+        # Bind FIRST: a bind failure (port in use) must raise before any
         # batcher spawns its worker thread, or every failed construction
-        # would leak an unjoinable daemon thread.
-        self._httpd = _QueryHTTPServer((host, port), reader, self.registry)
-        self.batcher = Batcher(
-            reader, window=window, cache_size=cache_size,
-            max_queue=max_queue, request_timeout=request_timeout,
-            breaker_threshold=breaker_threshold,
-            breaker_cooldown=breaker_cooldown,
-            logger=logger, registry=self.registry,
+        # would leak unjoinable daemon threads.
+        self._httpd = _QueryHTTPServer(
+            (host, port), routes, self.registry,
+            listen_sock=listen_sock, worker_id=worker_id,
         )
-        self._httpd.batcher = self.batcher
+        for route in self._httpd.routes.values():
+            route.batcher = Batcher(
+                route.reader, window=window, cache_size=cache_size,
+                max_queue=max_queue, request_timeout=request_timeout,
+                breaker_threshold=breaker_threshold,
+                breaker_cooldown=breaker_cooldown,
+                logger=logger, registry=self.registry,
+            )
         self._thread: threading.Thread | None = None
+
+    @property
+    def reader(self):
+        return self._httpd.reader
+
+    @property
+    def batcher(self):
+        return self._httpd.batcher
+
+    @property
+    def routes(self) -> dict:
+        return self._httpd.routes
+
+    @property
+    def inflight(self) -> int:
+        return self._httpd.inflight
 
     @property
     def host(self) -> str:
@@ -396,6 +585,26 @@ class QueryServer:
     def metrics(self) -> dict:
         return self._httpd.metrics()
 
+    def healthz(self) -> dict:
+        return self._httpd.healthz()
+
+    def self_probe(self) -> None:
+        """Warm-start self-probe: one REAL lookup of every routed game's
+        initial position through the full batcher->reader path. Raises
+        on any failure (a worker must not join the ready set answering
+        from a path it has never exercised); as a side effect the
+        canonicalize/expand kernels compile here, off the serving path,
+        so the first client request never pays a cold compile."""
+        for route in self._httpd.routes.values():
+            out = route.batcher.submit(
+                [int(route.reader.game.initial_state())]
+            )
+            if not out or not out[0][2]:
+                raise RuntimeError(
+                    f"self-probe: initial position of "
+                    f"{route.reader.game.name!r} not found in its DB"
+                )
+
     def begin_drain(self) -> None:
         """Flip /healthz to "draining" and 503 new queries while
         in-flight requests finish — the first half of a SIGTERM
@@ -403,23 +612,50 @@ class QueryServer:
         self._httpd.draining = True
 
     def stop(self) -> None:
-        self.begin_drain()
+        # Stop ACCEPTING first: a connection this server never accepted
+        # is someone else's to answer (a fleet sibling's via the shared
+        # accept queue; a load balancer's retry single-process). Flip
+        # draining only AFTER the accepted requests got their grace —
+        # a request the server chose to accept arrived before the drain
+        # and deserves an answer, not a 503 from a batcher closed under
+        # it (observed as rolling-reload request failures).
         self._httpd.shutdown()
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
-        # Requests already coalescing get one final flush (drain=True):
-        # they arrived before the drain flip and deserve an answer.
-        self.batcher.close(drain=True)
+        # Grace: accepted requests reach and clear the still-open
+        # batchers. inflight counts POSTs between entry and response
+        # written; the settle re-check catches one accepted and parsed
+        # but not yet counted. Keep-alive clients issuing NEW requests
+        # during the grace are bounded by the deadline.
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if self._httpd.inflight == 0:
+                time.sleep(0.05)
+                if self._httpd.inflight == 0:
+                    break
+            else:
+                time.sleep(0.01)
+        self.begin_drain()
+        # Requests still coalescing get one final flush (drain=True).
+        for route in self._httpd.routes.values():
+            route.batcher.close(drain=True)
         # Handler threads are daemons ThreadingHTTPServer never joins: a
         # process exit right after this call would kill them mid-write,
-        # truncating the very responses the drain flushed. Bounded wait
+        # truncating the very responses the drain flushed. Two-step
+        # teardown: (1) shut down IDLE keep-alive connections now —
+        # their handler threads sit in a blocking recv waiting for a
+        # next request that will never come, and without the nudge each
+        # would pin the drain until its socket timeout; (2) bounded wait
         # for the in-flight POSTs to finish writing (their batch answers
-        # arrived in the close(drain=True) above, so this is socket-write
-        # time — milliseconds; the deadline only guards a hung client).
+        # arrived in the close(drain=True) above, so this is socket-
+        # write time — milliseconds; the deadline only guards a hung
+        # client), then force-close whatever remains.
+        self._httpd.shutdown_idle_conns()
         deadline = time.monotonic() + 5.0
         while self._httpd.inflight > 0 and time.monotonic() < deadline:
             time.sleep(0.01)
+        self._httpd.shutdown_idle_conns(force=True)
         self._httpd.server_close()
 
     def __enter__(self):

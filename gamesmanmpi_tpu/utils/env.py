@@ -54,6 +54,23 @@ def env_int_strict(name: str, default: int) -> int:
         raise ValueError(f"{name}={raw!r} is not an integer") from None
 
 
+def env_bool(name: str, default: bool) -> bool:
+    """On/off env knob: "0"/"off"/"false"/"no" (any case) is False,
+    "1"/"on"/"true"/"yes" is True; anything else warns and falls back
+    (the warn-and-default contract — a typo'd toggle must not crash a
+    server, and must not silently flip the feature either way)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    low = raw.strip().lower()
+    if low in ("0", "off", "false", "no"):
+        return False
+    if low in ("1", "on", "true", "yes"):
+        return True
+    warnings.warn(f"{name}={raw!r} is not a boolean; using {default}")
+    return default
+
+
 def env_str(name: str, default: str) -> str:
     return os.environ.get(name, default)
 

@@ -72,7 +72,25 @@ class StdoutLogger(_ClosingLogger):
         pass
 
 
-class RankLogger(_ClosingLogger):
+class TagLogger(_ClosingLogger):
+    """Stamp every record with constant fields (a record's own value for
+    a key wins over the stamp). The serving fleet stamps ``worker=<id>``
+    on each worker's JSONL stream so tools/obs_report.py can merge N
+    workers' ``serve_batch`` records without ambiguity."""
+
+    def __init__(self, inner, **tags):
+        self.inner = inner
+        self.tags = tags
+
+    def log(self, record: dict) -> None:
+        merged = {**self.tags, **record}
+        self.inner.log(merged)
+
+    def close(self) -> None:
+        self.inner.close()
+
+
+class RankLogger(TagLogger):
     """Stamp every record with the emitting process's rank.
 
     A multi-process solve writes one JSONL stream per rank (same
@@ -82,16 +100,7 @@ class RankLogger(_ClosingLogger):
     """
 
     def __init__(self, inner, rank: int):
-        self.inner = inner
-        self.rank = int(rank)
-
-    def log(self, record: dict) -> None:
-        if "rank" not in record:
-            record = {**record, "rank": self.rank}
-        self.inner.log(record)
-
-    def close(self) -> None:
-        self.inner.close()
+        super().__init__(inner, rank=int(rank))
 
 
 class TeeLogger(_ClosingLogger):

@@ -104,3 +104,72 @@ def assert_table_parity(result, oracle_table):
             if len(mismatches) > 5:
                 break
     assert not mismatches, f"value/remoteness mismatches: {mismatches}"
+
+
+# --------------------------------------------------- serving-fleet fakes
+
+#: A scripted stand-in for serve/worker.py that speaks the heartbeat-pipe
+#: protocol without importing jax or opening a DB, so supervisor
+#: state-machine tests run in milliseconds. Modes: "ok" (ready + beats,
+#: SIGTERM -> draining + exit 0), "crash" (die before ready — the
+#: storm-breaker shape), "mute" (go ready, then stop beating — the
+#: hang shape the liveness deadline kills), "slowdrain" (like "ok" but
+#: takes a beat to exit after SIGTERM — keeps a rolling reload IN
+#: PROGRESS long enough for tests to race it), "stuckdrain" (announces
+#: draining on SIGTERM, then never exits — the wedged-teardown shape
+#: the drain deadline must catch), "wedge" (closes its pipe mid-life
+#: but lingers, SIGTERM-immune).
+FAKE_FLEET_WORKER = r"""
+import json, os, signal, sys, time
+fd = int(sys.argv[1]); mode = sys.argv[2]
+stop = []
+signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+def send(**m):
+    os.write(fd, (json.dumps(m) + "\n").encode())
+send(type="hello", pid=os.getpid())
+if mode == "crash":
+    sys.exit(3)
+send(type="ready", pid=os.getpid(), verified={"default": True},
+     warmup_secs=0.01, games=["default"])
+beats = 0
+while not stop:
+    time.sleep(0.02)
+    beats += 1
+    if mode == "mute" and beats > 3:
+        continue
+    if mode == "wedge" and beats > 3:
+        # The wedged-teardown shape: pipe closed (EOF at the
+        # supervisor) but the process lingers, ignoring SIGTERM.
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        os.close(fd)
+        time.sleep(600)
+    send(type="beat", status="ok")
+send(type="draining")
+if mode == "slowdrain":
+    time.sleep(0.5)
+if mode == "stuckdrain":
+    time.sleep(600)
+sys.exit(0)
+"""
+
+
+def fake_fleet_spawn(mode_for):
+    """Build a ServeSupervisor ``spawn=`` hook running FAKE_FLEET_WORKER
+    subprocesses; ``mode_for(slot_idx)`` picks each slot's script mode."""
+    import os
+    import subprocess
+    import sys
+
+    from gamesmanmpi_tpu.serve.supervisor import _ExecProc
+
+    def spawn(slot_idx, cfg):
+        r, w = os.pipe()
+        proc = subprocess.Popen(
+            [sys.executable, "-c", FAKE_FLEET_WORKER, str(w),
+             mode_for(slot_idx)],
+            pass_fds=(w,),
+        )
+        os.close(w)
+        return _ExecProc(proc), r
+
+    return spawn

@@ -106,3 +106,36 @@ def test_bench_demotes_to_classic_when_dense_breaks():
     assert record["engine"] == "classic"
     assert record["positions"] == 694
     assert "demoting to the classic engine" in stderr
+
+
+@pytest.mark.slow
+def test_bench_serve_slo_artifact(tmp_path):
+    """BENCH_SERVE=1 (ISSUE 7): the bench additionally exports a DB,
+    launches the supervised fleet, drives load-gen traffic through a
+    mid-load worker SIGKILL, and gates on the latency SLO — stdout
+    stays exactly one JSON line with a serve summary, the full record
+    lands in BENCH_SERVE_OUT."""
+    out = tmp_path / "BENCH_serve.json"
+    record, _ = _run_bench({
+        "BENCH_ENGINE": "classic",
+        "BENCH_SERVE": "1",
+        "BENCH_SERVE_GAME": "subtract:total=21,moves=1-2-3",
+        "BENCH_SERVE_SECS": "4",
+        "BENCH_SERVE_CONC": "4",
+        "BENCH_SERVE_SLO_P99_MS": "2000",
+        "BENCH_SERVE_OUT": str(out),
+    })
+    sv = record["serve"]
+    artifact = json.loads(out.read_text())
+    assert sv["ok"] is True, artifact.get("error")
+    assert sv["workers"] == 2
+    assert sv["slo_ok"] is True
+    assert sv["mismatches"] == 0
+    assert sv["dropped"] <= 4  # the in-flight budget of the kill
+    assert sv["worker_restarts"] == 1
+    assert sv["recovered_secs"] is not None
+    assert artifact["spawn_mode"] == "fork"
+    assert artifact["requests"] > 0
+    assert artifact["p99_ms"] > 0
+    # The chaos really happened and really healed inside the run.
+    assert artifact["killed_worker"] in ("0", "1")

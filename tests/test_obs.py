@@ -549,3 +549,43 @@ def test_server_metrics_prometheus_and_negotiation(tmp_path):
             assert body["http_requests"] >= 1
             assert body["server_start_time"] > 1e9
             assert body["uptime_secs"] >= 0
+
+
+@pytest.mark.smoke
+def test_obs_report_merges_per_worker_serving_streams(tmp_path, capsys):
+    """Fleet JSONL (ISSUE 7): serve_batch records from N worker-stamped
+    streams summarize per worker (workers are independent processes —
+    their figures accumulate separately, never merged by max the way
+    per-rank solve times are)."""
+    recs = [
+        {"worker": 0, "phase": "serve_batch", "requests": 2,
+         "batch_size": 5, "secs": 0.01},
+        {"worker": 0, "phase": "serve_batch", "requests": 1,
+         "batch_size": 3, "secs": 0.02},
+        {"worker": 1, "phase": "serve_batch", "requests": 4,
+         "batch_size": 8, "secs": 0.04},
+        # A legacy single-process stream has no worker tag.
+        {"phase": "serve_batch", "requests": 1, "batch_size": 1,
+         "secs": 0.005},
+    ]
+    obs_report = load_module(REPO / "tools" / "obs_report.py")
+    lines = obs_report.summarize_serving(recs)
+    assert lines == [
+        "serve[worker 0]: batches=2 requests=3 queries=8 "
+        "mean_batch=4.0 secs=0.030",
+        "serve[worker 1]: batches=1 requests=4 queries=8 "
+        "mean_batch=8.0 secs=0.040",
+        "serve: batches=1 requests=1 queries=1 mean_batch=1.0 "
+        "secs=0.005",
+    ]
+    # And through the CLI: worker-stamped streams fold into one report
+    # (serve_batch stays out of the aux record counts).
+    for i, rec in enumerate(recs):
+        path = tmp_path / f"serve.worker{i}.jsonl"
+        path.write_text(json.dumps(rec) + "\n")
+    assert obs_report.main(
+        [str(tmp_path / f"serve.worker{i}.jsonl") for i in range(4)]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "serve[worker 0]" in out
+    assert "serve_batch" not in out

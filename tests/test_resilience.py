@@ -14,7 +14,12 @@ Acceptance axes (ISSUE 4):
   intact prefix;
 * serving degradation — reader faults trip the circuit breaker (503 +
   /healthz "degraded", never a hang past the request deadline) and the
-  background half-open re-probe recovers to "ok" without a restart.
+  background half-open re-probe recovers to "ok" without a restart;
+* fleet chaos (ISSUE 7) — the serve.worker_spawn / serve.heartbeat /
+  serve.reload fault points: a crash-looping worker opens the
+  restart-storm breaker, a heartbeat stall is killed and restarted,
+  and a faulted rolling reload fails closed with the fleet untouched
+  (lifecycle + load chaos live in tests/test_serve_fleet.py).
 """
 
 import json
@@ -863,3 +868,160 @@ def test_serve_sigterm_drains_gracefully(tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.wait()
+
+
+# ------------------------------------------------- serving fleet chaos
+
+
+def _fleet_db(tmp_path):
+    from gamesmanmpi_tpu.db import export_result
+
+    spec = "subtract:total=10,moves=1-2"
+    db = tmp_path / "db"
+    export_result(Solver(get_game(spec)).solve(), db, spec)
+    return db
+
+
+def _fleet_proc(db, tmp_path, extra_env):
+    env = dict(os.environ)
+    env["GAMESMAN_PLATFORM"] = "cpu"
+    env["GAMESMAN_SERVE_RESTART_BASE_SECS"] = "0.05"
+    env.pop("GAMESMAN_FAULTS", None)
+    env.update(extra_env)
+    return subprocess.Popen(
+        _CLI + ["serve", str(db), "--port", "0", "--workers", "2",
+                "--control-port", "0"],
+        stdout=subprocess.PIPE, text=True, env=env, cwd=str(REPO),
+    )
+
+
+def _fleet_ports(proc):
+    banner = proc.stdout.readline()
+    assert "serving fleet" in banner, banner
+    return (int(banner.split("http://127.0.0.1:")[1].split(" ")[0]),
+            int(banner.split("http://127.0.0.1:")[2].split(" ")[0]))
+
+
+def test_chaos_worker_spawn_crashloop_opens_storm_breaker(tmp_path):
+    """serve.worker_spawn chaos: a worker whose every spawn dies at the
+    fault point (a rotted replica shape — the same failure recurs on
+    each restart) trips the slot's restart-storm breaker; the healthy
+    worker keeps the fleet answering, degraded."""
+    db = _fleet_db(tmp_path)
+    proc = _fleet_proc(db, tmp_path, {
+        "GAMESMAN_FAULTS_WORKER_0": "serve.worker_spawn:fatal:always",
+        "GAMESMAN_SERVE_STORM_RESTARTS": "2",
+        "GAMESMAN_SERVE_STORM_SECS": "600",
+    })
+    try:
+        port, cport = _fleet_ports(proc)
+        control = f"http://127.0.0.1:{cport}"
+        deadline = time.monotonic() + 120
+        st = {}
+        while time.monotonic() < deadline:
+            st = _get(control + "/healthz")[1]
+            if st["workers"]["0"]["breaker"] == "open" \
+                    and st["workers"]["1"]["state"] == "ready":
+                break
+            time.sleep(0.2)
+        assert st["workers"]["0"]["breaker"] == "open", st
+        assert st["workers"]["0"]["state"] == "broken"
+        assert st["workers"]["0"]["restarts"] >= 2
+        # The injected warm-start refusal is attributed on the slot.
+        assert "rc=3" in st["workers"]["0"]["last_error"]
+        assert st["status"] == "degraded"
+        # The surviving worker still answers through the shared socket.
+        status, body = _post(f"http://127.0.0.1:{port}/query",
+                             {"positions": [10]})
+        assert status == 200
+        assert body["results"][0]["found"]
+        proc.send_signal(subprocess.signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def test_chaos_heartbeat_stall_is_killed_and_restarted(tmp_path):
+    """serve.heartbeat chaos: a delay injected on the beat path stalls
+    the worker's liveness signal; the supervisor's beat deadline turns
+    the silent hang into SIGKILL + backoff restart while the sibling
+    keeps serving."""
+    db = _fleet_db(tmp_path)
+    proc = _fleet_proc(db, tmp_path, {
+        # The 2nd beat of worker 0 sleeps far past the beat deadline.
+        "GAMESMAN_FAULTS_WORKER_0": "serve.heartbeat:delay=60:2",
+        "GAMESMAN_SERVE_HEARTBEAT_SECS": "0.1",
+        "GAMESMAN_SERVE_HEARTBEAT_TIMEOUT": "1.0",
+    })
+    try:
+        port, cport = _fleet_ports(proc)
+        control = f"http://127.0.0.1:{cport}"
+        deadline = time.monotonic() + 120
+        st = {}
+        while time.monotonic() < deadline:
+            st = _get(control + "/healthz")[1]
+            if st["workers"]["0"]["restarts"] >= 1 \
+                    and st["workers"]["1"]["state"] == "ready":
+                break
+            time.sleep(0.2)
+        assert st["workers"]["0"]["restarts"] >= 1, st
+        status, body = _post(f"http://127.0.0.1:{port}/query",
+                             {"positions": [10]})
+        assert status == 200
+        assert body["results"][0]["found"]
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_chaos_reload_fault_fails_closed_fleet_untouched(tmp_path):
+    """serve.reload chaos: a fault at the top of a rolling reload fails
+    the RELOAD, not the fleet — no worker is drained, the error is
+    reported on /healthz state, and the next (clean) reload rolls."""
+    from gamesmanmpi_tpu.serve import ServeSupervisor, single_db_entries
+
+    from helpers import fake_fleet_spawn
+
+    db = _fleet_db(tmp_path)
+    faults.configure("serve.reload:fatal:1")
+    sup = ServeSupervisor(
+        single_db_entries(db), workers=2, control_port=None,
+        heartbeat_secs=0.05, heartbeat_timeout=5.0, restart_base=0.01,
+        spawn=fake_fleet_spawn(lambda i: "ok"),
+    ).start()
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if sup.status()["status"] == "ok":
+                break
+            time.sleep(0.05)
+        gen0_pids = {w["pid"] for w in sup.status()["workers"].values()}
+        sup.request_reload()
+        st = {}
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            st = sup.status()
+            if st["last_reload_error"]:
+                break
+            time.sleep(0.05)
+        assert "FatalFault" in (st["last_reload_error"] or ""), st
+        assert st["gen"] == 0
+        assert st["reloads_done"] == 0
+        assert st["status"] == "ok"
+        # No worker was drained by the failed reload.
+        assert {w["pid"] for w in st["workers"].values()} == gen0_pids
+        # The fault was one-shot (visit 1): the next reload rolls clean.
+        sup.request_reload()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            st = sup.status()
+            if st["reloads_done"] == 1 and st["status"] == "ok":
+                break
+            time.sleep(0.05)
+        assert st["reloads_done"] == 1, st
+        assert st["gen"] == 1
+        assert st["last_reload_error"] is None
+    finally:
+        sup.stop()

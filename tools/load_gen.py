@@ -1,0 +1,252 @@
+#!/usr/bin/env python
+"""Serving load harness: concurrent query load with latency-SLO gates.
+
+    python tools/load_gen.py http://127.0.0.1:8947 \
+        --positions-file pos.txt --duration 10 --concurrency 8 \
+        --slo-p99-ms 250 --json out.json
+
+Drives POST /query traffic from N threads for a wall-clock duration and
+reports request counts, shed/dropped/error classification, and latency
+percentiles (p50/p95/p99). This is the measurement half of the fleet
+chaos gate (tests/test_resilience.py, bench.py's serving mode): under a
+worker SIGKILL mid-load the fleet must keep answering with zero dropped
+requests beyond the in-flight shed budget and p99 within the SLO.
+
+Classification per request:
+
+* ``ok``       — HTTP 200 with every queried position found;
+* ``shed``     — HTTP 503 (deadline / load shed / breaker / draining):
+  the server DEGRADED POLITELY; a well-behaved client retries;
+* ``errors``   — any other HTTP status, or a 200 carrying per-position
+  errors/misses (would be wrong answers — the harness treats them as
+  failures, not noise);
+* ``dropped``  — connection-level failure (refused, reset mid-flight):
+  the only class a crashing worker is allowed to inflict, bounded by
+  its in-flight requests at death.
+
+Answers are accumulated per position (value/remoteness/best of the last
+successful response) and exposed for oracle comparison; ``mismatches``
+counts positions whose answer ever CHANGED between responses — a fleet
+serving one immutable DB must answer identically from every worker,
+before, during, and after chaos.
+
+Deliberately jax-free and stdlib-only (urllib + threads): bench.py's
+parent process imports this module, and that parent must never touch
+jax. One request per connection — no keep-alive — so a draining
+worker's connection close between requests can never be miscounted as a
+failed request.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+
+def percentile(sorted_vals: list, q: float) -> float:
+    """Nearest-rank percentile of an ascending list (0 when empty)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+class _Stats:
+    """Shared accumulator; one lock, touched once per request."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.latencies = []  # guarded-by: lock
+        self.ok = 0  # guarded-by: lock
+        self.shed = 0  # guarded-by: lock
+        self.errors = 0  # guarded-by: lock
+        self.dropped = 0  # guarded-by: lock
+        self.codes = {}  # guarded-by: lock
+        self.answers = {}  # guarded-by: lock
+        self.mismatches = 0  # guarded-by: lock
+
+    def note(self, kind: str, code, secs: float | None,
+             results=None) -> None:
+        with self.lock:
+            if secs is not None:
+                self.latencies.append(secs)
+            self.codes[str(code)] = self.codes.get(str(code), 0) + 1
+            setattr(self, kind, getattr(self, kind) + 1)
+            for rec in results or ():
+                pos = rec.get("position")
+                ans = (rec.get("value"), rec.get("remoteness"),
+                       rec.get("best"))
+                old = self.answers.get(pos)
+                if old is not None and old != ans:
+                    self.mismatches += 1
+                self.answers[pos] = ans
+
+
+def _worker_loop(url: str, chunks: list, stats: _Stats, stop: threading.Event,
+                 timeout: float, offset: int) -> None:
+    i = offset
+    while not stop.is_set():
+        chunk = chunks[i % len(chunks)]
+        i += 1
+        body = json.dumps({"positions": chunk}).encode()
+        req = urllib.request.Request(
+            f"{url}/query", data=body,
+            headers={"Content-Type": "application/json",
+                     "Connection": "close"},
+            method="POST",
+        )
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                payload = json.loads(resp.read())
+            secs = time.perf_counter() - t0
+            results = payload.get("results", [])
+            clean = all(
+                r.get("found") and "error" not in r for r in results
+            ) and len(results) == len(chunk)
+            stats.note("ok" if clean else "errors", 200, secs,
+                       results if clean else None)
+        except urllib.error.HTTPError as e:
+            secs = time.perf_counter() - t0
+            stats.note("shed" if e.code == 503 else "errors", e.code, secs)
+        except Exception:  # noqa: BLE001 - URLError/socket/timeout: dropped
+            stats.note("dropped", "conn", None)
+
+
+def run_load(url: str, positions: list, *, duration: float = 5.0,
+             concurrency: int = 4, chunk_size: int = 8,
+             timeout: float = 10.0, stop_event=None) -> dict:
+    """Drive load; returns the stats record (see module docstring).
+
+    positions: ints (or hex strings) assumed PRESENT in the served DB —
+    a miss counts as an error by design. Each thread cycles through
+    round-robin chunks at its own offset so concurrent threads overlap
+    on hot positions (cache hits) AND spread over the whole set.
+    """
+    url = url.rstrip("/")
+    positions = [int(p, 0) if isinstance(p, str) else int(p)
+                 for p in positions]
+    chunk_size = max(1, int(chunk_size))
+    chunks = [
+        positions[i:i + chunk_size]
+        for i in range(0, len(positions), chunk_size)
+    ] or [[0]]
+    stats = _Stats()
+    stop = stop_event or threading.Event()
+    threads = [
+        threading.Thread(
+            target=_worker_loop,
+            args=(url, chunks, stats, stop, timeout,
+                  i * max(1, len(chunks) // max(1, concurrency))),
+            daemon=True,
+        )
+        for i in range(max(1, int(concurrency)))
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    stop.wait(duration)
+    stop.set()
+    for t in threads:
+        t.join(timeout=timeout + 5)
+    elapsed = time.perf_counter() - t0
+    with stats.lock:
+        lat = sorted(stats.latencies)
+        record = {
+            "url": url,
+            "duration_secs": round(elapsed, 3),
+            "concurrency": int(concurrency),
+            "requests": stats.ok + stats.shed + stats.errors
+            + stats.dropped,
+            "ok": stats.ok,
+            "shed": stats.shed,
+            "errors": stats.errors,
+            "dropped": stats.dropped,
+            "codes": dict(stats.codes),
+            "mismatches": stats.mismatches,
+            "qps": round((stats.ok + stats.shed + stats.errors)
+                         / max(elapsed, 1e-9), 1),
+            "p50_ms": round(percentile(lat, 0.50) * 1e3, 3),
+            "p95_ms": round(percentile(lat, 0.95) * 1e3, 3),
+            "p99_ms": round(percentile(lat, 0.99) * 1e3, 3),
+            "answers": {
+                str(pos): ans for pos, ans in stats.answers.items()
+            },
+        }
+    return record
+
+
+def _read_positions(path: str) -> list:
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                out.append(int(line, 0))
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Concurrent POST /query load with latency-SLO gates "
+        "(docs/SERVING.md fleet mode)."
+    )
+    p.add_argument("url", help="server base URL, e.g. http://127.0.0.1:8947")
+    p.add_argument("--positions-file", required=True,
+                   help="file of packed positions (decimal or 0x-hex, one "
+                   "per line, # comments) known to be in the DB")
+    p.add_argument("--duration", type=float, default=5.0)
+    p.add_argument("--concurrency", type=int, default=4)
+    p.add_argument("--chunk-size", type=int, default=8,
+                   help="positions per request")
+    p.add_argument("--timeout", type=float, default=10.0,
+                   help="per-request client timeout, seconds")
+    p.add_argument("--slo-p99-ms", type=float, default=None,
+                   help="gate: exit 1 when p99 latency exceeds this")
+    p.add_argument("--max-dropped", type=int, default=None,
+                   help="gate: exit 1 when more requests were dropped "
+                   "(connection failures) than this budget")
+    p.add_argument("--json", default=None, metavar="OUT",
+                   help="also write the full record to this file")
+    args = p.parse_args(argv)
+    try:
+        positions = _read_positions(args.positions_file)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not positions:
+        print("error: no positions to query", file=sys.stderr)
+        return 2
+    record = run_load(
+        args.url, positions, duration=args.duration,
+        concurrency=args.concurrency, chunk_size=args.chunk_size,
+        timeout=args.timeout,
+    )
+    gates_ok = True
+    if args.slo_p99_ms is not None and record["p99_ms"] > args.slo_p99_ms:
+        print(f"SLO VIOLATION: p99 {record['p99_ms']:.1f}ms > "
+              f"{args.slo_p99_ms:g}ms", file=sys.stderr)
+        gates_ok = False
+    if args.max_dropped is not None and record["dropped"] > args.max_dropped:
+        print(f"DROP BUDGET EXCEEDED: {record['dropped']} > "
+              f"{args.max_dropped}", file=sys.stderr)
+        gates_ok = False
+    if record["mismatches"]:
+        print(f"ANSWER MISMATCHES: {record['mismatches']} positions "
+              "changed answers mid-run", file=sys.stderr)
+        gates_ok = False
+    summary = {k: v for k, v in record.items() if k != "answers"}
+    print(json.dumps(summary))
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(record, fh, indent=1)
+    return 0 if gates_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

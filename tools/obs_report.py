@@ -175,9 +175,45 @@ def format_table(rows: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def summarize_serving(records: list[dict]) -> list[str]:
+    """Per-worker serving lines from ``serve_batch`` records.
+
+    A fleet run writes one worker-stamped JSONL stream per worker
+    (``serve.worker0.jsonl`` — utils/metrics.TagLogger), the serving
+    twin of the per-rank solve streams: pass them all and each worker's
+    batching behavior reports separately (workers are independent
+    processes — unlike ranks their batches never time the same event,
+    so figures accumulate per worker and are never merged by max)."""
+    by_worker: dict = {}
+    for rec in records:
+        if rec.get("phase") != "serve_batch":
+            continue
+        row = by_worker.setdefault(
+            rec.get("worker"),
+            {"batches": 0, "requests": 0, "queries": 0, "secs": 0.0},
+        )
+        row["batches"] += 1
+        row["requests"] += int(rec.get("requests", 0))
+        row["queries"] += int(rec.get("batch_size", 0))
+        row["secs"] += float(rec.get("secs", 0.0))
+    lines = []
+    for worker in sorted(by_worker, key=lambda w: (w is None, w)):
+        row = by_worker[worker]
+        label = "serve" if worker is None else f"serve[worker {worker}]"
+        mean = row["queries"] / max(row["batches"], 1)
+        lines.append(
+            f"{label}: batches={row['batches']} requests={row['requests']} "
+            f"queries={row['queries']} mean_batch={mean:.1f} "
+            f"secs={row['secs']:.3f}"
+        )
+    return lines
+
+
 def report(records: list[dict]) -> str:
-    """The full report: level table + done summary + aux record counts."""
+    """The full report: level table + done summary + serving summary +
+    aux record counts."""
     out = [format_table(summarize_levels(records))]
+    out.extend(summarize_serving(records))
     for rec in records:
         if rec.get("phase") == "done":
             keys = ("game", "positions", "levels", "secs_forward",
@@ -196,8 +232,9 @@ def report(records: list[dict]) -> str:
         phase = rec.get("phase")
         # retry/ckpt_degraded already rolled into the level table's
         # retries column; a retry without a level (serving) still lands
-        # here.
-        if phase not in ("forward", "backward", "backward_edges", "done") \
+        # here. serve_batch has its own per-worker summary lines.
+        if phase not in ("forward", "backward", "backward_edges", "done",
+                         "serve_batch") \
                 and not (phase in ("retry", "ckpt_degraded")
                          and "level" in rec):
             aux[phase] = aux.get(phase, 0) + 1
