@@ -263,6 +263,67 @@ def _multiprocess_smoke() -> dict | None:
     return artifact
 
 
+def _launch_fleet(db: str, workers: int):
+    """Launch `cli serve --workers N` on ephemeral ports and wait until
+    the fleet reports ready — the subprocess choreography _serve_bench
+    and _db_compress_bench share (bounded banner read: a supervisor that
+    wedges before its banner must fail the bench into the artifact, not
+    hang it; every other wait is deadline-bounded too).
+
+    -> {"proc", "port", "cport", "status"} on success (caller owns
+    SIGTERM/kill teardown of proc), or {"error": ..., "proc": ...} —
+    proc may be live on the error path and must still be torn down.
+    """
+    import json as _json
+    import threading
+    import urllib.request
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gamesmanmpi_tpu.cli", "serve", db,
+         "--port", "0", "--workers", str(workers),
+         "--control-port", "0"],
+        stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        got: list = []
+        t = threading.Thread(
+            target=lambda: got.append(proc.stdout.readline()), daemon=True
+        )
+        t.start()
+        t.join(120.0)
+        if not got or not got[0]:
+            return {"error": "fleet supervisor printed no banner",
+                    "proc": proc}
+        banner = got[0]
+        port = int(banner.split("http://127.0.0.1:")[1].split(" ")[0])
+        cport = int(banner.split("http://127.0.0.1:")[2].split(" ")[0])
+        ready_deadline = time.monotonic() + 180.0
+        status = {}
+        while time.monotonic() < ready_deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{cport}/healthz", timeout=10
+                ) as resp:
+                    status = _json.loads(resp.read())
+            except OSError:
+                status = {}  # control port not accepting yet — keep polling
+            if status.get("status") == "ok":
+                break
+            time.sleep(0.25)
+        if status.get("status") != "ok":
+            return {"error": f"fleet never became ready: {status}",
+                    "proc": proc}
+        return {"proc": proc, "port": port, "cport": cport,
+                "status": status}
+    except BaseException:
+        # Unanticipated failure (malformed banner, poll crash): the
+        # caller never sees `proc`, so nothing downstream could tear the
+        # fleet down — kill it HERE or it outlives the bench.
+        proc.kill()
+        proc.wait()
+        raise
+
+
 def _serve_bench() -> dict | None:
     """BENCH_SERVE=1: the serving-fleet SLO benchmark (ROADMAP item 3).
 
@@ -320,40 +381,14 @@ def _serve_bench() -> dict | None:
                 artifact["error"] = "export-db failed: " \
                     + export.stderr[-1000:]
                 return artifact
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "gamesmanmpi_tpu.cli", "serve", db,
-                 "--port", "0", "--workers", str(workers),
-                 "--control-port", "0"],
-                stdout=subprocess.PIPE, text=True,
-            )
-            # Bounded banner read: a supervisor that wedges before its
-            # banner must fail the bench into the artifact, not hang it
-            # (every other wait here is deadline-bounded too).
-            got: list = []
-            t = threading.Thread(
-                target=lambda: got.append(proc.stdout.readline()),
-                daemon=True,
-            )
-            t.start()
-            t.join(120.0)
-            if not got or not got[0]:
-                artifact["error"] = "fleet supervisor printed no banner"
+            fleet = _launch_fleet(db, workers)
+            proc = fleet.get("proc")
+            if "error" in fleet:
+                artifact["error"] = fleet["error"]
                 return artifact
-            banner = got[0]
-            port = int(banner.split("http://127.0.0.1:")[1].split(" ")[0])
-            cport = int(banner.split("http://127.0.0.1:")[2].split(" ")[0])
+            port, cport = fleet["port"], fleet["cport"]
             control = f"http://127.0.0.1:{cport}"
-            ready_deadline = time.monotonic() + 180.0
-            status = {}
-            while time.monotonic() < ready_deadline:
-                status = _get_json(control + "/healthz")
-                if status.get("status") == "ok":
-                    break
-                time.sleep(0.25)
-            if status.get("status") != "ok":
-                artifact["error"] = f"fleet never became ready: {status}"
-                return artifact
-            artifact["spawn_mode"] = status.get("spawn_mode")
+            artifact["spawn_mode"] = fleet["status"].get("spawn_mode")
             positions = _db_sample_positions(db)
             killed = {}
 
@@ -438,6 +473,195 @@ def _serve_bench() -> dict | None:
     return artifact
 
 
+def _db_compress_bench() -> dict | None:
+    """BENCH_DB_COMPRESS=1: the compressed-DB ratio + latency benchmark
+    (ROADMAP item 2 / ISSUE 9).
+
+    One solve (child process, checkpointed), exported twice — format v1
+    and block-compressed v2 — then:
+
+    * integrity + **full logical equality**: tools/check_db.py checks
+      the v2 directory and proves it answers every position identically
+      to the v1 export (--same-as: levels, keys, cells — not a sample);
+    * **ratio gate**: whole-DB stored bytes v1/v2 from the real files,
+      gated on BENCH_DB_MIN_RATIO (default 3x, the ROADMAP claim);
+    * **probe latency under load**: each directory serves through a
+      real `serve --workers N` fleet driven by tools/load_gen; the v2
+      p99 is gated on BENCH_DB_SLO_P99_MS (default 250 ms — the
+      BENCH_serve_r07.json SLO must survive decompress-on-probe).
+
+    Runs in the PARENT (jax-free: exports/serving are subprocesses,
+    sampling reads the v1 .npy keys with plain numpy) and must never
+    kill the bench: failures land in the artifact, not as exceptions.
+    The full record writes to BENCH_DB_COMPRESS_OUT
+    (BENCH_db_compress.json); a summary joins the bench record.
+    """
+    if os.environ.get("BENCH_DB_COMPRESS", "0") in ("0", "", "off"):
+        return None
+    import signal
+    import tempfile
+
+    from tools.load_gen import run_load
+
+    spec = os.environ.get("BENCH_DB_GAME", "connect4:w=5,h=4")
+    workers = int(_env_float("BENCH_DB_WORKERS", 2))
+    duration = _env_float("BENCH_DB_SECS", 8.0)
+    conc = int(_env_float("BENCH_DB_CONC", 8))
+    slo_ms = _env_float("BENCH_DB_SLO_P99_MS", 250.0)
+    min_ratio = _env_float("BENCH_DB_MIN_RATIO", 3.0)
+    out_path = os.environ.get("BENCH_DB_COMPRESS_OUT",
+                              "BENCH_db_compress.json")
+    deadline = _env_float("GAMESMAN_BENCH_DEADLINE", 3000.0)
+    artifact = {
+        "game": spec, "workers": workers, "concurrency": conc,
+        "slo_p99_ms": slo_ms, "min_ratio": min_ratio, "ok": False,
+    }
+
+    def _serve_and_load(db: str, positions) -> dict:
+        """Launch a fleet over one DB dir (_launch_fleet), drive
+        load_gen, tear down. -> load record (qps/p50/p99/errors/
+        mismatches/answers)."""
+        fleet = _launch_fleet(db, workers)
+        proc = fleet.get("proc")
+        try:
+            if "error" in fleet:
+                return {"error": fleet["error"]}
+            load = run_load(
+                f"http://127.0.0.1:{fleet['port']}", positions,
+                duration=duration, concurrency=conc,
+            )
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=60)
+            return load
+        finally:
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+    t0 = time.perf_counter()
+    try:
+        with tempfile.TemporaryDirectory(prefix="bench_dbc_") as td:
+            ckpt = os.path.join(td, "ckpt")
+            v1, v2 = os.path.join(td, "v1"), os.path.join(td, "v2")
+            solve = subprocess.run(
+                [sys.executable, "-m", "gamesmanmpi_tpu.cli", spec,
+                 "--checkpoint-dir", ckpt],
+                timeout=deadline, capture_output=True, text=True,
+            )
+            if solve.returncode != 0:
+                artifact["error"] = "solve failed: " + solve.stderr[-1000:]
+                return artifact
+            # Scrub GAMESMAN_DB_COMPRESS for the exports: the A/B is
+            # meaningless unless the v1 arm REALLY writes v1 (the env
+            # knob would silently flip it; v2's explicit --compress
+            # wins either way).
+            export_env = dict(os.environ)
+            export_env.pop("GAMESMAN_DB_COMPRESS", None)
+            for out_dir, extra in ((v1, []), (v2, ["--compress"])):
+                export = subprocess.run(
+                    [sys.executable, "-m", "gamesmanmpi_tpu.cli",
+                     "export-db", spec, "--out", out_dir,
+                     "--from-checkpoint", ckpt, *extra],
+                    timeout=deadline, capture_output=True, text=True,
+                    env=export_env,
+                )
+                if export.returncode != 0:
+                    artifact["error"] = (
+                        f"export-db {extra} failed: " + export.stderr[-1000:]
+                    )
+                    return artifact
+            # Integrity + full v1-equality + per-level stats, in the
+            # jax-capable child (the checker itself is numpy-only but
+            # lives inside the package).
+            stats_json = os.path.join(td, "stats.json")
+            chk = subprocess.run(
+                [sys.executable, os.path.join("tools", "check_db.py"),
+                 v2, "--quiet", "--same-as", v1,
+                 "--stats-json", stats_json],
+                timeout=deadline, capture_output=True, text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            # Distinct gates from one run: --same-as differences print
+            # as "differs from" problem lines, integrity problems as
+            # anything else — an operator triaging the artifact can see
+            # WHICH gate failed without re-running. A checker that died
+            # WITHOUT reporting (import error, usage error, traceback)
+            # proves neither gate: both stay false, never vacuously
+            # true.
+            found = [l for l in chk.stderr.splitlines()
+                     if l.startswith("PROBLEM: ")]
+            reported = chk.returncode == 0 or bool(found)
+            artifact["check_ok"] = reported and not any(
+                "differs from" not in l for l in found
+            )
+            artifact["full_equal"] = reported and not any(
+                "differs from" in l for l in found
+            )
+            if chk.returncode != 0:
+                artifact["error"] = "check_db: " + chk.stderr[-1000:]
+                return artifact
+            with open(stats_json) as fh:
+                stats = json.load(fh)
+            v1_bytes = _dir_bytes(v1)
+            v2_bytes = _dir_bytes(v2)
+            artifact.update({
+                "positions": stats["num_positions"],
+                "levels": len(stats["levels"]),
+                "v1_bytes": v1_bytes,
+                "v2_bytes": v2_bytes,
+                "ratio": v1_bytes / max(v2_bytes, 1),
+                "manifest_ratio": stats["ratio"],
+            })
+            positions = _db_sample_positions(v1)
+            for arm, db in (("v1", v1), ("v2", v2)):
+                load = _serve_and_load(db, positions)
+                load.pop("answers", None)
+                artifact[arm] = {
+                    k: load.get(k)
+                    for k in ("qps", "ok", "p50_ms", "p95_ms", "p99_ms",
+                              "errors", "mismatches", "shed", "dropped",
+                              "error")
+                    if k in load
+                }
+            artifact["ratio_ok"] = artifact["ratio"] >= min_ratio
+            artifact["slo_ok"] = (
+                artifact.get("v2", {}).get("p99_ms", 1e9) <= slo_ms
+            )
+            artifact["ok"] = bool(
+                artifact["ratio_ok"] and artifact["slo_ok"]
+                and artifact["full_equal"]
+                and artifact.get("v1", {}).get("errors", 1) == 0
+                and artifact.get("v2", {}).get("errors", 1) == 0
+                and artifact.get("v1", {}).get("mismatches", 1) == 0
+                and artifact.get("v2", {}).get("mismatches", 1) == 0
+            )
+    except Exception as e:  # noqa: BLE001 - the bench must survive this
+        artifact["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        artifact.setdefault("secs_wall", round(time.perf_counter() - t0, 3))
+        try:
+            with open(out_path, "w") as fh:
+                json.dump(artifact, fh, indent=1)
+            print(
+                f"db-compress bench: wrote {out_path} "
+                f"(ok={artifact['ok']}, "
+                f"ratio={artifact.get('ratio', 0):.2f}x)",
+                file=sys.stderr,
+            )
+        except OSError as e:
+            print(f"db-compress bench: cannot write {out_path}: {e}",
+                  file=sys.stderr)
+    return artifact
+
+
+def _dir_bytes(directory: str) -> int:
+    """Total file bytes under one directory (non-recursive: DB dirs are
+    flat)."""
+    return sum(
+        e.stat().st_size for e in os.scandir(directory) if e.is_file()
+    )
+
+
 def _db_sample_positions(db: str, per_level: int = 64,
                          cap: int = 512) -> list:
     """Sample query positions straight off the DB's key files (plain
@@ -452,6 +676,21 @@ def _db_sample_positions(db: str, per_level: int = 64,
         n = int(keys.shape[0])
         step = max(1, n // per_level)
         positions.extend(int(k) for k in keys[::step][:per_level])
+    if not positions:
+        # Format v2 (block-compressed) directory: no .npy key files to
+        # mmap, but the manifest's per-block first_keys are real
+        # positions and already resident — sample those.
+        try:
+            with open(os.path.join(db, "manifest.json")) as fh:
+                manifest = json.load(fh)
+            for key in sorted(manifest.get("levels", {}), key=int):
+                positions.extend(
+                    int(k) for k in
+                    manifest["levels"][key].get("first_keys", [])
+                    [:per_level]
+                )
+        except (OSError, ValueError):
+            pass  # caller's load run will surface the empty sample
     if len(positions) > cap:
         step = len(positions) // cap
         positions = positions[::step][:cap]
@@ -524,6 +763,20 @@ def main() -> int:
              "positions_per_sec", "secs_wall", "error")
             if k in mp
         }
+    dbc = _db_compress_bench()
+    if dbc is not None:
+        # Summary only — per-level ratios and both load arms live in the
+        # artifact file (BENCH_DB_COMPRESS_OUT).
+        record["db_compress"] = {
+            k: dbc.get(k) for k in
+            ("ratio", "ok", "ratio_ok", "slo_ok", "full_equal",
+             "positions", "v1_bytes", "v2_bytes", "error")
+            if k in dbc
+        }
+        for arm in ("v1", "v2"):
+            if arm in dbc:
+                record["db_compress"][f"{arm}_p99_ms"] = \
+                    dbc[arm].get("p99_ms")
     sv = _serve_bench()
     if sv is not None:
         # Summary only — the full load/chaos record lives in the

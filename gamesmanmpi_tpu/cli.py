@@ -791,6 +791,16 @@ def _db_parser() -> argparse.ArgumentParser:
     )
     pe.add_argument("--overwrite", action="store_true",
                     help="replace an existing DB in --out")
+    pe.add_argument(
+        "--compress",
+        action="store_true",
+        default=None,
+        help="write format v2: block-compressed levels (compress/ — "
+        "entropy-coded keys/cells in independently-decodable blocks, "
+        "per-block index in the manifest; the reader decodes only "
+        "probed blocks through a hot-block cache). Default from "
+        "GAMESMAN_DB_COMPRESS; v1 DBs stay readable forever",
+    )
     pe.add_argument("--jsonl", default=None,
                     help="write per-level export metrics to this JSONL file")
     pe.add_argument("-v", "--verbose", action="store_true",
@@ -920,12 +930,18 @@ def _obs_scope(args):
 def _cmd_export_db(args) -> int:
     from gamesmanmpi_tpu.db import DbFormatError, DbWriter, export_checkpoint
     from gamesmanmpi_tpu.games import get_game
+    from gamesmanmpi_tpu.utils.env import env_bool
 
     try:
         game = get_game(args.game)
     except (KeyError, ValueError) as e:
         print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
         return 2
+    compress = (
+        env_bool("GAMESMAN_DB_COMPRESS", False)
+        if args.compress is None
+        else bool(args.compress)
+    )
     t0 = time.time()
     logger = _build_logger(args)
     with _logger_scope(logger):
@@ -952,6 +968,7 @@ def _cmd_export_db(args) -> int:
                     args.out,
                     overwrite=args.overwrite,
                     logger=logger,
+                    compress=compress,
                 )
             else:
                 # Fresh solve, streamed: each level flows into the writer as
@@ -960,7 +977,8 @@ def _cmd_export_db(args) -> int:
                 from gamesmanmpi_tpu.solve import Solver
 
                 writer = DbWriter(
-                    args.out, game, args.game, overwrite=args.overwrite
+                    args.out, game, args.game, overwrite=args.overwrite,
+                    compress=compress,
                 )
                 try:
                     Solver(
@@ -982,6 +1000,13 @@ def _cmd_export_db(args) -> int:
     print(f"game: {manifest['game']}")
     print(f"levels: {len(manifest['levels'])}")
     print(f"positions: {manifest['num_positions']}")
+    comp = manifest.get("compression")
+    if comp:
+        ratio = comp["raw_bytes"] / max(comp["stored_bytes"], 1)
+        print(
+            f"compressed: {comp['stored_bytes']} bytes "
+            f"({ratio:.2f}x vs raw cells)"
+        )
     print(f"elapsed: {time.time() - t0:.3f}s")
     return 0
 

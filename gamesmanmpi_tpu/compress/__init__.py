@@ -1,0 +1,59 @@
+"""compress: block-compressed storage shared by every on-disk surface.
+
+The subsystem behind ROADMAP item 2 ("Compressed Game Solving",
+PAPERS.md arXiv 2411.07273): a codec registry exploiting the solved-DB
+payload shape (sorted keys, 2-bit value alphabet), block framing with a
+separately-stored per-block index + crc32, and a thread-safe hot-block
+LRU for decompress-on-probe serving. Consumers:
+
+* ``db/`` format v2 — per-level keys/cells as framed block files, index
+  in the checksummed manifest, DbReader decodes only probed blocks;
+* ``utils/checkpoint.py`` — ``GAMESMAN_CKPT_COMPRESS=blocks`` frames
+  every checkpoint/spill npz member behind the existing crc-seal and
+  quarantine machinery (torn block -> BlockCorruptError, a
+  TORN_NPZ_ERRORS ValueError);
+* ``bench.py`` — BENCH_DB_COMPRESS gates ratio + probe-latency SLO.
+
+Pure numpy + stdlib: no jax anywhere in this package (it runs on host
+I/O paths and inside jax-free tools like tools/check_db.py).
+"""
+
+from gamesmanmpi_tpu.compress.blocks import (
+    DEFAULT_BLOCK_POSITIONS,
+    block_bounds,
+    decode_array,
+    decode_block,
+    encode_array,
+    index_offsets,
+    num_blocks,
+    validate_index,
+)
+from gamesmanmpi_tpu.compress.cache import BlockCache
+from gamesmanmpi_tpu.compress.codecs import (
+    CELL_CANDIDATES,
+    CODECS,
+    GENERIC_CANDIDATES,
+    KEY_CANDIDATES,
+    BlockCorruptError,
+    encode_best,
+    get_codec,
+)
+
+__all__ = [
+    "BlockCache",
+    "BlockCorruptError",
+    "CELL_CANDIDATES",
+    "CODECS",
+    "DEFAULT_BLOCK_POSITIONS",
+    "GENERIC_CANDIDATES",
+    "KEY_CANDIDATES",
+    "block_bounds",
+    "decode_array",
+    "decode_block",
+    "encode_array",
+    "encode_best",
+    "get_codec",
+    "index_offsets",
+    "num_blocks",
+    "validate_index",
+]

@@ -1,0 +1,138 @@
+"""BlockCache: bounded, thread-safe LRU over decoded hot blocks.
+
+The serving half of decompress-on-probe (ROADMAP item 2): a compressed
+DB answers a probe by decoding only the block the key lands in, and real
+query traffic is heavily skewed — openings and common midgames hash to a
+small set of hot blocks. Caching those decoded blocks makes the steady
+state cost one searchsorted per probe (the v1 mmap experience) while the
+cold tail pays one ~0.5 ms block decode.
+
+Design:
+
+* **Byte-budget LRU**, not entry-count: blocks are fixed position count
+  but variable decoded size (last-block ragged, keys vs cells width), so
+  the budget that matters for RSS is bytes.
+* **Thread-safe**: the fleet's per-route batcher flush thread, the
+  breaker's half-open re-probe thread, and direct DbReader users may
+  probe concurrently. Lookup/insert hold the lock; *decoding never
+  does* — two threads racing the same cold block both decode (counted
+  as two misses) and the second insert wins, which is strictly cheaper
+  than serializing every cold decode behind one lock.
+* **Per-reader instances**: each DbReader (so each fleet route, and
+  each forked worker after copy-on-write) has its own cache and its own
+  metric series — the per-worker cache behavior is an observable, not
+  an aggregate (tools/obs_report.py folds the per-worker streams).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+
+class BlockCache:
+    """LRU of decoded block payloads, bounded by total decoded bytes."""
+
+    def __init__(self, budget_bytes: int, *, registry=None, labels=None):
+        """labels: metric labels distinguishing THIS cache's series on a
+        shared registry (DbReader passes ``db=<dir name>``). Without
+        them, two caches in one process would share one registry child
+        and the bytes gauge would be last-writer-wins — exactly the
+        multi-route fleet worker shape."""
+        self.budget_bytes = int(budget_bytes)
+        self._lock = threading.Lock()
+        self._map: OrderedDict = OrderedDict()  # guarded-by: _lock
+        self._bytes = 0  # guarded-by: _lock
+        self._hits = 0  # guarded-by: _lock
+        self._misses = 0  # guarded-by: _lock
+        self._evictions = 0  # guarded-by: _lock
+        self._m_hits = self._m_misses = self._m_evictions = None
+        self._m_bytes = None
+        if registry is not None:
+            lbl = dict(labels or {})
+            self._m_hits = registry.counter(
+                "gamesman_db_cache_hits_total",
+                "probes answered from an already-decoded hot block",
+                **lbl,
+            )
+            self._m_misses = registry.counter(
+                "gamesman_db_cache_misses_total",
+                "probes that had to decode a cold block",
+                **lbl,
+            )
+            self._m_evictions = registry.counter(
+                "gamesman_db_cache_evictions_total",
+                "decoded blocks evicted by the byte budget "
+                "(GAMESMAN_DB_CACHE_MB)",
+                **lbl,
+            )
+            self._m_bytes = registry.gauge(
+                "gamesman_db_cache_bytes",
+                "decoded bytes resident in the hot-block cache",
+                **lbl,
+            )
+
+    def get(self, key):
+        """The cached value for key (refreshing recency), or None."""
+        with self._lock:
+            entry = self._map.get(key)
+            if entry is not None:
+                self._map.move_to_end(key)
+                self._hits += 1
+            else:
+                self._misses += 1
+        # Metrics outside the lock: registry children take their own
+        # lock, and nested unrelated locks are how deadlocks start.
+        if entry is not None:
+            if self._m_hits is not None:
+                self._m_hits.inc()
+            return entry[0]
+        if self._m_misses is not None:
+            self._m_misses.inc()
+        return None
+
+    def put(self, key, value, nbytes: int) -> None:
+        """Insert a decoded block (value is opaque to the cache; nbytes
+        is its decoded size for the budget). Oversized values are still
+        admitted and evict everything else — refusing them would make
+        the hottest block of a tiny-budget config permanently cold."""
+        evicted = 0
+        with self._lock:
+            old = self._map.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._map[key] = (value, int(nbytes))
+            self._bytes += int(nbytes)
+            while self._bytes > self.budget_bytes and len(self._map) > 1:
+                _, (_, dropped) = self._map.popitem(last=False)
+                self._bytes -= dropped
+                evicted += 1
+            self._evictions += evicted
+            now_bytes = self._bytes
+        if evicted and self._m_evictions is not None:
+            self._m_evictions.inc(evicted)
+        if self._m_bytes is not None:
+            self._m_bytes.set(now_bytes)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._map.clear()
+            self._bytes = 0
+        if self._m_bytes is not None:
+            self._m_bytes.set(0)
+
+    def stats(self) -> dict:
+        """Point-in-time counters (also exported as gamesman_db_cache_*
+        registry series): hits/misses/evictions/bytes/blocks."""
+        with self._lock:
+            return {
+                "hits": self._hits,
+                "misses": self._misses,
+                "evictions": self._evictions,
+                "bytes": self._bytes,
+                "blocks": len(self._map),
+            }

@@ -1,0 +1,275 @@
+"""Block codecs: typed 1-D numpy arrays <-> compressed bytes.
+
+"Compressed Game Solving" (PAPERS.md, arXiv 2411.07273) observes that
+solved-game databases are orders of magnitude more compressible than
+generic data, because the payload is not generic: keys are *sorted*
+packed bitboards (small, smooth deltas) and cells carry a *tiny value
+alphabet* (2-bit WIN/LOSE/TIE/UNDECIDED) next to a remoteness that
+rarely needs more than one byte. The codecs here exploit exactly that
+structure, with DEFLATE as the entropy stage:
+
+* ``raw``      — identity passthrough. Always wins ties: a block that
+  does not compress must cost zero decode work and zero risk.
+* ``zlib``     — plain DEFLATE of the array bytes; the generic backstop
+  for data with no exploitable shape (edge indices, slot maps).
+* ``keydelta`` — sorted-key transform: first key verbatim + deltas
+  narrowed to the smallest unsigned width that holds the block's
+  maximum, then DEFLATE. Sorted level keys shrink 5-50x because
+  neighboring bitboards share almost all their bits.
+* ``cellpack`` — packed-cell transform: the 2-bit values of four cells
+  share one byte, remoteness is split into its own stream narrowed to
+  min-width (u8 for every real game so far), both DEFLATE'd. This is
+  the value+remoteness entropy coding of the ROADMAP item.
+
+Every codec is **self-checking at the framing layer** (compress/blocks
+stores a crc32 per encoded block) and **deterministic**: encode is pure,
+decode(encode(a)) round-trips bit-exactly, and a codec that cannot
+represent an input (keydelta on unsorted data) returns None instead of
+guessing, so ``encode_best`` falls through to the next candidate.
+
+No jax anywhere in this package: compression runs on the host I/O path
+(DB export, checkpoint seal, decompress-on-probe serving) where pulling
+in a backend would be pure startup cost.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+import numpy as np
+
+
+class BlockCorruptError(ValueError):
+    """An encoded block failed structural validation (bad header, crc
+    mismatch at the framing layer, wrong decoded count). Subclasses
+    ValueError so checkpoint loaders treat it as one more
+    TORN_NPZ_ERRORS shape (quarantine-and-degrade), and DB readers can
+    wrap it in DbFormatError (also a ValueError) for the serving
+    breaker."""
+
+
+def _writable_frombuffer(data: bytes, dtype) -> np.ndarray:
+    # bytes -> writable array with ONE copy (np.frombuffer over immutable
+    # bytes yields a read-only view; loaders hand these arrays to code
+    # that sorts/slices in place).
+    return np.frombuffer(bytearray(data), dtype=dtype)
+
+
+def _min_unsigned_dtype(max_value: int) -> np.dtype:
+    for dt in (np.uint8, np.uint16, np.uint32, np.uint64):
+        if max_value <= np.iinfo(dt).max:
+            return np.dtype(dt)
+    raise BlockCorruptError(f"delta {max_value} exceeds uint64")
+
+
+class RawCodec:
+    """Identity: the passthrough every block can fall back to."""
+
+    name = "raw"
+
+    def encode(self, arr: np.ndarray):
+        return arr.tobytes()
+
+    def decode(self, blob: bytes, dtype, count: int) -> np.ndarray:
+        dtype = np.dtype(dtype)
+        if len(blob) != count * dtype.itemsize:
+            raise BlockCorruptError(
+                f"raw block: {len(blob)} bytes for {count} x {dtype}"
+            )
+        return _writable_frombuffer(blob, dtype)
+
+
+class ZlibCodec:
+    """DEFLATE over the array bytes — the shape-agnostic backstop."""
+
+    name = "zlib"
+
+    def __init__(self, level: int = 6):
+        self.level = level
+
+    def encode(self, arr: np.ndarray):
+        return zlib.compress(arr.tobytes(), self.level)
+
+    def decode(self, blob: bytes, dtype, count: int) -> np.ndarray:
+        dtype = np.dtype(dtype)
+        try:
+            data = zlib.decompress(blob)
+        except zlib.error as e:
+            raise BlockCorruptError(f"zlib block: {e}") from None
+        if len(data) != count * dtype.itemsize:
+            raise BlockCorruptError(
+                f"zlib block: decoded {len(data)} bytes for "
+                f"{count} x {dtype}"
+            )
+        return _writable_frombuffer(data, dtype)
+
+
+class KeyDeltaCodec:
+    """Sorted unsigned keys: first key + min-width deltas + DEFLATE.
+
+    Declines (returns None) for non-integer/unsorted/2-D inputs rather
+    than producing an encoding whose decode could not reproduce them;
+    strictly-ascending is the DB key invariant, but merely
+    non-descending data (checkpoint cells sorted by key, say) encodes
+    fine — only a *descending* pair is unrepresentable.
+    """
+
+    name = "keydelta"
+    _HEADER = struct.Struct("<BQ")  # delta width byte, first key (u64)
+
+    def encode(self, arr: np.ndarray):
+        if arr.dtype.kind != "u" or arr.ndim != 1 or arr.shape[0] == 0:
+            return None
+        if arr.shape[0] > 1 and bool(np.any(arr[1:] < arr[:-1])):
+            return None  # descending somewhere: not delta-codable
+        # Unsigned subtraction is exact here because non-descending was
+        # just established (np.diff on unsorted unsigned data would wrap,
+        # not go negative — hence the explicit check above).
+        deltas = arr[1:] - arr[:-1]
+        width_dt = _min_unsigned_dtype(
+            int(deltas.max()) if deltas.size else 0
+        )
+        payload = zlib.compress(deltas.astype(width_dt).tobytes(), 6)
+        return self._HEADER.pack(width_dt.itemsize, int(arr[0])) + payload
+
+    def decode(self, blob: bytes, dtype, count: int) -> np.ndarray:
+        dtype = np.dtype(dtype)
+        if count == 0:
+            return np.zeros(0, dtype=dtype)
+        if len(blob) < self._HEADER.size:
+            raise BlockCorruptError("keydelta block: truncated header")
+        width, first = self._HEADER.unpack_from(blob)
+        if width not in (1, 2, 4, 8):
+            raise BlockCorruptError(f"keydelta block: delta width {width}")
+        try:
+            data = zlib.decompress(blob[self._HEADER.size:])
+        except zlib.error as e:
+            raise BlockCorruptError(f"keydelta block: {e}") from None
+        deltas = np.frombuffer(data, dtype=np.dtype(f"u{width}"))
+        if deltas.shape[0] != count - 1:
+            raise BlockCorruptError(
+                f"keydelta block: {deltas.shape[0]} deltas for "
+                f"{count} keys"
+            )
+        out = np.empty(count, dtype=np.uint64)
+        out[0] = first
+        np.cumsum(deltas, dtype=np.uint64, out=out[1:])
+        out[1:] += np.uint64(first)
+        return out.astype(dtype, copy=False)
+
+
+class CellPackCodec:
+    """Packed uint32 cells: 2-bit values four-to-a-byte + min-width
+    remoteness stream, each DEFLATE'd (core/codec.py layout: value in
+    the low 2 bits, remoteness in the high 30)."""
+
+    name = "cellpack"
+    _HEADER = struct.Struct("<BI")  # remoteness width byte, value bytes
+
+    def encode(self, arr: np.ndarray):
+        if arr.dtype != np.uint32 or arr.ndim != 1 or arr.shape[0] == 0:
+            return None
+        values = (arr & np.uint32(3)).astype(np.uint8)
+        rem = arr >> np.uint32(2)
+        pad = (-values.shape[0]) % 4
+        if pad:
+            values = np.concatenate([values, np.zeros(pad, np.uint8)])
+        quads = values.reshape(-1, 4)
+        vbytes = (
+            quads[:, 0] | (quads[:, 1] << 2) | (quads[:, 2] << 4)
+            | (quads[:, 3] << 6)
+        ).tobytes()
+        width_dt = _min_unsigned_dtype(int(rem.max()) if rem.size else 0)
+        vblob = zlib.compress(vbytes, 6)
+        rblob = zlib.compress(rem.astype(width_dt).tobytes(), 6)
+        return (
+            self._HEADER.pack(width_dt.itemsize, len(vblob)) + vblob + rblob
+        )
+
+    def decode(self, blob: bytes, dtype, count: int) -> np.ndarray:
+        if np.dtype(dtype) != np.uint32:
+            raise BlockCorruptError(
+                f"cellpack block: cells must be uint32, not {dtype}"
+            )
+        if count == 0:
+            return np.zeros(0, dtype=np.uint32)
+        if len(blob) < self._HEADER.size:
+            raise BlockCorruptError("cellpack block: truncated header")
+        width, vlen = self._HEADER.unpack_from(blob)
+        if width not in (1, 2, 4, 8):
+            raise BlockCorruptError(
+                f"cellpack block: remoteness width {width}"
+            )
+        body = blob[self._HEADER.size:]
+        try:
+            vbytes = zlib.decompress(body[:vlen])
+            rbytes = zlib.decompress(body[vlen:])
+        except zlib.error as e:
+            raise BlockCorruptError(f"cellpack block: {e}") from None
+        packed = np.frombuffer(vbytes, dtype=np.uint8)
+        if packed.shape[0] * 4 < count:
+            raise BlockCorruptError(
+                f"cellpack block: {packed.shape[0] * 4} packed values "
+                f"for {count} cells"
+            )
+        values = np.empty((packed.shape[0], 4), dtype=np.uint32)
+        for j in range(4):
+            values[:, j] = (packed >> (2 * j)) & 3
+        values = values.reshape(-1)[:count]
+        rem = np.frombuffer(rbytes, dtype=np.dtype(f"u{width}"))
+        if rem.shape[0] != count:
+            raise BlockCorruptError(
+                f"cellpack block: {rem.shape[0]} remotenesses for "
+                f"{count} cells"
+            )
+        return (values | (rem.astype(np.uint32) << np.uint32(2))).astype(
+            np.uint32
+        )
+
+
+#: The codec registry: every name a block index may reference. Append-only
+#: by design — a reader must be able to decode every codec any historical
+#: writer recorded, forever (the "v1 stays readable" contract applied to
+#: codecs).
+CODECS = {
+    c.name: c
+    for c in (RawCodec(), ZlibCodec(), KeyDeltaCodec(), CellPackCodec())
+}
+
+#: Candidate orderings by payload shape: the writer tries these in order
+#: and keeps the smallest (raw included, so compression can only win).
+KEY_CANDIDATES = ("keydelta", "zlib")
+CELL_CANDIDATES = ("cellpack", "zlib")
+GENERIC_CANDIDATES = ("zlib",)
+
+
+def get_codec(name: str):
+    codec = CODECS.get(name)
+    if codec is None:
+        raise BlockCorruptError(
+            f"unknown block codec {name!r} — written by a newer version?"
+        )
+    return codec
+
+
+def encode_best(arr: np.ndarray, candidates) -> tuple[str, bytes]:
+    """Encode one block with the smallest of ``candidates``, falling back
+    to raw passthrough whenever compression loses (or every candidate
+    declines). -> (codec name, encoded bytes).
+
+    Raw competes by SIZE (arr.nbytes) without materializing bytes: at
+    export scale the common case is a codec winning (15x on the 5x4
+    board), and copying every raw block just to use it as a yardstick
+    would memcpy the whole DB for nothing. tobytes() runs only when raw
+    actually wins.
+    """
+    best_name, best = None, None
+    best_len = arr.nbytes
+    for name in candidates:
+        blob = get_codec(name).encode(arr)
+        if blob is not None and len(blob) < best_len:
+            best_name, best, best_len = name, blob, len(blob)
+    if best is None:
+        return "raw", arr.tobytes()
+    return best_name, best

@@ -13,6 +13,16 @@ serving process ever mmaps it:
 * cells are uint32, parallel to the keys, and every cell decodes to a
   DECIDED value (an UNDECIDED cell in a solved DB is a solver bug —
   lookups would report found-but-valueless)
+* **format v2** levels additionally prove the block machinery: index
+  structure vs real stream bytes, per-block crc32 + decoded position
+  counts, manifest first_keys vs the decoded blocks — checked
+  block-by-block in O(one block) memory (the same invariant set as v1;
+  the storage changed, the contract did not, and the gate must run on
+  replica nodes sized for the compressed artifact)
+
+``db_stats`` folds the per-level size/ratio table (tools/check_db.py,
+bench BENCH_DB_COMPRESS); ``db_equal`` proves two DBs logically
+identical across storage versions (the compressed-migration gate).
 """
 
 from __future__ import annotations
@@ -23,12 +33,21 @@ import numpy as np
 
 from gamesmanmpi_tpu.utils.env import env_bool
 
+from gamesmanmpi_tpu.compress import (
+    BlockCorruptError,
+    block_bounds,
+    decode_block,
+    index_offsets,
+    num_blocks,
+    validate_index,
+)  # block-streamed v2 checks: O(one block) memory at any DB scale
 from gamesmanmpi_tpu.core.bitops import sentinel_for
 from gamesmanmpi_tpu.core.codec import unpack_cells_np
 from gamesmanmpi_tpu.core.values import UNDECIDED
 from gamesmanmpi_tpu.db.format import (
     DbFormatError,
     file_sha256,
+    level_is_blocked,
     read_manifest,
 )
 
@@ -69,6 +88,15 @@ def check_db(directory, verbose=None) -> list[str]:
                 ok = False
         if not ok:
             continue
+        if level_is_blocked(rec):
+            n = _check_blocked_level(
+                directory, rec, dt, sentinel, tag, problems
+            )
+            if n is not None:
+                total += n
+                if verbose is not None:
+                    verbose(f"{tag}: {n} positions OK (blocked)")
+            continue
         keys = np.load(directory / rec["keys"], mmap_mode="r")
         cells = np.load(directory / rec["cells"], mmap_mode="r")
         if keys.dtype != dt:
@@ -106,6 +134,305 @@ def check_db(directory, verbose=None) -> list[str]:
             f"manifest num_positions {declared} != shard total {total}"
         )
     return problems
+
+
+def _check_blocked_level(directory, rec, dt, sentinel, tag, problems):
+    """Validate one v2 level block-by-block in O(one block) memory —
+    the gate runs on replica nodes sized for the COMPRESSED artifact,
+    so materializing a decoded multi-GB level (as a naive decode-all
+    would) could OOM exactly where this check matters most.
+
+    Per block: crc32 + codec decode + count (decode_block), dtype,
+    in-block strict ascent, cross-boundary ascent against the previous
+    block's last key, the manifest's first_keys router entry, cells
+    parallel/uint32/decided. Plus the structural whole-level checks:
+    index-vs-file sizes, keys-vs-cells counts, manifest count and
+    stored_bytes. Returns the verified position count, or None after
+    appending problems (one per level is enough: the first corrupt
+    block ends the level's scan)."""
+    kindex, cindex = rec.get("keys_blocks"), rec.get("cells_blocks")
+    kpath, cpath = directory / rec["keys"], directory / rec["cells"]
+    try:
+        validate_index(kindex, stream_bytes=kpath.stat().st_size)
+        validate_index(cindex, stream_bytes=cpath.stat().st_size)
+    except (BlockCorruptError, OSError, TypeError) as e:
+        problems.append(f"{tag}: block index invalid: {e}")
+        return None
+    if int(kindex["count"]) != int(cindex["count"]):
+        problems.append(
+            f"{tag}: {kindex['count']} keys vs {cindex['count']} cells "
+            "in the block index"
+        )
+        return None
+    if int(kindex["count"]) != int(rec["count"]):
+        problems.append(
+            f"{tag}: block index holds {kindex['count']} positions, "
+            f"manifest says {rec['count']}"
+        )
+        return None
+    first = [int(k) for k in rec.get("first_keys", [])]
+    if len(first) != num_blocks(kindex):
+        problems.append(
+            f"{tag}: {len(first)} first_keys for "
+            f"{num_blocks(kindex)} blocks"
+        )
+        return None
+    stored = kpath.stat().st_size + cpath.stat().st_size
+    if "stored_bytes" in rec and int(rec["stored_bytes"]) != stored:
+        problems.append(
+            f"{tag}: stored_bytes {rec['stored_bytes']} != {stored}"
+        )
+    koffs, coffs = index_offsets(kindex), index_offsets(cindex)
+    prev_last = None
+    total = 0
+    undecided = 0
+    try:
+        with open(kpath, "rb") as kf, open(cpath, "rb") as cf:
+            for b in range(num_blocks(kindex)):
+                keys, cells = _read_block_pair(
+                    kf, cf, kindex, cindex, koffs, coffs, b
+                )
+                if keys.dtype != dt:
+                    problems.append(
+                        f"{tag}: keys dtype {keys.dtype}, manifest "
+                        f"says {dt}"
+                    )
+                    return None
+                if cells.dtype != np.uint32 or cells.shape != keys.shape:
+                    problems.append(
+                        f"{tag}: block {b} cells are "
+                        f"{cells.dtype}{list(cells.shape)}, expected "
+                        f"uint32[{keys.shape[0]}]"
+                    )
+                    return None
+                if keys.shape[0]:
+                    if int(keys[0]) != first[b]:
+                        problems.append(
+                            f"{tag}: manifest first_keys disagree with "
+                            "the decoded blocks — the probe's block "
+                            "router would misroute"
+                        )
+                        return None
+                    if not np.all(keys[1:] > keys[:-1]) or (
+                        prev_last is not None and not keys[0] > prev_last
+                    ):
+                        problems.append(
+                            f"{tag}: keys not strictly ascending "
+                            f"(block {b})"
+                        )
+                        return None
+                    prev_last = keys[-1]
+                cell_values, _ = unpack_cells_np(cells)
+                undecided += int(
+                    np.count_nonzero(cell_values == UNDECIDED)
+                )
+                total += int(keys.shape[0])
+    except (BlockCorruptError, OSError) as e:
+        problems.append(f"{tag}: block stream invalid: {e}")
+        return None
+    if prev_last is not None and prev_last == sentinel:
+        problems.append(f"{tag}: keys contain the padding sentinel")
+    if undecided:
+        problems.append(f"{tag}: {undecided} UNDECIDED cells")
+    return total
+
+
+def db_stats(directory) -> dict:
+    """Per-level size/ratio summary of a (valid) DB directory, shared by
+    the tools/check_db.py table, bench.py's BENCH_DB_COMPRESS gate, and
+    the serving docs' shipping math. Raises DbFormatError on an
+    unreadable manifest; file-size figures come from disk, ratios from
+    the v2 manifest records (v1 levels report ratio 1.0).
+
+    -> {"version", "num_positions", "raw_bytes", "stored_bytes",
+        "ratio", "levels": [{level, count, raw_bytes, stored_bytes,
+        ratio, codecs}]}
+    """
+    directory = pathlib.Path(directory)
+    manifest = read_manifest(directory)
+    rows = []
+    for key in sorted(manifest["levels"], key=int):
+        rec = manifest["levels"][key]
+        if level_is_blocked(rec):
+            # raw/stored_bytes are optional in the record (check_db
+            # treats them as such — a foreign writer may omit them);
+            # fall back to disk sizes / the dtype arithmetic instead of
+            # KeyError-ing after a clean check.
+            stored = int(rec.get("stored_bytes", sum(
+                (directory / rec[kind]).stat().st_size
+                for kind in ("keys", "cells")
+                if (directory / rec[kind]).exists()
+            )))
+            raw = int(rec.get("raw_bytes", int(rec["count"]) * (
+                np.dtype(manifest["state_dtype"]).itemsize + 4
+            )))
+            codecs = sorted(
+                set(rec["keys_blocks"]["codecs"])
+                | set(rec["cells_blocks"]["codecs"])
+            )
+        else:
+            stored = raw = sum(
+                (directory / rec[kind]).stat().st_size
+                for kind in ("keys", "cells")
+                if (directory / rec[kind]).exists()
+            )
+            codecs = ["none"]
+        rows.append({
+            "level": int(key),
+            "count": int(rec["count"]),
+            "raw_bytes": raw,
+            "stored_bytes": stored,
+            "ratio": raw / stored if stored else 1.0,
+            "codecs": codecs,
+        })
+    raw = sum(r["raw_bytes"] for r in rows)
+    stored = sum(r["stored_bytes"] for r in rows)
+    return {
+        "version": int(manifest["version"]),
+        "num_positions": sum(r["count"] for r in rows),
+        "raw_bytes": raw,
+        "stored_bytes": stored,
+        "ratio": raw / stored if stored else 1.0,
+        "levels": rows,
+    }
+
+
+class _LevelRangeReader:
+    """Uniform `[lo, hi)` access to one level's (keys, cells) across
+    storage versions: v1 slices the mmap (no copy), v2 decodes only the
+    blocks covering the range. Lets db_equal stream a comparison in
+    O(chunk) memory instead of materializing multi-GB decoded levels."""
+
+    def __init__(self, directory, rec):
+        self.count = int(rec["count"])
+        self._blocked = level_is_blocked(rec)
+        if self._blocked:
+            self._kindex = rec["keys_blocks"]
+            self._cindex = rec["cells_blocks"]
+            validate_index(
+                self._kindex,
+                stream_bytes=(directory / rec["keys"]).stat().st_size,
+            )
+            validate_index(
+                self._cindex,
+                stream_bytes=(directory / rec["cells"]).stat().st_size,
+            )
+            self._koffs = index_offsets(self._kindex)
+            self._coffs = index_offsets(self._cindex)
+            self._kf = self._cf = None
+            try:
+                self._kf = open(directory / rec["keys"], "rb")
+                self._cf = open(directory / rec["cells"], "rb")
+            except BaseException:
+                # A half-built reader is never returned to the caller's
+                # close() bookkeeping — release what DID open.
+                self.close()
+                raise
+        else:
+            self._keys = np.load(directory / rec["keys"], mmap_mode="r")
+            self._cells = np.load(directory / rec["cells"], mmap_mode="r")
+
+    def _block(self, b):
+        return _read_block_pair(
+            self._kf, self._cf, self._kindex, self._cindex,
+            self._koffs, self._coffs, b,
+        )
+
+    def range(self, lo, hi):
+        """-> (keys[lo:hi], cells[lo:hi])."""
+        if not self._blocked:
+            return self._keys[lo:hi], self._cells[lo:hi]
+        bp = int(self._kindex["block_positions"])
+        ks, cs = [], []
+        for b in range(lo // bp, (max(hi, lo + 1) - 1) // bp + 1):
+            keys, cells = self._block(b)
+            start, _ = block_bounds(self._kindex, b)
+            a = max(lo - start, 0)
+            z = min(hi - start, keys.shape[0])
+            ks.append(keys[a:z])
+            cs.append(cells[a:z])
+        return np.concatenate(ks), np.concatenate(cs)
+
+    def close(self):
+        if self._blocked:
+            for fh in (self._kf, self._cf):
+                if fh is not None:
+                    fh.close()
+            self._kf = self._cf = None
+
+
+def _read_block_pair(kf, cf, kindex, cindex, koffs, coffs, b):
+    """Seek+read+decode block b of a (keys, cells) .gmb stream pair —
+    the one block-stream access sequence both the streaming checker and
+    _LevelRangeReader share."""
+    kf.seek(int(koffs[b]))
+    keys = decode_block(kindex, b, kf.read(int(koffs[b + 1] - koffs[b])))
+    cf.seek(int(coffs[b]))
+    cells = decode_block(cindex, b, cf.read(int(coffs[b + 1] - coffs[b])))
+    return keys, cells
+
+
+def db_equal(dir_a, dir_b) -> list[str]:
+    """Logical equality of two DBs' solved content — same games, levels,
+    keys, and cells, regardless of storage version. Returns differences
+    (empty = identical); the migration gate that proves a compressed
+    re-export answers every position identically to its v1 twin without
+    sampling."""
+    dir_a, dir_b = pathlib.Path(dir_a), pathlib.Path(dir_b)
+    try:
+        ma, mb = read_manifest(dir_a), read_manifest(dir_b)
+    except DbFormatError as e:
+        return [str(e)]
+    diffs = []
+    for field in ("game", "spec", "state_dtype", "sym"):
+        if ma.get(field) != mb.get(field):
+            diffs.append(
+                f"{field}: {ma.get(field)!r} != {mb.get(field)!r}"
+            )
+    la, lb = set(ma["levels"]), set(mb["levels"])
+    for missing in sorted(la ^ lb, key=int):
+        diffs.append(f"level {missing}: present in only one DB")
+    if diffs:
+        return diffs
+    # Chunked comparison (multiple of the default block size, so v2
+    # sides decode each block once): O(chunk) memory at any DB scale.
+    chunk = 1 << 20
+    for key in sorted(la, key=int):
+        readers = []
+        try:
+            try:
+                ra = _LevelRangeReader(dir_a, ma["levels"][key])
+                readers.append(ra)
+                rb = _LevelRangeReader(dir_b, mb["levels"][key])
+                readers.append(rb)
+            except (BlockCorruptError, OSError, KeyError) as e:
+                diffs.append(f"level {key}: unreadable: {e}")
+                continue
+            if ra.count != rb.count:
+                diffs.append(
+                    f"level {key}: {ra.count} vs {rb.count} positions"
+                )
+                continue
+            for lo in range(0, max(ra.count, 1), chunk):
+                hi = min(lo + chunk, ra.count)
+                if hi <= lo:
+                    break
+                try:
+                    ka, ca = ra.range(lo, hi)
+                    kb, cb = rb.range(lo, hi)
+                except (BlockCorruptError, OSError) as e:
+                    diffs.append(f"level {key}: unreadable: {e}")
+                    break
+                if not np.array_equal(ka, kb):
+                    diffs.append(f"level {key}: keys differ")
+                    break
+                if not np.array_equal(np.asarray(ca), np.asarray(cb)):
+                    diffs.append(f"level {key}: cells differ")
+                    break
+        finally:
+            for r in readers:
+                r.close()
+    return diffs
 
 
 def verify_for_serving(directory, verbose=None) -> bool:

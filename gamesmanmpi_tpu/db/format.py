@@ -12,6 +12,20 @@ defines the immutable directory format both halves of that story share:
       level_NNNN.cells.npy      packed (value, remoteness) uint32 cells
                                 (core/codec.py), parallel to the keys
 
+Format **v2** (ISSUE 9, `export-db --compress`) replaces the per-level
+.npy pair with block-compressed streams the reader decodes on probe:
+
+      level_NNNN.keys.gmb       framed key blocks (compress/blocks):
+                                fixed position-count blocks, each
+                                independently decodable
+      level_NNNN.cells.gmb      framed cell blocks, parallel blocking
+                                — block b of cells scores block b of keys
+
+with the per-block index (codec, length, crc32) and each block's first
+key in the manifest level record, so a probe touches exactly the blocks
+its queries land in. v1 stays readable forever; both versions share
+this manifest, the same probe contract, and the same checker.
+
 Design rules, in order of importance:
 
 * **Immutable once finalized.** The manifest is written last (atomic
@@ -48,6 +62,12 @@ from gamesmanmpi_tpu.core.probe import probe_sorted_np  # noqa: F401
 
 FORMAT_NAME = "gamesman-db"
 FORMAT_VERSION = 1
+#: Format v2 (ISSUE 9): per-level keys/cells stored as block-compressed
+#: streams (compress/blocks framing) with the per-block index in this
+#: manifest; v1 levels are plain mmap-able .npy. Readers speak both,
+#: forever — v1 directories never need re-exporting.
+FORMAT_VERSION_BLOCKS = 2
+SUPPORTED_VERSIONS = (FORMAT_VERSION, FORMAT_VERSION_BLOCKS)
 
 MANIFEST_NAME = "manifest.json"
 
@@ -62,6 +82,21 @@ def level_key_name(level: int) -> str:
 
 def level_cell_name(level: int) -> str:
     return f"level_{level:04d}.cells.npy"
+
+
+def level_key_blocks_name(level: int) -> str:
+    """v2: the level's framed key-block stream (compress/blocks)."""
+    return f"level_{level:04d}.keys.gmb"
+
+
+def level_cell_blocks_name(level: int) -> str:
+    """v2: the level's framed cell-block stream."""
+    return f"level_{level:04d}.cells.gmb"
+
+
+def level_is_blocked(rec: dict) -> bool:
+    """True when a manifest level record is block-compressed (v2)."""
+    return "keys_blocks" in rec
 
 
 def file_sha256(path, chunk: int = 1 << 22) -> str:
@@ -101,10 +136,10 @@ def read_manifest(directory) -> dict:
             f"{path}: format {manifest.get('format')!r}, "
             f"expected {FORMAT_NAME!r}"
         )
-    if manifest.get("version") != FORMAT_VERSION:
+    if manifest.get("version") not in SUPPORTED_VERSIONS:
         raise DbFormatError(
             f"{path}: version {manifest.get('version')!r} not supported "
-            f"(reader speaks {FORMAT_VERSION})"
+            f"(reader speaks {', '.join(map(str, SUPPORTED_VERSIONS))})"
         )
     for field in ("game", "spec", "state_dtype", "levels"):
         if field not in manifest:
@@ -169,3 +204,15 @@ def save_npy_hashed(path, arr: np.ndarray) -> str:
         writer = _HashingWriter(fh)
         np.save(writer, arr)
         return writer.h.hexdigest()
+
+
+def save_blocks_hashed(path, blobs) -> str:
+    """Write a framed block stream (compress/blocks.encode_array output)
+    + sha256 of the written bytes in ONE pass — the v2 twin of
+    save_npy_hashed, same export-I/O discipline."""
+    h = hashlib.sha256()
+    with open(path, "wb") as fh:
+        for blob in blobs:
+            h.update(blob)
+            fh.write(blob)
+    return h.hexdigest()

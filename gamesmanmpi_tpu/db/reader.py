@@ -21,6 +21,7 @@ process compiles O(log max-batch) programs, not one per batch size.
 from __future__ import annotations
 
 import math
+import os
 import pathlib
 import time
 
@@ -28,10 +29,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gamesmanmpi_tpu.compress import (
+    BlockCache,
+    BlockCorruptError,
+    decode_block,
+    index_offsets,
+    validate_index,
+)
 from gamesmanmpi_tpu.core.codec import unpack_cells_np
 from gamesmanmpi_tpu.core.values import LOSE, TIE, UNDECIDED, WIN
 from gamesmanmpi_tpu.db.format import (
     DbFormatError,
+    level_is_blocked,
     probe_sorted_np,
     read_manifest,
 )
@@ -39,10 +48,88 @@ from gamesmanmpi_tpu.obs import default_registry
 from gamesmanmpi_tpu.ops.padding import bucket_size, pad_to
 from gamesmanmpi_tpu.resilience import faults
 from gamesmanmpi_tpu.solve.engine import get_kernel, undecided_mask
+from gamesmanmpi_tpu.utils.env import env_int
 
 # Smallest query-kernel capacity: batches are tiny next to frontiers, and
 # every distinct capacity is a compiled program.
 _MIN_QUERY_BUCKET = 256
+
+#: Default hot-block cache budget (GAMESMAN_DB_CACHE_MB): 64 MB holds
+#: ~100 decoded 64Ki-position uint64 key+cell block pairs — the whole
+#: working set of a skewed query mix against a multi-GB level.
+_DEFAULT_CACHE_MB = 64
+
+
+class _BlockedLevel:
+    """One v2 level's probe-side handle: resident block router
+    (first_keys + derived offsets) over an fd read with os.pread, so
+    concurrent flush/breaker/caller threads — and forked fleet workers
+    sharing the parent's fds — never contend on a file position."""
+
+    def __init__(self, directory: pathlib.Path, level: int, rec: dict):
+        self.level = level
+        self.count = int(rec["count"])
+        self.keys_index = rec["keys_blocks"]
+        self.cells_index = rec["cells_blocks"]
+        self.first_keys = np.asarray(
+            rec.get("first_keys", []), dtype=np.uint64
+        )
+        self.keys_fd = self.cells_fd = -1
+        try:
+            self.keys_fd = os.open(directory / rec["keys"], os.O_RDONLY)
+            self.cells_fd = os.open(directory / rec["cells"], os.O_RDONLY)
+            # Validate the index against the real stream sizes at open:
+            # a truncated block file fails HERE (DbFormatError at reader
+            # construction / first touch), not as an out-of-range pread
+            # mid-probe.
+            validate_index(
+                self.keys_index,
+                stream_bytes=os.fstat(self.keys_fd).st_size,
+            )
+            validate_index(
+                self.cells_index,
+                stream_bytes=os.fstat(self.cells_fd).st_size,
+            )
+            if len(self.first_keys) != len(self.keys_index["lengths"]):
+                raise BlockCorruptError(
+                    f"level {level}: {len(self.first_keys)} first_keys "
+                    f"for {len(self.keys_index['lengths'])} blocks"
+                )
+        except BaseException:
+            self.close()
+            raise
+        self.keys_offsets = index_offsets(self.keys_index)
+        self.cells_offsets = index_offsets(self.cells_index)
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self.first_keys)
+
+    def read_block(self, b: int):
+        """Decode block b -> (keys, cells) arrays (crc-verified)."""
+        kb = os.pread(
+            self.keys_fd,
+            int(self.keys_offsets[b + 1] - self.keys_offsets[b]),
+            int(self.keys_offsets[b]),
+        )
+        cb = os.pread(
+            self.cells_fd,
+            int(self.cells_offsets[b + 1] - self.cells_offsets[b]),
+            int(self.cells_offsets[b]),
+        )
+        return (
+            decode_block(self.keys_index, b, kb),
+            decode_block(self.cells_index, b, cb),
+        )
+
+    def close(self) -> None:
+        for fd in (self.keys_fd, self.cells_fd):
+            if fd >= 0:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+        self.keys_fd = self.cells_fd = -1
 
 
 def _canon_builder(game):
@@ -121,6 +208,27 @@ class DbReader:
             int(k): rec for k, rec in self.manifest["levels"].items()
         }
         self._arrays: dict = {}
+        self._blocked: dict = {}
+        self._cache = None
+        self._m_decode_secs = None
+        if any(level_is_blocked(rec) for rec in self._levels.values()):
+            # Decompress-on-probe state (format v2): hot-block LRU +
+            # decode-latency series. Per-reader on purpose — each fleet
+            # route (and each forked worker, after copy-on-write) gets
+            # its own budget and its own observable cache behavior.
+            # db label: a multi-route fleet worker holds one reader per
+            # route on ONE registry — without it the per-reader series
+            # would collapse into a single shared child.
+            self._cache = BlockCache(
+                env_int("GAMESMAN_DB_CACHE_MB", _DEFAULT_CACHE_MB) << 20,
+                registry=reg, labels={"db": self.dir.name},
+            )
+            self._m_decode_secs = reg.histogram(
+                "gamesman_db_block_decode_seconds",
+                "wall seconds decoding one cold (keys, cells) block pair "
+                "on the probe path (cache misses only)",
+                db=self.dir.name,
+            )
         if verify:
             from gamesmanmpi_tpu.db.check import check_db
 
@@ -155,9 +263,42 @@ class DbReader:
             pair = self._arrays[level] = (keys, cells)
         return pair
 
+    def _blocked_level(self, level: int) -> _BlockedLevel:
+        """The v2 probe handle of one level, opened on first touch.
+        Lock-free under concurrent probes: a race opens two handles and
+        the setdefault loser closes its fds — strictly cheaper than
+        serializing every first touch behind a lock."""
+        bl = self._blocked.get(level)
+        if bl is None:
+            try:
+                fresh = _BlockedLevel(
+                    self.dir, level, self._levels[level]
+                )
+            except (BlockCorruptError, OSError) as e:
+                raise DbFormatError(
+                    f"{self.dir}: level {level} block stream unreadable: "
+                    f"{e}"
+                ) from e
+            bl = self._blocked.setdefault(level, fresh)
+            if bl is not fresh:
+                fresh.close()
+        return bl
+
+    def cache_stats(self):
+        """Hot-block cache counters (dict), or None for a v1 DB — the
+        serving batcher rides these on its serve_batch records so
+        per-worker cache behavior lands in the JSONL stream."""
+        return None if self._cache is None else self._cache.stats()
+
     def close(self) -> None:
-        """Drop the mmaps (they also die with the reader)."""
+        """Drop the mmaps and decoded blocks, close block-stream fds
+        (everything also dies with the reader)."""
         self._arrays.clear()
+        for bl in self._blocked.values():
+            bl.close()
+        self._blocked.clear()
+        if self._cache is not None:
+            self._cache.clear()
 
     def __enter__(self):
         return self
@@ -228,8 +369,13 @@ class DbReader:
             rec = self._levels.get(int(lv))
             if rec is None:
                 continue
-            keys, cells = self._level_arrays(int(lv))
             sel = np.nonzero(real & (levels == lv))[0]
+            if level_is_blocked(rec):
+                self._probe_blocked_level(
+                    int(lv), canon, sel, values, remoteness, found
+                )
+                continue
+            keys, cells = self._level_arrays(int(lv))
             idx, hit = probe_sorted_np(keys, canon[sel])
             hsel = sel[hit]
             if hsel.size:
@@ -248,6 +394,54 @@ class DbReader:
         self._m_page_touches.inc(pages)
         self._m_probe_secs.observe(time.perf_counter() - t0)
         return values, remoteness, found
+
+    def _probe_blocked_level(self, lv: int, canon, sel, values,
+                             remoteness, found) -> None:
+        """Decompress-on-probe for one v2 level: route each query to its
+        block by first_keys, decode only the touched blocks (hot-block
+        LRU first), then the same searchsorted-confirm as v1 inside the
+        decoded block. Corruption discovered mid-probe (torn block, crc
+        mismatch) raises DbFormatError so the serving breaker counts a
+        reader fault instead of a wrong answer going out."""
+        bl = self._blocked_level(lv)
+        if bl.num_blocks == 0 or sel.size == 0:
+            return
+        q = canon[sel]
+        # side="right" - 1: the block whose first key is <= q. Queries
+        # below the level's first key clip to block 0, where the
+        # equality confirm rejects them (same sentinel-free argument as
+        # probe_sorted_np).
+        bids = np.searchsorted(
+            bl.first_keys, q.astype(np.uint64, copy=False), side="right"
+        ) - 1
+        np.clip(bids, 0, bl.num_blocks - 1, out=bids)
+        for b in np.unique(bids):
+            pair = self._cache.get((lv, int(b)))
+            if pair is None:
+                t0 = time.perf_counter()
+                try:
+                    pair = bl.read_block(int(b))
+                except (BlockCorruptError, OSError) as e:
+                    raise DbFormatError(
+                        f"{self.dir}: level {lv} block {int(b)} "
+                        f"unreadable: {e}"
+                    ) from e
+                self._m_decode_secs.observe(time.perf_counter() - t0)
+                self._cache.put(
+                    (lv, int(b)), pair,
+                    pair[0].nbytes + pair[1].nbytes,
+                )
+            bkeys, bcells = pair
+            bsel = sel[bids == b]
+            idx, hit = probe_sorted_np(
+                bkeys, canon[bsel].astype(bkeys.dtype, copy=False)
+            )
+            hsel = bsel[hit]
+            if hsel.size:
+                v, r = unpack_cells_np(bcells[idx[hit]])
+                values[hsel] = v
+                remoteness[hsel] = r
+                found[hsel] = True
 
     def lookup_best(self, queries):
         """lookup + the optimal child of each decided, non-terminal query.

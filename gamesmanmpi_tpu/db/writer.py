@@ -25,18 +25,29 @@ import pathlib
 
 import numpy as np
 
+from gamesmanmpi_tpu.compress import (
+    CELL_CANDIDATES,
+    DEFAULT_BLOCK_POSITIONS,
+    KEY_CANDIDATES,
+    encode_array,
+)
 from gamesmanmpi_tpu.core.bitops import sentinel_for
 from gamesmanmpi_tpu.core.codec import pack_cells_np
 from gamesmanmpi_tpu.core.values import MAX_REMOTENESS
 from gamesmanmpi_tpu.db.format import (
     FORMAT_NAME,
     FORMAT_VERSION,
+    FORMAT_VERSION_BLOCKS,
     DbFormatError,
+    level_cell_blocks_name,
     level_cell_name,
+    level_key_blocks_name,
     level_key_name,
+    save_blocks_hashed,
     save_npy_hashed,
     write_manifest,
 )
+from gamesmanmpi_tpu.utils.env import env_int
 
 
 class DbWriter:
@@ -47,7 +58,22 @@ class DbWriter:
     """
 
     def __init__(self, directory, game, spec: str, *,
-                 overwrite: bool = False):
+                 overwrite: bool = False, compress: bool = False,
+                 block_positions: int | None = None):
+        """compress=True writes format v2: each level's keys/cells as
+        independently-decodable blocks (compress/) with the per-block
+        index in the manifest. block_positions overrides the block
+        size (positions per block; default GAMESMAN_DB_BLOCK)."""
+        self.compress = bool(compress)
+        self.block_positions = int(
+            block_positions
+            if block_positions is not None
+            else env_int("GAMESMAN_DB_BLOCK", DEFAULT_BLOCK_POSITIONS)
+        )
+        if self.compress and self.block_positions <= 0:
+            raise DbFormatError(
+                f"block size must be positive, got {self.block_positions}"
+            )
         self.final_dir = pathlib.Path(directory)
         self.dir = self.final_dir
         if (self.final_dir / "manifest.json").exists():
@@ -128,6 +154,11 @@ class DbWriter:
                 f"level {level}: {cells.shape[0]} cells for "
                 f"{states.shape[0]} keys"
             )
+        if self.compress:
+            self._levels[level] = self._add_level_blocked(
+                level, states, cells
+            )
+            return
         keys_name = level_key_name(level)
         cells_name = level_cell_name(level)
         self._levels[level] = {
@@ -139,6 +170,40 @@ class DbWriter:
             # would double export I/O per level.
             "keys_sha256": save_npy_hashed(self.dir / keys_name, states),
             "cells_sha256": save_npy_hashed(self.dir / cells_name, cells),
+        }
+
+    def _add_level_blocked(self, level: int, states, cells) -> dict:
+        """Format v2 level write: framed key/cell block streams + the
+        per-block index (and per-block first keys, the probe's block
+        router) destined for the manifest. Keys and cells share one
+        blocking so block b of cells scores block b of keys."""
+        bp = self.block_positions
+        keys_index, key_blobs = encode_array(states, bp, KEY_CANDIDATES)
+        cells_index, cell_blobs = encode_array(cells, bp, CELL_CANDIDATES)
+        keys_name = level_key_blocks_name(level)
+        cells_name = level_cell_blocks_name(level)
+        # One-pass save+hash, same discipline as the v1 path.
+        keys_sha = save_blocks_hashed(self.dir / keys_name, key_blobs)
+        cells_sha = save_blocks_hashed(self.dir / cells_name, cell_blobs)
+        return {
+            "count": int(states.shape[0]),
+            "keys": keys_name,
+            "cells": cells_name,
+            "keys_sha256": keys_sha,
+            "cells_sha256": cells_sha,
+            "keys_blocks": keys_index,
+            "cells_blocks": cells_index,
+            # Per-block first key: the reader's block router (one
+            # searchsorted over this small resident array finds the only
+            # block a canonical key can live in). JSON holds full uint64
+            # range exactly — Python ints are arbitrary precision.
+            "first_keys": [
+                int(states[b]) for b in range(0, states.shape[0], bp)
+            ],
+            "raw_bytes": int(states.nbytes + cells.nbytes),
+            "stored_bytes": int(
+                sum(keys_index["lengths"]) + sum(cells_index["lengths"])
+            ),
         }
 
     def add_level_table(self, level: int, table) -> None:
@@ -162,7 +227,9 @@ class DbWriter:
             raise DbFormatError("no levels written — refusing an empty DB")
         manifest = {
             "format": FORMAT_NAME,
-            "version": FORMAT_VERSION,
+            "version": (
+                FORMAT_VERSION_BLOCKS if self.compress else FORMAT_VERSION
+            ),
             "game": self.game.name,
             "spec": self.spec,
             "state_dtype": np.dtype(self.game.state_dtype).name,
@@ -174,6 +241,16 @@ class DbWriter:
                 str(k): self._levels[k] for k in sorted(self._levels)
             },
         }
+        if self.compress:
+            manifest["compression"] = {
+                "block_positions": self.block_positions,
+                "raw_bytes": sum(
+                    rec["raw_bytes"] for rec in self._levels.values()
+                ),
+                "stored_bytes": sum(
+                    rec["stored_bytes"] for rec in self._levels.values()
+                ),
+            }
         if extra:
             manifest.update(extra)
         write_manifest(self.dir, manifest)
@@ -190,14 +267,15 @@ class DbWriter:
 
 
 def export_result(result, directory, spec: str, *,
-                  overwrite: bool = False) -> dict:
+                  overwrite: bool = False, compress: bool = False) -> dict:
     """One-shot export of an in-memory SolveResult's tables. -> manifest.
 
     For memory-bounded exports of big solves, prefer the streaming hook:
     Solver(game, level_sink=DbWriter(...).add_level_table,
     store_tables=False) — see solve/engine.py.
     """
-    writer = DbWriter(directory, result.game, spec, overwrite=overwrite)
+    writer = DbWriter(directory, result.game, spec, overwrite=overwrite,
+                      compress=compress)
     try:
         for level in sorted(result.levels):
             writer.add_level_table(level, result.levels[level])
@@ -208,7 +286,8 @@ def export_result(result, directory, spec: str, *,
 
 
 def export_checkpoint(checkpointer, game, spec: str, directory, *,
-                      overwrite: bool = False, logger=None) -> dict:
+                      overwrite: bool = False, logger=None,
+                      compress: bool = False) -> dict:
     """Convert an existing --checkpoint-dir into a servable DB. -> manifest.
 
     Consumes classic-engine checkpoints (global level files or sharded
@@ -244,19 +323,26 @@ def export_checkpoint(checkpointer, game, spec: str, directory, *,
             "the DB will answer 'not found' for the gaps",
             file=sys.stderr,
         )
-    writer = DbWriter(directory, game, spec, overwrite=overwrite)
+    writer = DbWriter(directory, game, spec, overwrite=overwrite,
+                      compress=compress)
     try:
         for level in levels:
             table = checkpointer.load_level(level)
             writer.add_level_table(level, table)
             if logger is not None:
-                logger.log(
-                    {
-                        "phase": "export_db",
-                        "level": level,
-                        "n": int(table.states.shape[0]),
-                    }
-                )
+                record = {
+                    "phase": "export_db",
+                    "level": level,
+                    "n": int(table.states.shape[0]),
+                }
+                rec = writer._levels[level]
+                if "stored_bytes" in rec:
+                    # Per-level compression figures ride the export
+                    # stream so tools/obs_report.py can fold a ratio
+                    # column without re-reading the manifest.
+                    record["raw_bytes"] = rec["raw_bytes"]
+                    record["stored_bytes"] = rec["stored_bytes"]
+                logger.log(record)
         return writer.finalize()
     except BaseException:  # incl. KeyboardInterrupt: drop the staging dir
         writer.abort()

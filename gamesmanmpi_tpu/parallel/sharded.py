@@ -645,6 +645,12 @@ class ShardedSolver:
             self.precompile = flag not in ("0", "off", "false")
         #: bytes of edge arrays evicted from device to host (big-run mode).
         self.edges_bytes_spilled = 0
+        #: checkpoint/spill tier I/O accounting: raw array bytes handed to
+        #: the checkpointer vs bytes that actually landed on disk (the
+        #: delta is what GAMESMAN_CKPT_COMPRESS — incl. the block-framed
+        #: ``blocks`` mode — saved this run; see stats()["ckpt_bytes_*"]).
+        self.ckpt_bytes_raw = 0
+        self.ckpt_bytes_stored = 0
         #: number of capacity-overflow retries taken (forward + backward);
         #: the observable for the spill-path tests.
         self.spill_retries = 0
@@ -2258,7 +2264,9 @@ class ShardedSolver:
         for s in range(self.S):
             rows = self._shard_rows(rec, s)
             if rows is not None:
-                self.checkpointer.save_forward_level_shard(k, s, rows)
+                self._count_ckpt_bytes(
+                    self.checkpointer.save_forward_level_shard(k, s, rows)
+                )
         self._sync_processes(f"forward_level_{k}_shards_written")
         if jax.process_index() == 0:
             self.checkpointer.finish_forward_level(
@@ -2305,12 +2313,26 @@ class ShardedSolver:
         for s, states in ss.items():
             n = int(rec.counts[s])
             cells = pack_cells_np(sv[s][:n], sr[s][:n])
-            self.checkpointer.save_level_shard(k, s, states[:n], cells)
+            self._count_ckpt_bytes(
+                self.checkpointer.save_level_shard(k, s, states[:n], cells)
+            )
         self._sync_processes(f"level_{k}_shards_written")
         if jax.process_index() == 0:
             self.checkpointer.finish_level_shards(
                 k, self.S, ranks=self._shard_ranks()
             )
+
+    def _count_ckpt_bytes(self, sizes) -> None:
+        """Fold one checkpoint write's (raw, stored) byte pair into the
+        run totals (stats ckpt_bytes_raw/ckpt_bytes_stored). The pair is
+        an optional accounting hint: wrapped/stubbed checkpointers (the
+        resume tests' recording shims) may return None — skip, don't
+        crash a solve over bookkeeping."""
+        if not sizes:
+            return
+        raw, stored = sizes
+        self.ckpt_bytes_raw += int(raw)
+        self.ckpt_bytes_stored += int(stored)
 
     @staticmethod
     def _rows_of(arr, s: int):
@@ -2344,7 +2366,9 @@ class ShardedSolver:
             e = self._rows_of(rec.eidx, s)
             sl = self._rows_of(rec.slot, s)
             if e is not None and sl is not None:
-                self.checkpointer.save_edges_shard(k, s, e, sl)
+                self._count_ckpt_bytes(
+                    self.checkpointer.save_edges_shard(k, s, e, sl)
+                )
         self._sync_processes(f"edges_level_{k}_shards_written")
         if jax.process_index() == 0:
             slot_len = (rec.slot.cap if isinstance(rec.slot, _HostSpill)
@@ -2463,6 +2487,8 @@ class ShardedSolver:
             "backward": self.backward_mode,
             "backward_edges_levels": self.backward_edges_levels,
             "edges_bytes_spilled": self.edges_bytes_spilled,
+            "ckpt_bytes_raw": self.ckpt_bytes_raw,
+            "ckpt_bytes_stored": self.ckpt_bytes_stored,
             "secs_forward": t_forward,
             "secs_backward": t_total - t_forward,
             "secs_total": t_total,

@@ -474,14 +474,31 @@ class Batcher:
             self._m_batch_size.observe(int(states.shape[0]))
             self._m_batch_secs.observe(secs)
             if self.logger is not None:
-                self.logger.log(
-                    {
-                        "phase": "serve_batch",
-                        "batch_size": int(states.shape[0]),
-                        "requests": len(batch),
-                        "secs": secs,
-                    }
-                )
+                record = {
+                    "phase": "serve_batch",
+                    "batch_size": int(states.shape[0]),
+                    "requests": len(batch),
+                    "secs": secs,
+                }
+                # getattr: chaos/unit tests drive the batcher with stub
+                # readers that expose only lookup_best.
+                stats_fn = getattr(self.reader, "cache_stats", None)
+                db_cache = stats_fn() if stats_fn is not None else None
+                if db_cache is not None:
+                    # Compressed-DB route: cumulative hot-block cache
+                    # counters ride every flush record, so the
+                    # per-worker JSONL stream carries the hit-rate
+                    # trajectory (tools/obs_report.py folds the final
+                    # figures into its serve lines). The db name keeps
+                    # routes separable in a multi-DB worker's one
+                    # stream — without it the report could only keep
+                    # the busiest route's counters.
+                    record["db_cache_hits"] = db_cache["hits"]
+                    record["db_cache_misses"] = db_cache["misses"]
+                    db_dir = getattr(self.reader, "dir", None)
+                    if db_dir is not None:
+                        record["db"] = db_dir.name
+                self.logger.log(record)
             off = 0
             for r in batch:
                 n = r.states.shape[0]

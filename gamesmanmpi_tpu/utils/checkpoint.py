@@ -20,13 +20,21 @@ import zlib
 import jax.numpy as jnp
 import numpy as np
 
+from gamesmanmpi_tpu.compress import (
+    CELL_CANDIDATES,
+    DEFAULT_BLOCK_POSITIONS,
+    GENERIC_CANDIDATES,
+    KEY_CANDIDATES,
+    decode_array,
+    encode_array,
+)
 from gamesmanmpi_tpu.core.codec import (
     pack_cells,
     unpack_cells,
     unpack_cells_np,
 )
 from gamesmanmpi_tpu.resilience import faults
-from gamesmanmpi_tpu.utils.env import env_str
+from gamesmanmpi_tpu.utils.env import env_int, env_str
 
 
 class CorruptCheckpointError(ValueError):
@@ -67,8 +75,37 @@ def _verify_enabled() -> bool:
     )
 
 
-def _savez(path, **arrays) -> None:
-    """Atomic npz write: tmp + os.replace; compressed below ~64 MB.
+#: npz member name of the block-framing metadata (GAMESMAN_CKPT_COMPRESS=
+#: blocks): JSON bytes mapping each framed member to its block index.
+#: Double-underscored so it can never collide with a real array name
+#: (states/cells/eidx/slot/level_NNNN...).
+BLOCKS_META_MEMBER = "__blocks__"
+
+
+def _block_candidates(name: str, arr: np.ndarray):
+    """Codec candidates by member shape (compress/codecs): sorted state
+    arrays delta-code, packed uint32 cells split value/remoteness, and
+    everything else (edge indices, slot maps) gets the DEFLATE backstop
+    — raw passthrough always competes, so a pathological member can only
+    tie, never lose."""
+    if arr.dtype == np.uint32 and name.startswith("cells"):
+        return CELL_CANDIDATES
+    if arr.dtype.kind == "u":
+        # states / frontier levels / keys: sorted by the engine's
+        # invariants; keydelta declines gracefully if one is not.
+        return KEY_CANDIDATES
+    return GENERIC_CANDIDATES
+
+
+def _savez(path, allow_block_framing=True, **arrays) -> tuple[int, int]:
+    """Atomic npz write: tmp + os.replace. -> (raw bytes, stored bytes).
+
+    allow_block_framing=False pins the PLAIN npz layout regardless of
+    GAMESMAN_CKPT_COMPRESS=blocks: user-facing artifacts (``--table-out``
+    tables via save_result_npz/save_table_npz) are consumed by plain
+    np.load outside this repo, and a checkpoint knob must never silently
+    change their format (framed members would read as uint8 bytes, not
+    states). zip-level DEFLATE still applies — np.load understands it.
 
     Atomicity (ADVICE r5): resumed runs RE-save levels whose files already
     exist while the manifest still seals them — a death mid-overwrite
@@ -77,10 +114,23 @@ def _savez(path, **arrays) -> None:
     prefix. The tmp name is per-writer (pid), same discipline as the
     manifest's.
 
-    Compression: small-game checkpoints compress well and stay tidy; at
-    big-run scale the payload is high-entropy packed bitboards where zlib
-    costs ~50 MB/s/core for single-digit-percent savings — raw npz writes
-    at disk speed. Override with GAMESMAN_CKPT_COMPRESS=0/1.
+    Compression (GAMESMAN_CKPT_COMPRESS):
+
+    * ``auto`` (default) — np.savez_compressed below ~64 MB, raw npz
+      above: small-game checkpoints stay tidy, big-run payloads write at
+      disk speed (zlib over high-entropy packed bitboards costs
+      ~50 MB/s/core for single-digit savings).
+    * ``0``/``1`` — force raw / force zip-level DEFLATE.
+    * ``blocks`` — the ISSUE 9 format: each 1-D member is framed into
+      independently-decodable blocks (compress/blocks — keydelta for
+      sorted states, cellpack for packed cells, raw when compression
+      loses) inside an UNCOMPRESSED npz, with the per-member index in a
+      ``__blocks__`` JSON member. Loaders go through :func:`_loadz`,
+      which decodes transparently; a torn/bit-rotted block raises
+      BlockCorruptError (a ValueError — already in TORN_NPZ_ERRORS), so
+      the quarantine-and-degrade resume paths treat compressed
+      corruption exactly like v1 torn files. Plain npz files keep
+      loading regardless of the knob (resume across a flag flip works).
     """
     total = sum(a.nbytes for a in arrays.values())
     flag = env_str("GAMESMAN_CKPT_COMPRESS", "auto")
@@ -97,13 +147,102 @@ def _savez(path, **arrays) -> None:
         path = path.with_name(path.name + ".npz")
     tmp = path.with_suffix(f".{os.getpid()}.tmp.npz")
     try:
-        if compress:
+        if flag == "blocks" and not allow_block_framing:
+            compress = total < (64 << 20)  # the "auto" contract
+        if flag == "blocks" and allow_block_framing:
+            members, meta = {}, {}
+            bp = env_int("GAMESMAN_DB_BLOCK", DEFAULT_BLOCK_POSITIONS)
+            if bp <= 0:
+                # Warn-and-default (the env-knob degradation contract):
+                # a nonsensical block size must not kill a multi-hour
+                # solve at its FIRST checkpoint seal. DbWriter validates
+                # the same knob at construction; checkpoint writes have
+                # no construction moment, so degrade here.
+                import warnings
+
+                warnings.warn(
+                    f"GAMESMAN_DB_BLOCK={bp} is not positive; using "
+                    f"{DEFAULT_BLOCK_POSITIONS}"
+                )
+                bp = DEFAULT_BLOCK_POSITIONS
+            for name, a in arrays.items():
+                arr = np.asarray(a)
+                if arr.ndim != 1 or arr.dtype.hasobject:
+                    members[name] = arr  # stored plain, absent from meta
+                    continue
+                index, blobs = encode_array(arr, bp, _block_candidates(
+                    name, arr
+                ))
+                members[name] = np.frombuffer(
+                    b"".join(blobs), dtype=np.uint8
+                )
+                meta[name] = index
+            members[BLOCKS_META_MEMBER] = np.frombuffer(
+                json.dumps(meta).encode(), dtype=np.uint8
+            )
+            # Uncompressed zip: the payload is already entropy-coded
+            # per block; zipping it again costs CPU for ~nothing.
+            np.savez(tmp, **members)
+        elif compress:
             np.savez_compressed(tmp, **arrays)
         else:
             np.savez(tmp, **arrays)
+        stored = tmp.stat().st_size
         os.replace(tmp, path)
+        return total, stored
     finally:
         tmp.unlink(missing_ok=True)
+
+
+class _BlockedNpzView:
+    """Dict-like view over a block-framed npz (the ``blocks`` flavor of
+    _savez): same ``files`` / ``[]`` / context-manager surface as
+    np.load's NpzFile, decoding framed members on access. Corrupt blocks
+    raise BlockCorruptError (ValueError) from ``[]`` — exactly where a
+    torn plain npz raises — so every TORN_NPZ_ERRORS consumer degrades
+    identically for both storage flavors."""
+
+    def __init__(self, z, meta: dict):
+        self._z = z
+        self._meta = meta
+
+    @property
+    def files(self):
+        return [n for n in self._z.files if n != BLOCKS_META_MEMBER]
+
+    def __getitem__(self, name):
+        raw = self._z[name]
+        index = self._meta.get(name)
+        if index is None:
+            return raw
+        return decode_array(index, raw.tobytes())
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._z.close()
+        return False
+
+    def close(self):
+        self._z.close()
+
+
+def _loadz(path):
+    """np.load for checkpoint npz files, transparent to block framing:
+    plain npz returns as-is; a ``__blocks__`` member returns the
+    decoding view. The single load door for every checkpoint/spill
+    consumer — which is what makes the compressed format invisible to
+    the resume/quarantine machinery above it."""
+    z = np.load(path)
+    if BLOCKS_META_MEMBER not in z.files:
+        return z
+    try:
+        meta = json.loads(bytes(z[BLOCKS_META_MEMBER]))
+    except (ValueError, KeyError):
+        z.close()
+        raise  # ValueError: a TORN_NPZ_ERRORS member — degrade as torn
+    return _BlockedNpzView(z, meta)
 
 
 class LevelCheckpointer:
@@ -342,7 +481,7 @@ class LevelCheckpointer:
             except CorruptCheckpointError:
                 self.quarantine_level(level)
                 raise
-            with np.load(path) as z:
+            with _loadz(path) as z:
                 states = z["states"]
                 values, remoteness = unpack_cells(jnp.asarray(z["cells"]))
             return LevelTable(
@@ -392,7 +531,7 @@ class LevelCheckpointer:
         return sorted(self.load_manifest().get("dense_levels", []))
 
     def load_dense_level(self, level: int) -> np.ndarray:
-        with np.load(self.dir / f"dense_{level:04d}.npz") as z:
+        with _loadz(self.dir / f"dense_{level:04d}.npz") as z:
             return z["cells"]
 
     # ------------------------------------------------- sharded (per-shard)
@@ -405,8 +544,13 @@ class LevelCheckpointer:
     def _shard_level_path(self, level: int, shard: int) -> pathlib.Path:
         return self.dir / f"level_{level:04d}.shard_{shard:04d}.npz"
 
-    def save_level_shard(self, level: int, shard: int, states, cells) -> None:
-        _savez(
+    def save_level_shard(self, level: int, shard: int, states,
+                         cells) -> tuple[int, int]:
+        """-> (raw, stored) bytes — the sharded engine accumulates them
+        into its ckpt_bytes_* stats so an operator can see what the
+        spill/checkpoint tier costs (and what ``blocks`` compression
+        saves) without stat-ing the directory."""
+        return _savez(
             self._shard_level_path(level, shard), states=states, cells=cells
         )
 
@@ -445,7 +589,7 @@ class LevelCheckpointer:
         except CorruptCheckpointError:
             self.quarantine_level(level)
             raise
-        with np.load(path) as z:
+        with _loadz(path) as z:
             return z["states"], z["cells"]
 
     def lookup_level_state(self, level: int, state):
@@ -476,7 +620,7 @@ class LevelCheckpointer:
         if cache is not None and cache[0] == cache_key:
             states, cells = cache[1]
         elif cache_key[1] is None:
-            with np.load(path) as z:
+            with _loadz(path) as z:
                 states, cells = z["states"], z["cells"]
         else:
             states, cells = self.load_level_shard(level, cache_key[1])
@@ -510,8 +654,10 @@ class LevelCheckpointer:
     def _edges_path(self, level: int, shard: int) -> pathlib.Path:
         return self.dir / f"edges_{level:04d}.shard_{shard:04d}.npz"
 
-    def save_edges_shard(self, level: int, shard: int, eidx, slot) -> None:
-        _savez(
+    def save_edges_shard(self, level: int, shard: int, eidx,
+                         slot) -> tuple[int, int]:
+        """-> (raw, stored) bytes, like save_level_shard."""
+        return _savez(
             self._edges_path(level, shard),
             eidx=np.asarray(eidx, dtype=np.int32),
             slot=np.asarray(slot, dtype=np.int32),
@@ -534,7 +680,7 @@ class LevelCheckpointer:
 
     def load_edges_shard(self, level: int, shard: int):
         """-> (eidx [S*ecap] int32, slot [cap*M] int32) of one shard."""
-        with np.load(self._edges_path(level, shard)) as z:
+        with _loadz(self._edges_path(level, shard)) as z:
             return z["eidx"], z["slot"]
 
     # Incremental per-(level, shard) forward saves — the sharded analog of
@@ -544,8 +690,9 @@ class LevelCheckpointer:
     # also supports shard-count changes), then deleted.
 
     def save_forward_level_shard(self, level: int, shard: int,
-                                 states) -> None:
-        _savez(
+                                 states) -> tuple[int, int]:
+        """-> (raw, stored) bytes, like save_level_shard."""
+        return _savez(
             self.dir / f"frontier_{level:04d}.shard_{shard:04d}.npz",
             states=np.asarray(states),
         )
@@ -621,7 +768,7 @@ class LevelCheckpointer:
                         f"frontier_{int(k):04d}.shard_{s:04d}.npz"
                     )
                     self._check_crc(path, manifest)
-                    with np.load(path) as z:
+                    with _loadz(path) as z:
                         arrs.append(z["states"])
             except TORN_NPZ_ERRORS:
                 # Torn or crc-mismatching per-rank file (a death between
@@ -676,7 +823,7 @@ class LevelCheckpointer:
         out: dict = {}
         for s in range(num_shards):
             path = self.dir / f"frontiers.shard_{s:04d}.npz"
-            with np.load(path) as z:
+            with _loadz(path) as z:
                 for name in z.files:
                     k = int(name.split("_")[1])
                     out.setdefault(k, [None] * num_shards)[s] = z[name]
@@ -723,7 +870,7 @@ class LevelCheckpointer:
             path = self.dir / f"frontier_{int(k):04d}.npz"
             try:
                 self._check_crc(path)
-                with np.load(path) as z:
+                with _loadz(path) as z:
                     out[int(k)] = z["states"]
             except TORN_NPZ_ERRORS:
                 self._quarantine_frontier(int(k))
@@ -766,7 +913,7 @@ class LevelCheckpointer:
                 try:
                     self._check_crc(path)
                     out = {}
-                    with np.load(path) as z:
+                    with _loadz(path) as z:
                         for name in z.files:
                             out[int(name.split("_")[1])] = z[name]
                     return out
@@ -796,7 +943,12 @@ class LevelCheckpointer:
 
 
 def save_table_npz(path: str, table: dict) -> None:
-    """Dump a host-solve table ({pos: (value, remoteness)}) as one .npz."""
+    """Dump a host-solve table ({pos: (value, remoteness)}) as one .npz.
+
+    Always PLAIN npz (allow_block_framing=False): ``--table-out`` output
+    is a user-facing artifact read by plain np.load downstream — the
+    checkpoint compression knob must not reshape it.
+    """
     states = np.array(sorted(table), dtype=np.uint64)
     values = jnp.asarray(
         np.array([table[int(s)][0] for s in states], dtype=np.uint8)
@@ -805,12 +957,14 @@ def save_table_npz(path: str, table: dict) -> None:
         np.array([table[int(s)][1] for s in states], dtype=np.int32)
     )
     _savez(
-        path, states=states, cells=np.asarray(pack_cells(values, rems))
+        path, allow_block_framing=False,
+        states=states, cells=np.asarray(pack_cells(values, rems)),
     )
 
 
 def save_result_npz(path: str, result) -> None:
-    """Dump a SolveResult's full table as one .npz (packed cells per level)."""
+    """Dump a SolveResult's full table as one .npz (packed cells per
+    level). Plain npz always — see save_table_npz."""
     arrays = {}
     for level, table in result.levels.items():
         cells = np.asarray(
@@ -818,4 +972,4 @@ def save_result_npz(path: str, result) -> None:
         )
         arrays[f"states_{level:04d}"] = table.states
         arrays[f"cells_{level:04d}"] = cells
-    _savez(path, **arrays)
+    _savez(path, allow_block_framing=False, **arrays)

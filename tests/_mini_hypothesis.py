@@ -1,0 +1,173 @@
+"""Drop-in mini property-test runner for boxes without `hypothesis`.
+
+The suite's property tests (test_properties.py, test_pallas_gather.py)
+declare laws with hypothesis' @given/@settings/strategies API. The
+dependency is in pyproject, but this container image does not ship it
+and cannot pip install — which left the two modules as tier-1
+COLLECTION ERRORS (the import died before pytest could even skip).
+
+This shim implements the small strategy subset those tests use —
+integers / sampled_from / booleans / lists / tuples / data — with
+deterministic per-test seeding (crc32 of the test's qualname), so:
+
+* the laws still RUN (50 deterministic examples beats 0 skipped tests),
+* runs are reproducible (no flaky seeds in CI),
+* when real hypothesis is present it is preferred — the test modules
+  fall back here only on ModuleNotFoundError, so richer shrinking and
+  example databases return the moment the dependency exists.
+
+Deliberately NOT implemented: shrinking, @example, assume, profiles.
+A failing example raises with the drawn arguments in the message —
+enough to reproduce (the seed is fixed) without a shrinker.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import random
+import zlib
+
+
+class _Strategy:
+    """A draw rule: example(rng) -> one value."""
+
+    def __init__(self, draw_fn, describe: str):
+        self._draw = draw_fn
+        self._describe = describe
+
+    def example(self, rng: random.Random):
+        return self._draw(rng)
+
+    def __repr__(self):
+        return self._describe
+
+
+class _DataObject:
+    """The st.data() handle: mid-test draws from the same rng stream."""
+
+    def __init__(self, rng: random.Random):
+        self._rng = rng
+
+    def draw(self, strategy: _Strategy):
+        return strategy.example(self._rng)
+
+
+class strategies:
+    """Namespace mirroring `hypothesis.strategies` (the used subset)."""
+
+    @staticmethod
+    def integers(min_value=None, max_value=None) -> _Strategy:
+        lo = -(2**63) if min_value is None else int(min_value)
+        hi = 2**63 - 1 if max_value is None else int(max_value)
+
+        def draw(rng):
+            # Bias toward boundaries: hypothesis finds edge bugs by
+            # shrinking; without a shrinker, sample the edges outright.
+            pick = rng.random()
+            if pick < 0.1:
+                return lo
+            if pick < 0.2:
+                return hi
+            return rng.randint(lo, hi)
+
+        return _Strategy(draw, f"integers({lo}, {hi})")
+
+    @staticmethod
+    def sampled_from(elements) -> _Strategy:
+        pool = list(elements)
+        return _Strategy(
+            lambda rng: pool[rng.randrange(len(pool))],
+            f"sampled_from(<{len(pool)}>)",
+        )
+
+    @staticmethod
+    def booleans() -> _Strategy:
+        return _Strategy(lambda rng: rng.random() < 0.5, "booleans()")
+
+    @staticmethod
+    def lists(elements: _Strategy, min_size: int = 0,
+              max_size: int = 10) -> _Strategy:
+        def draw(rng):
+            size = rng.randint(min_size, max_size)
+            return [elements.example(rng) for _ in range(size)]
+
+        return _Strategy(draw, f"lists({elements!r})")
+
+    @staticmethod
+    def tuples(*parts: _Strategy) -> _Strategy:
+        return _Strategy(
+            lambda rng: tuple(p.example(rng) for p in parts),
+            f"tuples(<{len(parts)}>)",
+        )
+
+    @staticmethod
+    def data() -> _Strategy:
+        # example() is handed the rng by the runner; the DataObject
+        # draws from the SAME stream so a test's whole example sequence
+        # replays from one seed.
+        return _Strategy(lambda rng: _DataObject(rng), "data()")
+
+
+st = strategies
+
+
+def settings(**config):
+    """Records max_examples etc. on the function; order-agnostic with
+    @given (hypothesis allows either stacking order)."""
+
+    def deco(fn):
+        fn._mini_settings = dict(config)
+        return fn
+
+    return deco
+
+
+def given(**named_strategies):
+    """Run the test once per generated example (max_examples, default
+    50), deterministically seeded per test so failures reproduce."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            conf = (
+                getattr(wrapper, "_mini_settings", None)
+                or getattr(fn, "_mini_settings", None)
+                or {}
+            )
+            examples = int(conf.get("max_examples", 50))
+            rng = random.Random(
+                zlib.crc32(fn.__qualname__.encode()) & 0xFFFFFFFF
+            )
+            for i in range(examples):
+                drawn = {
+                    name: strat.example(rng)
+                    for name, strat in named_strategies.items()
+                }
+                try:
+                    fn(*args, **drawn, **kwargs)
+                except Exception as e:
+                    raise AssertionError(
+                        f"falsifying example #{i + 1} "
+                        f"(mini-hypothesis, seeded): "
+                        + ", ".join(
+                            f"{k}={v!r}" for k, v in drawn.items()
+                            if not isinstance(v, _DataObject)
+                        )
+                    ) from e
+
+        # Hide the generated parameters from pytest's fixture
+        # resolution: functools.wraps leaves __wrapped__ pointing at the
+        # original function, whose (states, shards, ...) parameters
+        # pytest would otherwise demand as fixtures. The surviving
+        # signature is whatever @given did NOT fill (real fixtures keep
+        # working in mixed tests).
+        del wrapper.__wrapped__
+        original = inspect.signature(fn)
+        wrapper.__signature__ = original.replace(parameters=[
+            p for name, p in original.parameters.items()
+            if name not in named_strategies
+        ])
+        return wrapper
+
+    return deco

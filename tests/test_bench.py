@@ -139,3 +139,39 @@ def test_bench_serve_slo_artifact(tmp_path):
     assert artifact["p99_ms"] > 0
     # The chaos really happened and really healed inside the run.
     assert artifact["killed_worker"] in ("0", "1")
+
+
+@pytest.mark.slow
+def test_bench_db_compress_artifact(tmp_path):
+    """BENCH_DB_COMPRESS=1 (ISSUE 9): the bench additionally solves a
+    board once, exports it v1 AND block-compressed v2, proves the two
+    logically identical (full content, not a sample), gates the
+    whole-DB ratio, and serves BOTH through real fleets under load-gen
+    traffic gating the v2 p99 — stdout stays exactly one JSON line
+    with a db_compress summary, the full A/B lands in
+    BENCH_DB_COMPRESS_OUT."""
+    out = tmp_path / "BENCH_db_compress.json"
+    record, _ = _run_bench({
+        "BENCH_ENGINE": "classic",
+        "BENCH_DB_COMPRESS": "1",
+        # ttt compresses well even at tiny scale; 1.5x keeps the gate
+        # honest without demanding the 5x4 board's 15x in a smoke test.
+        "BENCH_DB_GAME": "tictactoe",
+        "BENCH_DB_MIN_RATIO": "1.5",
+        "BENCH_DB_SECS": "3",
+        "BENCH_DB_CONC": "4",
+        "BENCH_DB_SLO_P99_MS": "2000",
+        "BENCH_DB_COMPRESS_OUT": str(out),
+    })
+    dbc = record["db_compress"]
+    artifact = json.loads(out.read_text())
+    assert dbc["ok"] is True, artifact.get("error")
+    assert dbc["full_equal"] is True
+    assert dbc["ratio"] >= 1.5
+    assert dbc["ratio_ok"] is True and dbc["slo_ok"] is True
+    assert artifact["positions"] == 5478
+    for arm in ("v1", "v2"):
+        assert artifact[arm]["errors"] == 0
+        assert artifact[arm]["mismatches"] == 0
+        assert artifact[arm]["p99_ms"] > 0
+    assert artifact["v2_bytes"] < artifact["v1_bytes"]

@@ -1,0 +1,490 @@
+"""compress/ + DB format v2: codecs, framing, cache, decompress-on-probe.
+
+The acceptance axes of ISSUE 9:
+
+* codec laws — every codec round-trips bit-exactly on its shapes,
+  declines (None) off them, and raw passthrough wins when compression
+  loses, so a block can never grow past raw;
+* framing integrity — per-block crc32 catches torn/bit-rotted blocks,
+  index-vs-stream mismatches are structural errors, and every failure
+  is a ValueError (TORN_NPZ_ERRORS / DbFormatError speak it);
+* decompress-on-probe — a v2 DB answers byte-identically to its v1
+  twin through lookup/lookup_best, under a thread-hammered hot-block
+  cache with a tiny budget (eviction correctness), and a corrupted
+  block surfaces as DbFormatError at probe time (the serving breaker's
+  food), never as a wrong answer;
+* checkpoint blocks mode — GAMESMAN_CKPT_COMPRESS=blocks round-trips
+  through _savez/_loadz, v1 npz files keep loading, resume reaches
+  parity, and the sharded engine's spill/checkpoint files compress with
+  byte-parity resume (its ckpt_bytes_* stats expose the saving).
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from gamesmanmpi_tpu.compress import (
+    CELL_CANDIDATES,
+    CODECS,
+    GENERIC_CANDIDATES,
+    KEY_CANDIDATES,
+    BlockCache,
+    BlockCorruptError,
+    decode_array,
+    decode_block,
+    encode_array,
+    encode_best,
+    index_offsets,
+)
+from gamesmanmpi_tpu.db import DbFormatError, DbReader, check_db, export_result
+from gamesmanmpi_tpu.db.check import db_equal, db_stats
+from gamesmanmpi_tpu.games import get_game
+from gamesmanmpi_tpu.obs import MetricsRegistry
+from gamesmanmpi_tpu.solve import Solver
+from gamesmanmpi_tpu.utils.checkpoint import (
+    TORN_NPZ_ERRORS,
+    LevelCheckpointer,
+    _loadz,
+    _savez,
+)
+
+from helpers import REF_GAMES, REPO, load_module
+
+# Smoke tier: fast, compile-light, single-process-safe (see pyproject).
+pytestmark = pytest.mark.smoke
+
+
+# ------------------------------------------------------------------ codecs
+
+
+def _sorted_keys(n, hi, dtype, seed=0):
+    rng = np.random.default_rng(seed)
+    return np.unique(rng.integers(0, hi, n, dtype=dtype))
+
+
+def _cells(n, max_rem=40, seed=1):
+    rng = np.random.default_rng(seed)
+    v = rng.integers(1, 4, n).astype(np.uint32)
+    r = rng.integers(0, max_rem + 1, n).astype(np.uint32)
+    return (v | (r << np.uint32(2))).astype(np.uint32)
+
+
+@pytest.mark.parametrize("dtype,hi", [
+    (np.uint64, 1 << 50), (np.uint64, 1 << 10), (np.uint32, 1 << 31),
+])
+def test_keydelta_roundtrip_and_dtype(dtype, hi):
+    keys = _sorted_keys(5000, hi, dtype)
+    codec = CODECS["keydelta"]
+    blob = codec.encode(keys)
+    assert blob is not None
+    out = codec.decode(blob, keys.dtype, keys.shape[0])
+    assert out.dtype == keys.dtype
+    assert np.array_equal(out, keys)
+
+
+def test_keydelta_declines_unsorted_and_signed():
+    codec = CODECS["keydelta"]
+    assert codec.encode(np.array([5, 3, 9], dtype=np.uint64)) is None
+    assert codec.encode(np.array([1, 2, 3], dtype=np.int32)) is None
+    assert codec.encode(np.zeros(0, dtype=np.uint64)) is None
+    # Equal neighbors are representable (non-descending), huge deltas too.
+    dup = np.array([7, 7, 2**63], dtype=np.uint64)
+    out = codec.decode(codec.encode(dup), np.uint64, 3)
+    assert np.array_equal(out, dup)
+
+
+def test_cellpack_roundtrip_all_widths():
+    codec = CODECS["cellpack"]
+    for max_rem in (0, 200, 70000, 1 << 20):
+        cells = _cells(4097, max_rem=max_rem)
+        out = codec.decode(codec.encode(cells), np.uint32, cells.shape[0])
+        assert np.array_equal(out, cells), max_rem
+    # Non-multiple-of-4 counts round-trip (padding never leaks).
+    for n in (1, 2, 3, 5):
+        cells = _cells(n)
+        assert np.array_equal(
+            codec.decode(codec.encode(cells), np.uint32, n), cells
+        )
+
+
+def test_zlib_and_raw_roundtrip():
+    arr = np.arange(1000, dtype=np.int32)
+    for name in ("zlib", "raw"):
+        codec = CODECS[name]
+        out = codec.decode(codec.encode(arr), np.int32, 1000)
+        assert np.array_equal(out, arr)
+
+
+def test_encode_best_raw_passthrough_when_compression_loses():
+    junk = np.random.default_rng(3).integers(
+        0, 1 << 63, 512, dtype=np.uint64
+    )  # high-entropy unsorted: nothing beats raw
+    name, blob = encode_best(junk, GENERIC_CANDIDATES)
+    assert name == "raw"
+    assert len(blob) == junk.nbytes
+    keys = _sorted_keys(5000, 1 << 30, np.uint64)
+    name, blob = encode_best(keys, KEY_CANDIDATES)
+    assert name == "keydelta"
+    assert len(blob) < keys.nbytes
+
+
+# ----------------------------------------------------------------- framing
+
+
+def test_block_framing_roundtrip_and_ragged_tail():
+    keys = _sorted_keys(10000, 1 << 40, np.uint64)
+    index, blobs = encode_array(keys, 1024, KEY_CANDIDATES)
+    assert len(blobs) == (keys.shape[0] + 1023) // 1024
+    stream = b"".join(blobs)
+    assert np.array_equal(decode_array(index, stream), keys)
+    # Single-block decode agrees with the slice.
+    offs = index_offsets(index)
+    b = len(blobs) - 1  # the ragged tail
+    out = decode_block(index, b, stream[offs[b]:offs[b + 1]])
+    assert np.array_equal(out, keys[b * 1024:])
+
+
+def test_block_crc_catches_corruption_and_index_mismatch():
+    cells = _cells(5000)
+    index, blobs = encode_array(cells, 512, CELL_CANDIDATES)
+    stream = bytearray(b"".join(blobs))
+    stream[len(stream) // 2] ^= 0xFF
+    with pytest.raises(BlockCorruptError, match="crc32"):
+        decode_array(index, bytes(stream))
+    # Truncated stream: the lengths-vs-stream check fires first.
+    with pytest.raises(BlockCorruptError, match="lengths"):
+        decode_array(index, b"".join(blobs)[:-3])
+    # Index lists disagreeing in length are structural corruption.
+    bad = dict(index, crc32=index["crc32"][:-1])
+    with pytest.raises(BlockCorruptError, match="parallel"):
+        decode_array(bad, b"".join(blobs))
+    # BlockCorruptError must ride the checkpoint degrade tuple.
+    assert issubclass(BlockCorruptError, ValueError)
+    with pytest.raises(TORN_NPZ_ERRORS):
+        decode_array(index, bytes(stream))
+
+
+# ------------------------------------------------------------------- cache
+
+
+def test_block_cache_lru_eviction_by_bytes():
+    reg = MetricsRegistry()
+    cache = BlockCache(1000, registry=reg)
+    a, b, c = (np.zeros(50, np.uint64) for _ in range(3))  # 400 B each
+    cache.put("a", a, a.nbytes)
+    cache.put("b", b, b.nbytes)
+    assert cache.get("a") is a  # refreshes recency: b is now LRU
+    cache.put("c", c, c.nbytes)  # 1200 B > 1000: evicts b
+    assert cache.get("b") is None
+    assert cache.get("a") is a and cache.get("c") is c
+    stats = cache.stats()
+    assert stats["evictions"] == 1 and stats["blocks"] == 2
+    assert stats["bytes"] == 800
+    # An oversized value still admits (evicting the rest).
+    big = np.zeros(500, np.uint64)
+    cache.put("big", big, big.nbytes)
+    assert cache.get("big") is big
+    assert cache.stats()["blocks"] == 1
+    snap = reg.snapshot()
+    hits = snap["gamesman_db_cache_hits_total"]["values"][0]["value"]
+    assert hits >= 3
+
+
+def test_block_cache_thread_hammer_accounting():
+    cache = BlockCache(1 << 16)
+    payload = np.zeros(64, np.uint64)
+    errors = []
+
+    def worker(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            for _ in range(500):
+                key = int(rng.integers(0, 32))
+                if cache.get(key) is None:
+                    cache.put(key, payload, payload.nbytes)
+        except Exception as e:  # noqa: BLE001 - collected for the assert
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    stats = cache.stats()
+    assert stats["hits"] + stats["misses"] == 8 * 500
+    assert stats["bytes"] <= (1 << 16)
+
+
+# ------------------------------------------------- DB format v2 (probe)
+
+
+@pytest.fixture(scope="module")
+def ttt_pair(tmp_path_factory):
+    """One ttt solve exported both ways + the oracle: the A/B pair."""
+    from gamesmanmpi_tpu.solve.oracle import oracle_solve
+
+    d = tmp_path_factory.mktemp("v2db")
+    spec = "tictactoe"
+    result = Solver(get_game(spec)).solve()
+    export_result(result, d / "v1", spec)
+    export_result(result, d / "v2", spec, compress=True)
+    _, _, oracle = oracle_solve(load_module(REF_GAMES / "tictactoe.py"))
+    return d, oracle
+
+
+def test_v2_db_checks_clean_equals_v1_and_compresses(ttt_pair):
+    d, _ = ttt_pair
+    assert check_db(d / "v1") == []
+    assert check_db(d / "v2") == []
+    assert db_equal(d / "v1", d / "v2") == []
+    stats = db_stats(d / "v2")
+    assert stats["version"] == 2
+    # ttt keys/cells are highly structured; the whole-DB manifest ratio
+    # must comfortably clear 2x even at this tiny scale.
+    assert stats["ratio"] > 2.0
+    v1_stats = db_stats(d / "v1")
+    assert v1_stats["version"] == 1 and v1_stats["ratio"] == 1.0
+
+
+def test_v2_lookup_matches_oracle_and_v1(ttt_pair, monkeypatch):
+    d, oracle = ttt_pair
+    # A tiny cache budget forces eviction mid-scan: answers must not
+    # depend on residency.
+    monkeypatch.setenv("GAMESMAN_DB_CACHE_MB", "1")
+    positions = np.array(sorted(oracle), dtype=np.uint64)
+    with DbReader(d / "v1") as r1, DbReader(d / "v2") as r2:
+        assert r2.cache_stats() is not None
+        assert r1.cache_stats() is None  # v1: no block cache
+        a = r1.lookup(positions)
+        b = r2.lookup(positions)
+        for x, y in zip(a, b):
+            assert np.array_equal(x, y)
+        assert b[2].all()
+        for i, pos in enumerate(positions):
+            assert (int(b[0][i]), int(b[1][i])) == oracle[int(pos)]
+        # best-move parity through the same decompressing probe.
+        ab = r1.lookup_best(positions[:256])
+        bb = r2.lookup_best(positions[:256])
+        for x, y in zip(ab, bb):
+            assert np.array_equal(x, y)
+        # Misses miss identically.
+        miss = np.array([0b1_000000001, (1 << 18) - 1], dtype=np.uint64)
+        assert not r2.lookup(miss)[2].any()
+        stats = r2.cache_stats()
+        assert stats["hits"] > 0 and stats["misses"] > 0
+
+
+def test_v2_concurrent_probes_stay_exact(ttt_pair, monkeypatch):
+    """The fleet's concurrency shape on one reader: flush + breaker +
+    direct callers probing at once through a small, evicting cache."""
+    d, oracle = ttt_pair
+    monkeypatch.setenv("GAMESMAN_DB_CACHE_MB", "1")
+    positions = np.array(sorted(oracle), dtype=np.uint64)
+    expect = {int(p): oracle[int(p)] for p in positions}
+    errors = []
+    with DbReader(d / "v2") as reader:
+        def hammer(seed):
+            rng = np.random.default_rng(seed)
+            try:
+                for _ in range(10):
+                    qs = rng.choice(positions, size=257, replace=True)
+                    v, r, f = reader.lookup(qs)
+                    assert f.all()
+                    for i, q in enumerate(qs):
+                        assert (int(v[i]), int(r[i])) == expect[int(q)]
+            except Exception as e:  # noqa: BLE001 - collected
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=hammer, args=(s,)) for s in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:3]
+        stats = reader.cache_stats()
+        assert stats["hits"] + stats["misses"] > 0
+
+
+def test_v2_corrupt_block_is_a_reader_fault_not_a_wrong_answer(
+        ttt_pair, tmp_path):
+    import shutil
+
+    d, oracle = ttt_pair
+    bad = tmp_path / "bad"
+    shutil.copytree(d / "v2", bad)
+    manifest = json.loads((bad / "manifest.json").read_text())
+    rec = max(
+        manifest["levels"].values(), key=lambda r: r["stored_bytes"]
+    )
+    victim = bad / rec["cells"]
+    raw = bytearray(victim.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    victim.write_bytes(raw)
+    # check_db: caught both as a sha256 mismatch and a block problem.
+    problems = check_db(bad)
+    assert problems
+    # The reader raises DbFormatError at probe (breaker food), and
+    # db_equal refuses to call the directories identical.
+    positions = np.array(sorted(oracle), dtype=np.uint64)
+    with DbReader(bad) as reader:
+        with pytest.raises(DbFormatError):
+            reader.lookup(positions)
+    assert db_equal(d / "v1", bad) != []
+
+
+def test_v2_check_db_catches_index_and_router_tampering(ttt_pair, tmp_path):
+    import shutil
+
+    from gamesmanmpi_tpu.db.format import file_sha256, write_manifest
+
+    d, _ = ttt_pair
+    # Tamper 1: first_keys shifted — the probe router would misroute.
+    bad = tmp_path / "router"
+    shutil.copytree(d / "v2", bad)
+    manifest = json.loads((bad / "manifest.json").read_text())
+    key = next(k for k, r in manifest["levels"].items()
+               if len(r["first_keys"]))
+    manifest["levels"][key]["first_keys"][0] += 1
+    write_manifest(bad, manifest)
+    assert any("first_keys" in p for p in check_db(bad))
+
+    # Tamper 2: block count that cannot hold the level (index mismatch
+    # exits non-zero through the tool — the satellite contract).
+    bad2 = tmp_path / "count"
+    shutil.copytree(d / "v2", bad2)
+    manifest = json.loads((bad2 / "manifest.json").read_text())
+    rec = manifest["levels"][key]
+    rec["keys_blocks"]["count"] = rec["keys_blocks"]["count"] + 1
+    rec["count"] = rec["count"] + 1
+    write_manifest(bad2, manifest)
+    assert check_db(bad2)
+    tool = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "check_db.py"),
+         str(bad2), "--quiet"],
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+    assert tool.returncode == 1
+    assert "PROBLEM" in tool.stderr
+
+
+def test_check_db_tool_stats_table_and_same_as(ttt_pair, tmp_path):
+    d, _ = ttt_pair
+    stats_json = tmp_path / "stats.json"
+    tool = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "check_db.py"),
+         str(d / "v2"), "--same-as", str(d / "v1"),
+         "--stats-json", str(stats_json)],
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+    assert tool.returncode == 0, tool.stderr
+    assert "TOTAL" in tool.stdout and "format v2" in tool.stdout
+    stats = json.loads(stats_json.read_text())
+    assert stats["ratio"] > 2.0
+    # Logical difference -> non-zero: compare against a different game.
+    other = tmp_path / "other"
+    export_result(
+        Solver(get_game("subtract:total=10,moves=1-2")).solve(),
+        other, "subtract:total=10,moves=1-2",
+    )
+    tool = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "check_db.py"),
+         str(d / "v2"), "--quiet", "--same-as", str(other)],
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+    assert tool.returncode == 1
+    assert "differs" in tool.stderr
+
+
+def test_cli_export_compress_roundtrip(tmp_path, capsys):
+    """export-db --compress end to end, plus GAMESMAN_DB_COMPRESS as the
+    env default (the CLI flag wins when given)."""
+    from gamesmanmpi_tpu.cli import main as cli_main
+
+    spec = "subtract:total=10,moves=1-2"
+    rc = cli_main(["export-db", spec, "--out", str(tmp_path / "db"),
+                   "--compress"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "compressed:" in out
+    assert check_db(tmp_path / "db") == []
+    rc = cli_main(["query", str(tmp_path / "db"), "9"])
+    assert rc == 0
+    assert "value=LOSE remoteness=6" in capsys.readouterr().out
+
+
+# -------------------------------------------- checkpoint blocks mode
+
+
+def test_savez_blocks_roundtrip_and_v1_interop(tmp_path, monkeypatch):
+    states = _sorted_keys(30000, 1 << 44, np.uint64)
+    cells = _cells(states.shape[0])
+    plain = tmp_path / "plain.npz"
+    raw, stored = _savez(plain, states=states, cells=cells)
+    monkeypatch.setenv("GAMESMAN_CKPT_COMPRESS", "blocks")
+    blocked = tmp_path / "blocked.npz"
+    raw_b, stored_b = _savez(blocked, states=states, cells=cells)
+    assert raw_b == states.nbytes + cells.nbytes
+    assert stored_b < raw_b / 2  # structured payload really compresses
+    for path in (plain, blocked):
+        with _loadz(path) as z:
+            assert sorted(z.files) == ["cells", "states"]
+            assert np.array_equal(z["states"], states)
+            assert np.array_equal(z["cells"], cells)
+    # Non-1-D members pass through uncompressed but load identically.
+    m = np.ones((3, 4), np.float32)
+    _savez(tmp_path / "mixed.npz", m=m, states=states)
+    with _loadz(tmp_path / "mixed.npz") as z:
+        assert z["m"].shape == (3, 4)
+        assert np.array_equal(z["states"], states)
+
+
+def test_blocks_checkpoint_resume_parity_and_quarantine(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("GAMESMAN_CKPT_COMPRESS", "blocks")
+    spec = "subtract:total=21,moves=1-2-3"
+    ck = LevelCheckpointer(str(tmp_path / "ck"))
+    first = Solver(get_game(spec), checkpointer=ck).solve()
+    resumed = Solver(
+        get_game(spec), checkpointer=LevelCheckpointer(str(tmp_path / "ck"))
+    ).solve()
+    for lv, t in first.levels.items():
+        r = resumed.levels[lv]
+        assert np.array_equal(t.states, r.states)
+        assert np.array_equal(t.values, r.values)
+        assert np.array_equal(t.remoteness, r.remoteness)
+    # Rot a sealed compressed level: load_level must raise into the
+    # TORN tuple and quarantine, exactly like a v1 file.
+    victim = sorted((tmp_path / "ck").glob("level_*.npz"))[2]
+    raw = bytearray(victim.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    victim.write_bytes(raw)
+    ck2 = LevelCheckpointer(str(tmp_path / "ck"))
+    with pytest.raises(TORN_NPZ_ERRORS):
+        ck2.load_level(2)
+    assert list((tmp_path / "ck").glob("*.corrupt"))
+
+
+def test_ckpt_to_db_compress_flag(tmp_path):
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import ckpt_to_db
+    finally:
+        sys.path.pop(0)
+    spec = "subtract:total=10,moves=1-2"
+    ck = tmp_path / "ck"
+    Solver(get_game(spec), checkpointer=LevelCheckpointer(str(ck))).solve()
+    rc = ckpt_to_db.main(
+        [str(ck), str(tmp_path / "db"), "--game", spec, "--compress"]
+    )
+    assert rc == 0
+    stats = db_stats(tmp_path / "db")
+    assert stats["version"] == 2
+    assert check_db(tmp_path / "db") == []

@@ -84,6 +84,30 @@ def test_inprocess_query_and_db_agree(solved, spec, ref_file):
         assert result.lookup(int(pos)) == (int(values[i]), int(rem[i]))
 
 
+@pytest.mark.parametrize("spec,ref_file", CASES)
+def test_compressed_db_answers_identically(solved, tmp_path, spec,
+                                           ref_file):
+    """Format v2 (ISSUE 9) acceptance, per game: a block-compressed
+    re-export is logically identical to the v1 DB (db_equal — levels,
+    keys, cells) AND answers every reachable position identically
+    through the decompress-on-probe reader."""
+    from gamesmanmpi_tpu.db import DbReader, export_result
+    from gamesmanmpi_tpu.db.check import db_equal
+
+    result, v1_reader, oracle, v1_dir = solved(spec, ref_file)
+    v2_dir = tmp_path / "v2"
+    export_result(result, v2_dir, spec, compress=True)
+    assert check_db(v2_dir) == []
+    assert db_equal(v1_dir, v2_dir) == []
+    positions = np.array(sorted(oracle), dtype=np.uint64)
+    with DbReader(v2_dir) as v2_reader:
+        a = v1_reader.lookup(positions)
+        b = v2_reader.lookup(positions)
+        for x, y in zip(a, b):
+            assert np.array_equal(x, y), spec
+        assert b[2].all()
+
+
 def test_db_lookup_misses_and_empty(solved):
     _, reader, oracle, _ = solved(*CASES[0])
     # Unreachable (overlapping X/O planes) and out-of-table patterns miss.

@@ -442,6 +442,54 @@ def test_obs_report_cli(tmp_path, capsys):
     assert obs_report.main([str(tmp_path / "missing.jsonl")]) == 2
 
 
+def test_obs_report_compression_and_cache_columns(tmp_path, capsys):
+    """ISSUE 9 satellites: a compressed export's per-level
+    raw/stored_bytes fold into one whole-DB ratio line, and serve_batch
+    records carrying db_cache_* counters grow per-worker hit-rate
+    columns (cumulative counters: the largest total wins, so
+    interleaved streams cannot double-count)."""
+    jsonl = tmp_path / "m.jsonl"
+    jsonl.write_text("\n".join(json.dumps(r) for r in [
+        {"phase": "export_db", "level": 0, "n": 10,
+         "raw_bytes": 1200, "stored_bytes": 300},
+        {"phase": "export_db", "level": 1, "n": 20,
+         "raw_bytes": 2400, "stored_bytes": 600},
+        {"phase": "serve_batch", "batch_size": 8, "requests": 2,
+         "secs": 0.01, "worker": 0, "db_cache_hits": 5,
+         "db_cache_misses": 5},
+        {"phase": "serve_batch", "batch_size": 8, "requests": 2,
+         "secs": 0.01, "worker": 0, "db_cache_hits": 70,
+         "db_cache_misses": 30},
+        {"phase": "serve_batch", "batch_size": 4, "requests": 1,
+         "secs": 0.01, "worker": 1},
+        # Worker 2 serves TWO compressed routes: each keeps its own
+        # cache figures (the cold route must not vanish behind the
+        # busy one).
+        {"phase": "serve_batch", "batch_size": 8, "requests": 2,
+         "secs": 0.01, "worker": 2, "db": "busy",
+         "db_cache_hits": 900, "db_cache_misses": 100},
+        {"phase": "serve_batch", "batch_size": 8, "requests": 2,
+         "secs": 0.01, "worker": 2, "db": "cold",
+         "db_cache_hits": 1, "db_cache_misses": 9},
+    ]) + "\n")
+    obs_report = load_module(REPO / "tools" / "obs_report.py")
+    assert obs_report.main([str(jsonl)]) == 0
+    out = capsys.readouterr().out
+    assert "export_db: levels=2" in out
+    assert "ratio=4.00x" in out
+    # Worker 0: final cumulative counters, not a sum over records.
+    assert "db_cache_hits=70 db_cache_misses=30" in out
+    assert "db_cache_hit_rate=0.700" in out
+    # Worker 1 (v1 route, no cache): line present, no cache columns.
+    assert "serve[worker 1]: batches=1" in out
+    w1_line = next(l for l in out.splitlines() if "worker 1" in l)
+    assert "db_cache" not in w1_line
+    # Worker 2 (two compressed routes): per-route qualified columns.
+    w2_line = next(l for l in out.splitlines() if "worker 2" in l)
+    assert "db_cache_hit_rate[busy]=0.900" in w2_line
+    assert "db_cache_hit_rate[cold]=0.100" in w2_line
+
+
 @pytest.mark.smoke
 def test_obs_report_merges_rank_streams_without_double_counting(
         tmp_path, capsys):

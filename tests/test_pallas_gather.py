@@ -11,8 +11,15 @@ its on-chip timing is still pending.
 
 import numpy as np
 import pytest
-from hypothesis import given, settings
-from hypothesis import strategies as st
+
+try:
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+except ModuleNotFoundError:
+    # No hypothesis on this image (and no pip install allowed): the
+    # properties run through the deterministic mini shim instead of
+    # failing tier-1 collection. See tests/_mini_hypothesis.py.
+    from _mini_hypothesis import given, settings, st
 
 from gamesmanmpi_tpu.ops.pallas_gather import monotone_window_gather
 

@@ -42,6 +42,7 @@ from gamesmanmpi_tpu.resilience.supervisor import Watchdog
 from gamesmanmpi_tpu.solve import Solver
 from gamesmanmpi_tpu.utils.checkpoint import (
     LevelCheckpointer,
+    _loadz,
     file_crc32,
     save_result_npz,
 )
@@ -224,10 +225,16 @@ def _flip_byte(path, offset_frac=0.5):
         fh.write(bytes([b[0] ^ 0xFF]))
 
 
-def test_crc_quarantines_corrupt_level_and_recomputes(tmp_path, c3_clean):
+@pytest.mark.parametrize("ckpt_mode", ["auto", "blocks"])
+def test_crc_quarantines_corrupt_level_and_recomputes(tmp_path, c3_clean,
+                                                      monkeypatch,
+                                                      ckpt_mode):
     """Silent bit-rot in a sealed level: crc mismatch on resume ->
     quarantine (.corrupt) -> the level recomputes from the intact
-    prefix -> parity."""
+    prefix -> parity. Parametrized over the block-compressed checkpoint
+    format (ISSUE 9): torn compressed blocks must quarantine-and-degrade
+    exactly like v1 files."""
+    monkeypatch.setenv("GAMESMAN_CKPT_COMPRESS", ckpt_mode)
     ck = LevelCheckpointer(tmp_path / "ck")
     Solver(get_game(_C3), checkpointer=ck).solve()
     sealed = ck.completed_levels()
@@ -480,7 +487,10 @@ def _run_cli(args, extra_env=None, timeout=600):
 
 
 def _assert_tables_equal(a, b):
-    with np.load(a) as za, np.load(b) as zb:
+    # _loadz, not np.load: byte-parity means LOGICAL table equality, and
+    # a blocks-mode run's --table-out is block-framed on disk (the
+    # ckpt_mode chaos parametrization compares against a plain golden).
+    with _loadz(a) as za, _loadz(b) as zb:
         assert sorted(za.files) == sorted(zb.files)
         for f in za.files:
             assert np.array_equal(za[f], zb[f]), f
@@ -525,19 +535,25 @@ def test_chaos_kill_and_resume_parity_ttt(point, tmp_path, ttt_clean_table):
 
 
 @pytest.mark.slow
-def test_chaos_torn_seal_and_resume_parity(tmp_path, ttt_clean_table):
+@pytest.mark.parametrize("ckpt_mode", ["auto", "blocks"])
+def test_chaos_torn_seal_and_resume_parity(tmp_path, ttt_clean_table,
+                                           ckpt_mode):
     """The torn-write kind: a sealed level file is truncated and the
     process dies. Resume must quarantine (crc/zip failure) and
-    recompute to parity."""
+    recompute to parity — identically when the checkpoint is
+    block-compressed (GAMESMAN_CKPT_COMPRESS=blocks, ISSUE 9): a torn
+    compressed file is just one more TORN_NPZ_ERRORS shape."""
     ck = tmp_path / "ck"
     killed = _run_cli(
         ["tictactoe", "--checkpoint-dir", str(ck)],
-        {"GAMESMAN_FAULTS": "ckpt.save_level:torn:2"},
+        {"GAMESMAN_FAULTS": "ckpt.save_level:torn:2",
+         "GAMESMAN_CKPT_COMPRESS": ckpt_mode},
     )
     assert killed.returncode == faults.TORN_EXIT_CODE, killed.stderr[-2000:]
     out = tmp_path / "resumed.npz"
     resumed = _run_cli(
-        ["tictactoe", "--checkpoint-dir", str(ck), "--table-out", str(out)]
+        ["tictactoe", "--checkpoint-dir", str(ck), "--table-out", str(out)],
+        {"GAMESMAN_CKPT_COMPRESS": ckpt_mode},
     )
     assert resumed.returncode == 0, resumed.stderr[-2000:]
     _assert_tables_equal(out, ttt_clean_table)

@@ -1,41 +1,110 @@
 #!/usr/bin/env python
 """Solved-position DB integrity checker (CI-runnable).
 
-    python tools/check_db.py DB_DIR [--quiet]
+    python tools/check_db.py DB_DIR [--quiet] [--stats-json F]
+                                    [--same-as OTHER_DB]
 
 Validates the manifest, per-shard sha256 checksums, key sortedness/
-uniqueness/sentinel-freedom, cell dtypes and decided-ness — everything a
-serving process assumes but never re-verifies on the hot path (see
-gamesmanmpi_tpu/db/check.py for the full list). Exit 0 = clean, 1 =
-problems (printed one per line), 2 = usage error. Pure numpy file reads
-— no game construction, no kernels, no backend init — so it runs in
-seconds even where accelerator bring-up is expensive or wedged.
+uniqueness/sentinel-freedom, cell dtypes and decided-ness — and, for
+format v2 (block-compressed) directories, the whole block machinery:
+index structure vs real stream sizes, per-block crc32, decoded position
+counts, and the manifest's block-router first_keys (see
+gamesmanmpi_tpu/db/check.py for the full list). After a clean check a
+per-level size/ratio summary table prints (suppressed by --quiet):
+
+    level  positions    stored_MB       raw_MB  ratio  codecs
+        0          1          0.0          0.0   1.9x  keydelta,raw
+    TOTAL       5478          0.1          0.3   4.2x
+
+--same-as proves this DB logically identical (same levels, keys, cells)
+to another directory regardless of storage version — the migration gate
+for a compressed re-export. --stats-json dumps the db_stats record for
+machine consumers (bench.py's BENCH_DB_COMPRESS gate).
+
+Exit 0 = clean, 1 = problems (printed one per line; any block-index or
+cell-count mismatch is a problem), 2 = usage error. Pure numpy file
+reads — no game construction, no kernels, no backend init — so it runs
+in seconds even where accelerator bring-up is expensive or wedged.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # tools/ scripts get sys.path[0]=tools/
+    sys.path.insert(0, _REPO)
+
+
+def format_stats_table(stats: dict) -> str:
+    """The per-level size/ratio table (db_stats record -> text)."""
+    lines = [
+        f"{'level':>5}  {'positions':>10}  {'stored_MB':>11}  "
+        f"{'raw_MB':>11}  {'ratio':>6}  codecs"
+    ]
+    for row in stats["levels"]:
+        lines.append(
+            f"{row['level']:>5}  {row['count']:>10}  "
+            f"{row['stored_bytes'] / 1e6:>11.2f}  "
+            f"{row['raw_bytes'] / 1e6:>11.2f}  "
+            f"{row['ratio']:>5.1f}x  {','.join(row['codecs'])}"
+        )
+    lines.append(
+        f"{'TOTAL':>5}  {stats['num_positions']:>10}  "
+        f"{stats['stored_bytes'] / 1e6:>11.2f}  "
+        f"{stats['raw_bytes'] / 1e6:>11.2f}  "
+        f"{stats['ratio']:>5.1f}x  (format v{stats['version']})"
+    )
+    return "\n".join(lines)
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("db_dir", help="database directory (from export-db)")
     p.add_argument("--quiet", action="store_true",
-                   help="print problems only, no per-level OK lines")
+                   help="print problems only — no per-level OK lines, "
+                   "no summary table")
+    p.add_argument("--stats-json", default=None, metavar="FILE",
+                   help="also write the db_stats record (per-level "
+                   "sizes/ratios) as JSON")
+    p.add_argument("--same-as", default=None, metavar="OTHER_DB",
+                   help="additionally require logical equality with "
+                   "another DB directory (storage-version-agnostic; "
+                   "the v1-vs-compressed migration gate)")
     args = p.parse_args(argv)
 
-    from gamesmanmpi_tpu.db.check import check_db
+    from gamesmanmpi_tpu.db.check import check_db, db_equal, db_stats
+    from gamesmanmpi_tpu.db.format import DbFormatError
 
     problems = check_db(
         args.db_dir, verbose=None if args.quiet else print
     )
+    if args.same_as:
+        problems += [
+            f"differs from {args.same_as}: {d}"
+            for d in db_equal(args.db_dir, args.same_as)
+        ]
     for problem in problems:
         print(f"PROBLEM: {problem}", file=sys.stderr)
     if problems:
         print(f"{args.db_dir}: {len(problems)} problem(s)", file=sys.stderr)
         return 1
+    stats = None
+    try:
+        stats = db_stats(args.db_dir)
+    except (DbFormatError, OSError) as e:
+        # check_db passed, so this is a race (file vanished) — report it
+        # as the problem it is rather than crashing the checker.
+        print(f"PROBLEM: stats: {e}", file=sys.stderr)
+        return 1
+    if args.stats_json:
+        with open(args.stats_json, "w") as fh:
+            json.dump(stats, fh, indent=1)
     if not args.quiet:
+        print(format_stats_table(stats))
         print(f"{args.db_dir}: OK")
     return 0
 

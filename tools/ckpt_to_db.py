@@ -38,6 +38,10 @@ def main(argv=None) -> int:
     )
     p.add_argument("--overwrite", action="store_true",
                    help="replace an existing DB in out_dir")
+    p.add_argument("--compress", action="store_true",
+                   help="write format v2 (block-compressed levels, "
+                   "decompress-on-probe serving) — see export-db "
+                   "--compress")
     p.add_argument("-v", "--verbose", action="store_true",
                    help="print per-level progress to stderr")
     args = p.parse_args(argv)
@@ -51,6 +55,8 @@ def main(argv=None) -> int:
     ]
     if args.overwrite:
         forward.append("--overwrite")
+    if args.compress:
+        forward.append("--compress")
     if args.verbose:
         forward.append("--verbose")
     return cli_main(forward)

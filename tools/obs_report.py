@@ -190,23 +190,67 @@ def summarize_serving(records: list[dict]) -> list[str]:
             continue
         row = by_worker.setdefault(
             rec.get("worker"),
-            {"batches": 0, "requests": 0, "queries": 0, "secs": 0.0},
+            {"batches": 0, "requests": 0, "queries": 0, "secs": 0.0,
+             "db_cache": {}},
         )
         row["batches"] += 1
         row["requests"] += int(rec.get("requests", 0))
         row["queries"] += int(rec.get("batch_size", 0))
         row["secs"] += float(rec.get("secs", 0.0))
+        if "db_cache_hits" in rec:
+            # Cumulative counters, kept PER ROUTE (the record's db
+            # field): the record with the largest total IS that route's
+            # final figure (streams may interleave) — and a multi-DB
+            # worker's cold route must not vanish behind its busy one.
+            dbk = rec.get("db")
+            cand = (int(rec["db_cache_hits"]),
+                    int(rec.get("db_cache_misses", 0)))
+            cur = row["db_cache"].get(dbk)
+            if cur is None or sum(cand) > sum(cur):
+                row["db_cache"][dbk] = cand
     lines = []
     for worker in sorted(by_worker, key=lambda w: (w is None, w)):
         row = by_worker[worker]
         label = "serve" if worker is None else f"serve[worker {worker}]"
         mean = row["queries"] / max(row["batches"], 1)
-        lines.append(
+        line = (
             f"{label}: batches={row['batches']} requests={row['requests']} "
             f"queries={row['queries']} mean_batch={mean:.1f} "
             f"secs={row['secs']:.3f}"
         )
+        for dbk in sorted(row["db_cache"], key=str):
+            hits, misses = row["db_cache"][dbk]
+            rate = hits / max(hits + misses, 1)
+            # One route keeps the plain column names; several routes
+            # qualify each with its db name.
+            tag = "" if len(row["db_cache"]) == 1 else f"[{dbk}]"
+            line += (
+                f" db_cache_hits{tag}={hits} db_cache_misses{tag}={misses} "
+                f"db_cache_hit_rate{tag}={rate:.3f}"
+            )
+        lines.append(line)
     return lines
+
+
+def summarize_export(records: list[dict]) -> list[str]:
+    """Compression summary from ``export_db`` records: a compressed
+    (format v2) export logs raw_bytes/stored_bytes per level, which
+    fold into one whole-DB ratio line (absent for v1 exports — no
+    ratio to report)."""
+    raw = stored = levels = 0
+    for rec in records:
+        if rec.get("phase") != "export_db" or "stored_bytes" not in rec:
+            continue
+        levels += 1
+        raw += int(rec.get("raw_bytes", 0))
+        stored += int(rec["stored_bytes"])
+    if not levels:
+        return []
+    return [
+        f"export_db: levels={levels} raw_MB={raw / 1e6:.1f} "
+        f"stored_MB={stored / 1e6:.1f} "
+        f"ratio={raw / max(stored, 1):.2f}x"
+    ]
 
 
 def report(records: list[dict]) -> str:
@@ -214,6 +258,7 @@ def report(records: list[dict]) -> str:
     aux record counts."""
     out = [format_table(summarize_levels(records))]
     out.extend(summarize_serving(records))
+    out.extend(summarize_export(records))
     for rec in records:
         if rec.get("phase") == "done":
             keys = ("game", "positions", "levels", "secs_forward",
