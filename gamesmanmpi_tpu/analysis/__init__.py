@@ -22,6 +22,14 @@ Checker families (ids are stable; catalogue in docs/ANALYSIS.md):
 * ``GM3xx`` — env-var registry parity (analysis/env_parity.py)
 * ``GM4xx`` — metrics registry parity (analysis/metrics_parity.py)
 * ``GM5xx`` — fault-point registry parity (analysis/faults_parity.py)
+* ``GM6xx`` — SPMD / collective safety over the whole-program call
+  graph (analysis/spmd.py)
+* ``GM7xx`` — resource lifecycle & fork safety (analysis/lifecycle.py)
+* ``GM8xx`` — atomic-write & seal discipline (analysis/atomic_write.py)
+
+plus ``analysis/lockdep.py``, the runtime lock-order witness
+(GAMESMAN_LOCKDEP=1) that validates the static lock model against real
+acquisition edges and fails tests on witnessed cycles.
 """
 
 from gamesmanmpi_tpu.analysis.diagnostics import Diagnostic

@@ -1,0 +1,172 @@
+"""GM8xx — atomic-write & seal discipline.
+
+Checkpoint and DB directories survive preemption because every writer
+follows one of two disciplines (docs/ARCHITECTURE.md):
+
+* **tmp + os.replace** — write to a per-writer ``*.tmp`` name, then
+  ``os.replace`` into place (``_savez``, ``write_manifest``): readers
+  see the old bytes or the new bytes, never a torn file;
+* **write-then-seal** — stream payload to its final name, then record
+  it (count/crc/sha) in a manifest that is itself replaced atomically
+  (``save_npy_hashed`` / ``save_blocks_hashed``): a file is real only
+  once the manifest says so, so a death mid-write leaves an unsealed
+  stray, not a corrupt database.
+
+A direct write that follows neither is how "resume killed the run"
+bugs are born (the torn in-place npz overwrites PR 3 fixed). These
+checkers enforce the discipline in every module that practices it
+(contains an ``os.replace`` or a ``# sealed-write:`` annotation —
+modules that never write sealed state, e.g. report tools, are out of
+scope by construction).
+
+Conventions:
+
+* ``# sealed-write: <why>`` on a ``def`` line (or the line above)
+  declares a write-then-seal payload helper: its direct writes are
+  exempt because a manifest seal follows at the call layer;
+* a write is tmp+replace-compliant when its target is tmp-named
+  (``tmp``/``*.tmp``) and the same function calls ``os.replace``;
+* ``*.lock`` sentinel files are exempt — they carry no payload.
+
+| id | finding |
+|---|---|
+| GM801 | direct write bypasses both atomic-write disciplines |
+| GM802 | payload written after the manifest seal in the same function |
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from gamesmanmpi_tpu.analysis.diagnostics import Diagnostic, directive_lines
+from gamesmanmpi_tpu.analysis.project import (
+    Project,
+    SourceFile,
+    attr_chain,
+    call_name,
+    walk_scoped,
+)
+
+_SEALED_WRITE_RE = re.compile(r"#\s*sealed-write:\s*(\S.*)")
+
+#: callables that persist bytes; the checked target is their first arg
+_WRITE_CALLS = {"save", "savez", "savez_compressed"}  # np.* tails
+_WRITE_METHODS = {"write_text", "write_bytes"}  # target = receiver
+
+#: call-name tails that seal a manifest / mark artifacts complete
+_SEAL_RE = re.compile(r"(^|_)(seal|finish)|^_?write_manifest$")
+
+#: payload-writing helpers for the GM802 ordering check
+_PAYLOAD_HELPERS = re.compile(
+    r"^_?savez$|^save_npy_hashed$|^save_blocks_hashed$|^save_"
+)
+
+
+def _has_annotation(src: SourceFile, lineno: int) -> bool:
+    return any(_SEALED_WRITE_RE.search(t)
+               for t in directive_lines(src.lines, lineno))
+
+
+def _expr_mentions_tmp(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and "tmp" in n.id.lower():
+            return True
+        if isinstance(n, ast.Attribute) and "tmp" in n.attr.lower():
+            return True
+        if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                and "tmp" in n.value.lower():
+            return True
+    return False
+
+
+def _expr_mentions_lock(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                and ".lock" in n.value:
+            return True
+    return False
+
+
+def _write_target(call: ast.Call) -> Optional[ast.AST]:
+    """The path expression a persistent-write call targets, or None
+    when this call does not persist bytes."""
+    chain = attr_chain(call.func) or []
+    final = chain[-1] if chain else ""
+    if final in _WRITE_CALLS and len(chain) >= 2 \
+            and chain[0] in ("np", "numpy"):
+        return call.args[0] if call.args else call
+    if final in _WRITE_METHODS and len(chain) >= 2:
+        return call.func.value
+    if final == "open" and len(chain) == 1 and len(call.args) >= 2:
+        mode = call.args[1]
+        if isinstance(mode, ast.Constant) and isinstance(mode.value, str) \
+                and any(c in mode.value for c in "wax"):
+            return call.args[0]
+    return None
+
+
+def _walk_scoped_calls(fn):
+    for node in walk_scoped(fn):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def _module_participates(src: SourceFile) -> bool:
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call) and call_name(node) == "os.replace":
+            return True
+    return any(_SEALED_WRITE_RE.search(line) for line in src.lines)
+
+
+def _check_function(src: SourceFile, fn,
+                    diags: List[Diagnostic]) -> None:
+    if _has_annotation(src, fn.lineno):
+        return  # declared write-then-seal payload helper
+    calls = list(_walk_scoped_calls(fn))
+    has_replace = any(call_name(c) == "os.replace" for c in calls)
+    seal_lines: List[int] = []
+    payload_lines: List[int] = []
+    for call in calls:
+        name = call_name(call)
+        final = name.rsplit(".", 1)[-1]
+        if _SEAL_RE.search(final):
+            seal_lines.append(call.lineno)
+        if _PAYLOAD_HELPERS.search(final):
+            payload_lines.append(call.lineno)
+        target = _write_target(call)
+        if target is None:
+            continue
+        payload_lines.append(call.lineno)
+        if _expr_mentions_lock(target):
+            continue  # sentinel lockfile — no payload to tear
+        if _expr_mentions_tmp(target) and has_replace:
+            continue  # tmp + os.replace discipline
+        diags.append(Diagnostic(
+            src.rel, call.lineno, "GM801",
+            "direct write bypasses the atomic-write discipline — "
+            "write a *.tmp and os.replace it, or route through a "
+            "sealed-write helper (_savez / save_blocks_hashed)",
+        ))
+    if seal_lines and payload_lines:
+        first_seal = min(seal_lines)
+        late = [ln for ln in payload_lines if ln > first_seal]
+        for ln in late:
+            diags.append(Diagnostic(
+                src.rel, ln, "GM802",
+                "payload written AFTER the manifest seal in this "
+                "function — a death between the two leaves a sealed "
+                "manifest pointing at missing/stale payload",
+            ))
+
+
+def check(project: Project) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for src in project.files:
+        if src.tree is None or not _module_participates(src):
+            continue
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _check_function(src, node, diags)
+    return diags

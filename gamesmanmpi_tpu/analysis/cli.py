@@ -17,6 +17,46 @@ from gamesmanmpi_tpu.analysis.runner import run_project
 DEFAULT_BASELINE = "lint_baseline.json"
 
 
+def _changed_lint_targets(root: str, base_ref: str) -> list:
+    """Root-relative paths of lint-scope files changed vs ``base_ref``
+    (committed diffs + working tree + untracked). Raises RuntimeError
+    on git failures — surfaced as usage errors, never tracebacks."""
+    import pathlib
+    import subprocess
+
+    from gamesmanmpi_tpu.analysis.project import default_scope_rels
+
+    def git(*argv):
+        try:
+            proc = subprocess.run(
+                ["git", "-C", str(root), *argv],
+                capture_output=True, text=True, timeout=60,
+            )
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise RuntimeError(f"git {' '.join(argv)}: {e}") from e
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"git {' '.join(argv)} failed: "
+                f"{proc.stderr.strip() or proc.stdout.strip()}"
+            )
+        return [line.strip() for line in proc.stdout.splitlines()
+                if line.strip()]
+
+    # --relative: `git diff --name-only` prints TOPLEVEL-relative paths
+    # by default; when --root is a subdirectory of a larger checkout
+    # they would never match the root-relative scope below (ls-files
+    # --others is cwd-relative already).
+    changed = set(git("diff", "--name-only", "--relative", base_ref,
+                      "--"))
+    changed |= set(git("ls-files", "--others", "--exclude-standard"))
+    scope = default_scope_rels(root)
+    root_path = pathlib.Path(root).resolve()
+    return sorted(
+        rel for rel in changed
+        if rel in scope and (root_path / rel).exists()
+    )
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="gamesman-lint",
@@ -47,6 +87,16 @@ def main(argv=None) -> int:
              "exit 0",
     )
     ap.add_argument(
+        "--changed-only", action="store_true",
+        help="lint only files changed vs --base-ref (git diff + "
+             "untracked), for fast local runs; baseline and exit-code "
+             "semantics are unchanged",
+    )
+    ap.add_argument(
+        "--base-ref", default="HEAD", metavar="REF",
+        help="base ref for --changed-only (default: HEAD)",
+    )
+    ap.add_argument(
         "--format", choices=("text", "json"), default="text",
         help="diagnostic output format",
     )
@@ -65,19 +115,42 @@ def main(argv=None) -> int:
     if args.no_baseline:
         baseline_path = None
 
-    if args.update_baseline and args.paths:
+    if args.update_baseline and (args.paths or args.changed_only):
         # A partial run sees a subset of findings; writing it back would
         # silently drop every accepted entry outside the scanned paths.
         print(
             "gamesman-lint: error: --update-baseline requires a "
-            "whole-project run (no explicit paths)",
+            "whole-project run (no explicit paths / --changed-only)",
             file=sys.stderr,
         )
         return 2
 
+    paths = args.paths or None
+    restrict = None
+    if args.changed_only:
+        if args.paths:
+            print(
+                "gamesman-lint: error: --changed-only and explicit "
+                "paths are mutually exclusive",
+                file=sys.stderr,
+            )
+            return 2
+        try:
+            restrict = _changed_lint_targets(args.root, args.base_ref)
+        except RuntimeError as e:
+            print(f"gamesman-lint: error: {e}", file=sys.stderr)
+            return 2
+        if not restrict:
+            print(
+                f"no lint targets changed vs {args.base_ref}",
+                file=sys.stderr,
+            )
+            return 0
+
     try:
-        result = run_project(args.root, paths=args.paths or None,
-                             baseline_path=baseline_path)
+        result = run_project(args.root, paths=paths,
+                             baseline_path=baseline_path,
+                             restrict=restrict)
     except (FileNotFoundError, ValueError) as e:
         # Missing/outside-root targets and malformed baseline files are
         # usage errors, not tracebacks.

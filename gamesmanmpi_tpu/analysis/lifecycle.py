@@ -1,0 +1,348 @@
+"""GM7xx — resource lifecycle & fork safety.
+
+The serve supervisor (PR 7) and the distributed harness (PR 6) live and
+die by two disciplines no generic linter enforces:
+
+* every acquired OS resource — file handle, mmap, socket, subprocess,
+  thread — must have its release **guaranteed on all paths**: a ``with``
+  block, a ``try/finally``, ownership transfer (returned, passed to a
+  tracking registry/constructor/container), or a ``self.`` field the
+  module demonstrably releases somewhere. A bare ``f = open(...); ...;
+  f.close()`` leaks on the first exception between the two — exactly the
+  fd/zombie creep that kills a fleet after days, not minutes;
+* in a module that forks (``os.fork``), nothing may start threads or
+  take locks earlier in the forking function: the child inherits the
+  lock state of a thread that no longer exists (the classic
+  fork-after-threads deadlock the supervisor's fork spawn mode dodges
+  by forking before any jax/thread activity).
+
+| id | finding |
+|---|---|
+| GM701 | acquired resource whose release is not guaranteed on all paths |
+| GM702 | thread started / lock created before ``os.fork()`` in the same function |
+
+Daemon threads are exempt from GM701 (never joined by design — they die
+with the process). Analysis is per-function and name-based, same spirit
+as the rest of the suite.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from gamesmanmpi_tpu.analysis.diagnostics import Diagnostic
+from gamesmanmpi_tpu.analysis.project import (
+    Project,
+    SourceFile,
+    attr_chain,
+    call_name,
+    from_import_map,
+    walk_scoped as _walk_scoped,
+)
+
+#: dotted-name (or bare-name) acquisition calls -> (kind, release attrs)
+_ACQUIRE = {
+    "open": ("file", {"close"}),
+    "io.open": ("file", {"close"}),
+    "os.fdopen": ("file", {"close"}),
+    "gzip.open": ("file", {"close"}),
+    "mmap.mmap": ("mmap", {"close"}),
+    "socket.socket": ("socket", {"close"}),
+    "socket.create_connection": ("socket", {"close"}),
+    "subprocess.Popen": ("process",
+                         {"wait", "communicate", "kill", "terminate"}),
+    "threading.Thread": ("thread", {"join"}),
+}
+
+#: All release attribute names, for the tracked-self-field escape.
+_ALL_RELEASES = {"close", "join", "wait", "communicate", "kill",
+                 "terminate", "stop", "shutdown", "unlink", "release"}
+
+#: Thread/lock factories that must not run before a fork point.
+_PRE_FORK_HAZARDS = {"Thread", "Lock", "RLock", "Condition", "Semaphore",
+                     "BoundedSemaphore", "Event", "Timer"}
+
+
+def _acquire_kind(node: ast.Call, from_map: Optional[dict] = None):
+    """(kind, releases) when this call acquires a resource, else None."""
+    name = call_name(node)
+    if from_map and name and "." not in name and name != "open":
+        name = from_map.get(name, name)
+    hit = _ACQUIRE.get(name)
+    if hit is None and "." in name:
+        # tolerate aliased module roots ("sp.Popen", "thr.Thread")
+        tail = name.rsplit(".", 1)[-1]
+        for dotted, info in _ACQUIRE.items():
+            if "." in dotted and dotted.rsplit(".", 1)[-1] == tail \
+                    and tail in ("Popen", "Thread", "mmap"):
+                hit = info
+                break
+    if hit is None:
+        return None
+    if hit[0] == "thread" and _is_daemon_thread(node):
+        return None
+    if hit[0] == "file" and not _is_write_or_read_handle(node):
+        return None
+    return hit
+
+
+def _is_daemon_thread(node: ast.Call) -> bool:
+    for kw in node.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+def _is_write_or_read_handle(node: ast.Call) -> bool:
+    """open() in any mode counts; this hook exists so future tuning can
+    exempt modes centrally."""
+    return True
+
+
+class _FnScan:
+    """One function's resource-acquisition audit."""
+
+    def __init__(self, src: SourceFile, fn, self_released: Set[str],
+                 diags: List[Diagnostic], from_map: dict):
+        self.src = src
+        self.fn = fn
+        self.self_released = self_released
+        self.diags = diags
+        self.from_map = from_map
+        #: child node id -> parent node, for this function only (built
+        #: once here — no module-global id()-keyed cache to go stale
+        #: across runs when node ids are recycled)
+        self.parents: dict = {}
+        for node in ast.walk(fn):
+            for child in ast.iter_child_nodes(node):
+                self.parents[id(child)] = node
+        #: locals released inside some finally body / with-as binding
+        self.finally_released: Set[str] = set()
+        self.with_bound: Set[str] = set()
+        self._collect_guards(fn)
+        self._scan(fn)
+
+    # ------------------------------------------------------------- guards
+
+    def _collect_guards(self, fn) -> None:
+        for node in _walk_scoped(fn):
+            if isinstance(node, ast.Try):
+                for name in self._released_names(node.finalbody):
+                    self.finally_released.add(name)
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    if isinstance(item.optional_vars, ast.Name):
+                        self.with_bound.add(item.optional_vars.id)
+
+    def _released_names(self, stmts) -> Set[str]:
+        """Local names released (or handed off) inside ``stmts``."""
+        out: Set[str] = set()
+        for s in stmts:
+            for node in ast.walk(s):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = attr_chain(node.func)
+                if chain and len(chain) >= 2 \
+                        and chain[-1] in _ALL_RELEASES:
+                    out.add(chain[0])
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        out.add(arg.id)
+        return out
+
+    # --------------------------------------------------------------- scan
+
+    def _scan(self, fn) -> None:
+        for node in _walk_scoped(fn):
+            if isinstance(node, ast.Call):
+                hit = _acquire_kind(node, self.from_map)
+                if hit is not None:
+                    self._judge(node, *hit)
+
+    def _judge(self, call: ast.Call, kind: str, releases: Set[str]):
+        ctx = self._context_of(call)
+        if ctx == "ok":
+            return
+        if ctx is None:
+            self.diags.append(Diagnostic(
+                self.src.rel, call.lineno, "GM701",
+                f"{kind} acquired and discarded — release is not "
+                "guaranteed on any path (use `with`, try/finally, or "
+                "a tracked registry)",
+            ))
+            return
+        # ctx is the bound name (local or "self.X")
+        if ctx.startswith("self."):
+            field = ctx[len("self."):]
+            if field in self.self_released:
+                return
+            self.diags.append(Diagnostic(
+                self.src.rel, call.lineno, "GM701",
+                f"{kind} stored on {ctx} but nothing in this module "
+                f"ever releases it ({'/'.join(sorted(releases))})",
+            ))
+            return
+        if ctx in self.finally_released or ctx in self.with_bound:
+            return
+        if self._escapes(ctx, call):
+            return
+        self.diags.append(Diagnostic(
+            self.src.rel, call.lineno, "GM701",
+            f"{kind} bound to {ctx!r} but its release "
+            f"({'/'.join(sorted(releases))}) is not guaranteed on all "
+            "paths — use `with` or try/finally",
+        ))
+
+    def _context_of(self, call: ast.Call) -> Optional[str]:
+        """How the acquired value is consumed: "ok" (with/return/
+        argument/yield), a binding name, or None (discarded)."""
+        node: ast.AST = call
+        parent = self.parents.get(id(node))
+        # unwrap await: `f = await aopen(...)` binds the awaited value
+        while isinstance(parent, (ast.Await,)):
+            node, parent = parent, self.parents.get(id(parent))
+        if isinstance(parent, ast.withitem):
+            return "ok"
+        if isinstance(parent, (ast.Return, ast.Yield, ast.YieldFrom)):
+            return "ok"
+        if isinstance(parent, ast.Call) and parent is not call:
+            return "ok"  # argument: ownership transferred
+        if isinstance(parent, (ast.List, ast.Tuple, ast.Dict, ast.Set)):
+            return "ok"  # stored in a container literal
+        if isinstance(parent, (ast.Assign, ast.AnnAssign, ast.NamedExpr)):
+            target = (parent.targets[0]
+                      if isinstance(parent, ast.Assign)
+                      else parent.target)
+            if isinstance(target, ast.Name):
+                return target.id
+            if (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                return f"self.{target.attr}"
+            return "ok"  # subscript/other attribute: escaped to a registry
+        if isinstance(parent, ast.Expr):
+            # bare `Thread(...).start()`-style chains land here via the
+            # Attribute parent below; a truly bare acquisition is a leak
+            return None
+        if isinstance(parent, ast.Attribute):
+            # e.g. open(p).read() — acquired, used, dropped: leak
+            return None
+        return None
+
+    def _escapes(self, name: str, call: ast.Call) -> bool:
+        """True when the named local is handed off within this function:
+        passed as an argument, returned, yielded, re-stored onto
+        self/container, or re-bound into a with."""
+        for node in _walk_scoped(self.fn):
+            if isinstance(node, ast.Call):
+                for arg in list(node.args) + [k.value for k in
+                                              node.keywords]:
+                    if _value_carries(arg, name):
+                        return True
+            elif isinstance(node, (ast.Return, ast.Yield)):
+                if node.value is not None \
+                        and _value_carries(node.value, name):
+                    return True
+            elif isinstance(node, ast.Assign):
+                # the VALUE must be the resource itself (or a container
+                # holding it) — `x = f.read()` does not hand f off
+                if _value_carries(node.value, name) and not (
+                    len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == name
+                ):
+                    return True
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    for sub in ast.walk(item.context_expr):
+                        if isinstance(sub, ast.Name) and sub.id == name:
+                            return True
+        return False
+
+
+def _value_carries(expr: ast.AST, name: str) -> bool:
+    """True when evaluating ``expr`` yields the named resource itself:
+    the bare name, or a container literal holding it (``(proc, t0)``).
+    ``proc.pid`` / ``f.read()`` do NOT carry the resource."""
+    if isinstance(expr, ast.Name):
+        return expr.id == name
+    if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+        return any(_value_carries(e, name) for e in expr.elts)
+    if isinstance(expr, ast.Dict):
+        return any(v is not None and _value_carries(v, name)
+                   for v in expr.values)
+    return False
+
+
+def _self_released_fields(src: SourceFile) -> Set[str]:
+    """Attribute names on which some method in this module calls a
+    release (``self._sock.close()``, ``w._thread.join()``, ...) or
+    passes to a closer (``_close_readers(self._readers)``)."""
+    out: Set[str] = set()
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = attr_chain(node.func)
+        if chain and len(chain) >= 3 and chain[-1] in _ALL_RELEASES:
+            out.add(chain[-2])
+        for arg in node.args:
+            a = attr_chain(arg)
+            if a and len(a) >= 2:
+                out.add(a[-1])
+    return out
+
+
+def _check_fork_ordering(src: SourceFile, diags: List[Diagnostic],
+                         from_map: dict) -> None:
+    """GM702 within each function that calls os.fork()."""
+    funcs = [n for n in ast.walk(src.tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in funcs:
+        fork_lines = [
+            node.lineno for node in _walk_scoped(fn)
+            if isinstance(node, ast.Call)
+            and call_name(node).endswith("os.fork")
+        ]
+        if not fork_lines:
+            continue
+        fork_line = min(fork_lines)
+        for node in _walk_scoped(fn):
+            if not isinstance(node, ast.Call) \
+                    or node.lineno >= fork_line:
+                continue
+            name = call_name(node)
+            if name and "." not in name:
+                name = from_map.get(name, name)
+            tail = name.rsplit(".", 1)[-1]
+            if tail in _PRE_FORK_HAZARDS and "." in name:
+                diags.append(Diagnostic(
+                    src.rel, node.lineno, "GM702",
+                    f"{tail} created before os.fork() in the same "
+                    "function — the child inherits lock/thread state "
+                    "that no longer has an owner",
+                ))
+            elif tail == "start" and len(attr_chain(node.func) or []) >= 2:
+                recv = (attr_chain(node.func) or ["?"])[-2]
+                if "thread" in recv.lower():
+                    diags.append(Diagnostic(
+                        src.rel, node.lineno, "GM702",
+                        f"thread {recv!r} started before os.fork() in "
+                        "the same function — fork-unsafe",
+                    ))
+
+
+def check(project: Project) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for src in project.files:
+        if src.tree is None:
+            continue
+        self_released = _self_released_fields(src)
+        from_map = from_import_map(src.tree)
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _FnScan(src, node, self_released, diags, from_map)
+        _check_fork_ordering(src, diags, from_map)
+    return diags

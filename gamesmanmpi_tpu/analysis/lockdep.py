@@ -1,0 +1,376 @@
+"""Runtime lock-order witness: the dynamic half of GM2xx/GM6xx.
+
+The static lock checkers reason about names and lexical scopes; this
+module validates that model against *reality*. With
+``GAMESMAN_LOCKDEP=1`` in the environment (or an explicit
+:func:`install`), every ``threading.Lock`` / ``RLock`` / ``Condition``
+constructed from the watched packages (``obs/``, ``serve/``,
+``resilience/`` by default) is wrapped in a recording proxy. Each time
+a thread acquires lock B while holding lock A, the edge ``A -> B`` is
+added to a global acquisition-order graph, keyed by the locks'
+construction sites (``serve/batcher.py:87``). A cycle in that graph is
+a lock-order inversion — two threads interleaving those paths can
+deadlock — and :func:`assert_acyclic` turns it into a test failure
+with the witnessed cycle spelled out.
+
+Wiring: ``tests/conftest.py`` installs the witness when
+``GAMESMAN_LOCKDEP=1`` and asserts acyclicity at session teardown;
+``tests/test_lint.py`` holds the unit tests (cycle detection, RLock
+reentrancy, Condition wait/notify accounting) and an integration test
+driving the real obs/serve/resilience lock users under a witness.
+
+The proxy is Condition-compatible: ``Condition.wait`` releases the
+wrapped lock through ``_release_save`` (held-state drops, correctly)
+and re-acquires through ``_acquire_restore`` (edges record against
+whatever the thread holds at wake-up). Reentrant RLock acquisitions
+record no edges — only the 0->1 transition does.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Tuple
+
+#: Construction sites are instrumented only under these path fragments
+#: (posix separators) — the thread+lock packages the static checkers
+#: model. Everything else gets a plain lock: zero overhead, no noise.
+DEFAULT_WATCH = (
+    "gamesmanmpi_tpu/obs/",
+    "gamesmanmpi_tpu/serve/",
+    "gamesmanmpi_tpu/resilience/",
+)
+
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+
+
+class LockOrderError(AssertionError):
+    """A witnessed lock-order cycle (potential deadlock)."""
+
+
+class _Graph:
+    """The global acquisition-order graph (thread-safe via an original,
+    uninstrumented lock)."""
+
+    def __init__(self):
+        self._lock = _ORIG_LOCK()
+        self.edges: Dict[str, Dict[str, str]] = {}  # a -> {b: thread}
+
+    def add(self, a: str, b: str, thread: str) -> None:
+        with self._lock:
+            self.edges.setdefault(a, {}).setdefault(b, thread)
+
+    def snapshot(self) -> List[Tuple[str, str]]:
+        with self._lock:
+            return sorted(
+                (a, b) for a, bs in self.edges.items() for b in bs
+            )
+
+    def clear(self) -> None:
+        with self._lock:
+            self.edges.clear()
+
+    def cycles(self) -> List[List[str]]:
+        """Every elementary cycle reachable in the edge set (DFS with a
+        color map; one representative per back edge)."""
+        with self._lock:
+            adj = {a: sorted(bs) for a, bs in self.edges.items()}
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color = {n: WHITE for n in adj}
+        out: List[List[str]] = []
+        path: List[str] = []
+
+        def dfs(n: str) -> None:
+            color[n] = GRAY
+            path.append(n)
+            for m in adj.get(n, ()):
+                if color.get(m, WHITE) == GRAY:
+                    out.append(path[path.index(m):] + [m])
+                elif color.get(m, WHITE) == WHITE:
+                    color[m] = WHITE
+                    dfs(m)
+            path.pop()
+            color[n] = BLACK
+
+        for n in list(adj):
+            if color.get(n, WHITE) == WHITE:
+                dfs(n)
+        return out
+
+
+_GRAPH = _Graph()
+_TLS = threading.local()
+#: construction sites of every lock the witness instrumented this
+#: session — the coverage observable (edges exist only when locks NEST,
+#: which healthy single-lock designs never do).
+_SITES: set = set()
+_SITES_LOCK = _ORIG_LOCK()
+
+
+def _held():
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []  # [(id(lock), name)] in acquire order
+        _TLS.counts = {}  # id(lock) -> recursion depth
+    return stack, _TLS.counts
+
+
+class _LockProxy:
+    """Recording wrapper around a Lock/RLock instance."""
+
+    def __init__(self, inner, name: str):
+        self._inner = inner
+        self._name = name
+
+    # ------------------------------------------------------- accounting
+
+    def _note_acquired(self) -> None:
+        stack, counts = _held()
+        key = id(self)
+        counts[key] = counts.get(key, 0) + 1
+        if counts[key] == 1:
+            me = threading.current_thread().name
+            for _, held_name in stack:
+                if held_name != self._name:
+                    _GRAPH.add(held_name, self._name, me)
+            stack.append((key, self._name))
+
+    def _note_released(self, full: bool = False) -> None:
+        stack, counts = _held()
+        key = id(self)
+        if key not in counts:
+            return  # released by a thread that never noted the acquire
+        counts[key] = 0 if full else counts[key] - 1
+        if counts[key] <= 0:
+            counts.pop(key, None)
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i][0] == key:
+                    del stack[i]
+                    break
+
+    # ------------------------------------------------------- lock API
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._note_acquired()
+        return ok
+
+    def release(self) -> None:
+        self._note_released()
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __getattr__(self, name: str):
+        # Condition compatibility: expose _release_save /
+        # _acquire_restore / _is_owned ONLY when the inner lock has
+        # them (RLock), wrapped so wait()'s full release and the
+        # wake-up re-acquire keep the held-state honest. The saved
+        # state carries OUR recursion depth alongside the inner
+        # lock's, so waiting on a Condition over a reentrantly-held
+        # RLock restores the proxy to the true depth (not 1) and
+        # later releases keep the accounting exact.
+        if name == "_release_save":
+            inner = self._inner._release_save
+
+            def _release_save():
+                _, counts = _held()
+                depth = counts.get(id(self), 0)
+                self._note_released(full=True)
+                return (inner(), depth)
+
+            return _release_save
+        if name == "_acquire_restore":
+            inner = self._inner._acquire_restore
+
+            def _acquire_restore(state):
+                inner_state, depth = state
+                inner(inner_state)
+                self._note_acquired()
+                if depth > 1:
+                    _held()[1][id(self)] = depth
+
+            return _acquire_restore
+        return getattr(self._inner, name)
+
+
+class _Installed:
+    watch: tuple = DEFAULT_WATCH
+    active: bool = False
+
+
+def _caller_site() -> Optional[str]:
+    """repo-relative construction site of the first frame outside this
+    module and the threading machinery."""
+    f = sys._getframe(2)
+    while f is not None:
+        fname = f.f_code.co_filename.replace(os.sep, "/")
+        if "analysis/lockdep" not in fname and "/threading" not in fname:
+            return f"{fname}:{f.f_lineno}"
+        f = f.f_back
+    return None
+
+
+def _should_instrument(site: Optional[str]) -> bool:
+    return site is not None and any(w in site for w in _Installed.watch)
+
+
+def _short(site: str) -> str:
+    for w in _Installed.watch:
+        i = site.find(w)
+        if i >= 0:
+            return site[i:]
+    return site.rsplit("/", 2)[-1]
+
+
+#: per-construction-site instance counters: distinct locks born at the
+#: same line (a loop, one per object) must keep distinct graph nodes,
+#: or an inversion BETWEEN them would merge into one self-edge-free
+#: name and never be witnessed.
+_SITE_SEQ: dict = {}
+
+
+def _note_site(site: str) -> str:
+    with _SITES_LOCK:
+        n = _SITE_SEQ.get(site, 0)
+        _SITE_SEQ[site] = n + 1
+        name = site if n == 0 else f"{site}#{n}"
+        _SITES.add(name)
+        return name
+
+
+def _make_lock():
+    site = _caller_site()
+    if not _Installed.active or not _should_instrument(site):
+        return _ORIG_LOCK()
+    return _LockProxy(_ORIG_LOCK(), _note_site(_short(site)))
+
+
+def _make_rlock():
+    site = _caller_site()
+    if not _Installed.active or not _should_instrument(site):
+        return _ORIG_RLOCK()
+    return _LockProxy(_ORIG_RLOCK(), _note_site(_short(site)))
+
+
+def install(watch=None) -> None:
+    """Patch the threading lock factories (idempotent). ``Condition``
+    needs no patching: built over a patched lock it routes every
+    acquire/release through the proxy, and a bare ``Condition()``
+    constructs its RLock through the patched factory."""
+    if watch is not None:
+        _Installed.watch = tuple(watch)
+    if _Installed.active:
+        return
+    _Installed.active = True
+    threading.Lock = _make_lock
+    threading.RLock = _make_rlock
+
+
+def uninstall() -> None:
+    if not _Installed.active:
+        return
+    threading.Lock = _ORIG_LOCK
+    threading.RLock = _ORIG_RLOCK
+    _Installed.active = False
+    _Installed.watch = DEFAULT_WATCH
+
+
+def reset() -> None:
+    _GRAPH.clear()
+    with _SITES_LOCK:
+        _SITES.clear()
+        _SITE_SEQ.clear()
+
+
+def edges() -> List[Tuple[str, str]]:
+    """Witnessed (held, acquired) pairs, sorted."""
+    return _GRAPH.snapshot()
+
+
+def instrumented() -> List[str]:
+    """Construction sites of every lock wrapped this session."""
+    with _SITES_LOCK:
+        return sorted(_SITES)
+
+
+def cycles() -> List[List[str]]:
+    return _GRAPH.cycles()
+
+
+def assert_acyclic() -> None:
+    cy = _GRAPH.cycles()
+    if cy:
+        lines = [" -> ".join(c) for c in cy]
+        raise LockOrderError(
+            "lock-order cycle(s) witnessed at runtime (deadlock "
+            "potential):\n  " + "\n  ".join(lines)
+        )
+
+
+def enabled_by_env() -> bool:
+    # Deliberately a raw default-free read: this runs at conftest import,
+    # before any package code, and the knob is documented in CONFIG.md.
+    from gamesmanmpi_tpu.utils.env import env_str
+
+    return env_str("GAMESMAN_LOCKDEP", "0") == "1"
+
+
+class witness:
+    """Context manager for tests: install + clean slate on entry,
+    acyclicity assertion (optional) on exit.
+
+    Nestable over a session-wide install (GAMESMAN_LOCKDEP=1 via
+    conftest): the prior installation state, watch list, edge graph,
+    and site registry are snapshotted on entry and restored on exit —
+    a scoped witness must never blind the session witness for the
+    tests that run after it.
+
+    >>> with lockdep.witness():
+    ...     exercise_locks()
+    """
+
+    def __init__(self, watch=None, check: bool = True):
+        self.watch = watch
+        self.check = check
+
+    def __enter__(self):
+        self._was_active = _Installed.active
+        self._prev_watch = _Installed.watch
+        with _GRAPH._lock:
+            self._prev_edges = {a: dict(bs)
+                                for a, bs in _GRAPH.edges.items()}
+        with _SITES_LOCK:
+            self._prev_sites = set(_SITES)
+            self._prev_seq = dict(_SITE_SEQ)
+        install(self.watch)
+        reset()
+        return sys.modules[__name__]
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        try:
+            if exc_type is None and self.check:
+                assert_acyclic()
+        finally:
+            if not self._was_active:
+                uninstall()
+            _Installed.watch = self._prev_watch
+            with _GRAPH._lock:
+                _GRAPH.edges.clear()
+                _GRAPH.edges.update(self._prev_edges)
+            with _SITES_LOCK:
+                _SITES.clear()
+                _SITES.update(self._prev_sites)
+                _SITE_SEQ.clear()
+                _SITE_SEQ.update(self._prev_seq)

@@ -395,9 +395,10 @@ def check(project: Project) -> List[Diagnostic]:
     for src in project.files:
         if src.tree is None:
             continue
-        mod = _ModuleLocks(src)
+        # Shared with the GM6xx collective-under-lock checker via the
+        # project cache: one lock inventory per module per run.
+        mod = project.module_locks(src)
         if not mod.guarded and not mod.requires and not mod.lock_kind:
             continue
-        mod.compute_acquires()
         _walk_functions(mod, diags)
     return diags

@@ -54,12 +54,40 @@ class Project:
     config_md: str  # "" when absent
     observability_md: str
     chaos_text: str
+    #: Expensive derived indexes, built lazily and exactly ONCE per run,
+    #: shared by every checker (the tier-1 60 s budget depends on it).
+    _callgraph: Optional["CallGraph"] = None
+    #: How many times the call graph was built — the runtime-budget test
+    #: asserts this stays 1 however many checkers consume it.
+    callgraph_builds: int = 0
+    #: rel -> per-module lock inventory (locks._ModuleLocks), shared by
+    #: the GM2xx and GM6xx checkers. Typed loosely to avoid an import
+    #: cycle (locks.py imports this module).
+    _module_locks: dict = dataclasses.field(default_factory=dict)
 
     def file(self, rel: str) -> Optional[SourceFile]:
         for f in self.files + self.collect_only:
             if f.rel == rel:
                 return f
         return None
+
+    def callgraph(self) -> "CallGraph":
+        if self._callgraph is None:
+            self._callgraph = CallGraph(self)
+            self.callgraph_builds += 1
+        return self._callgraph
+
+    def module_locks(self, src: SourceFile):
+        """Memoized lock inventory for one module (see module_locks in
+        analysis/locks.py — the builder is injected there to keep the
+        import direction project <- locks)."""
+        if src.rel not in self._module_locks:
+            from gamesmanmpi_tpu.analysis.locks import _ModuleLocks
+
+            mod = _ModuleLocks(src)
+            mod.compute_acquires()
+            self._module_locks[src.rel] = mod
+        return self._module_locks[src.rel]
 
 
 def _load(root: pathlib.Path, p: pathlib.Path) -> SourceFile:
@@ -87,6 +115,22 @@ def _iter_py(d: pathlib.Path):
     for p in sorted(d.rglob("*.py")):
         if not any(part in EXCLUDED_DIRS for part in p.parts):
             yield p
+
+
+def default_scope_rels(root) -> set:
+    """Root-relative posix paths of every file the default (whole-
+    project) discovery would lint — the filter ``--changed-only`` uses
+    so a git-scoped run never lints files (tests, docs scripts) the
+    full run would not."""
+    root = pathlib.Path(root).resolve()
+    out = set()
+    for child in sorted(root.iterdir()):
+        if child.name in EXCLUDED_DIRS or not child.is_dir():
+            continue
+        if (child / "__init__.py").exists() or child.name == "tools":
+            for p in _iter_py(child):
+                out.add(p.relative_to(root).as_posix())
+    return out
 
 
 def load_project(root, paths=None) -> Project:
@@ -119,11 +163,10 @@ def load_project(root, paths=None) -> Project:
             else:
                 targets.append(p)
     else:
-        for child in sorted(root.iterdir()):
-            if child.name in EXCLUDED_DIRS or not child.is_dir():
-                continue
-            if (child / "__init__.py").exists() or child.name == "tools":
-                targets.extend(_iter_py(child))
+        # One discovery rule, shared with --changed-only's reporting
+        # filter: the two must never diverge or a git-scoped run would
+        # drop findings the full run reports.
+        targets = [root / rel for rel in sorted(default_scope_rels(root))]
     seen = set()
     files = []
     for p in targets:
@@ -180,6 +223,347 @@ def const_str(node: ast.AST, module_consts=None) -> Optional[str]:
     ):
         return module_consts[node.id]
     return None
+
+
+def from_import_map(tree: ast.AST) -> dict:
+    """local name -> dotted origin for ``from mod import name [as n]``,
+    the shared resolver the GM7xx/GM8xx checkers use so
+    ``from subprocess import Popen`` reads the same as
+    ``subprocess.Popen``. (CallGraph keeps its own richer two-map form
+    for cross-module function resolution.)"""
+    out: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for alias in node.names:
+                out[alias.asname or alias.name] = \
+                    f"{node.module}.{alias.name}"
+    return out
+
+
+def walk_scoped(fn):
+    """All nodes of ``fn`` excluding nested function/class/lambda
+    bodies — those belong to their own scope and are audited there.
+    The shared traversal for per-function checkers."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop(0)
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def stmt_terminates(stmts: list) -> str:
+    """How a statement list exits early: "return" (also break/continue —
+    control leaves the list), "raise", or "" when it falls through."""
+    if not stmts:
+        return ""
+    last = stmts[-1]
+    if isinstance(last, (ast.Return, ast.Break, ast.Continue)):
+        return "return"
+    if isinstance(last, ast.Raise):
+        return "raise"
+    return ""
+
+
+# ------------------------------------------------------------- call graph
+#
+# A name-based whole-program index: every function/method (including
+# nested defs) keyed as "<rel>::<qualname>", with its call sites resolved
+# through imports, self-dispatch, and enclosing-scope nesting. Functions
+# *passed* as arguments (builders, retry thunks, thread targets) become
+# callback events tagged with the receiving callee's name, so checkers
+# can decide which funnels propagate behavior (get_kernel dispatches the
+# built kernel at the call site; schedule_kernel only compiles it).
+# Resolution is conventional, not perfect — same spirit as the rest of
+# the suite: one name means one thing in this repo.
+
+
+@dataclasses.dataclass
+class CallEvent:
+    """One call site (or callback argument) inside a function body."""
+
+    lineno: int
+    node: ast.AST  # the ast.Call
+    callee: Optional[str]  # resolved function key, None when external
+    external: str  # dotted text of an unresolved callee ("jax.lax.psum")
+    final: str  # last segment of the callee name ("psum")
+    chain: tuple  # full attr chain as written, () when not a name chain
+    via: str = ""  # "" = direct call; else the name of the function this
+    #               one was passed TO as an argument (callback edge)
+
+
+@dataclasses.dataclass
+class FunctionNode:
+    key: str
+    rel: str
+    qualname: str  # "Class.method", "outer.inner", "func"
+    name: str
+    cls: Optional[str]  # enclosing class name, None for plain functions
+    node: ast.AST
+    lineno: int
+    events: List[CallEvent] = dataclasses.field(default_factory=list)
+
+
+def _module_dotted(rel: str) -> str:
+    name = rel[:-3] if rel.endswith(".py") else rel
+    if name.endswith("/__init__"):
+        name = name[: -len("/__init__")]
+    return name.replace("/", ".")
+
+
+class CallGraph:
+    """Cross-module call graph + per-function ordered call events."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.functions: dict = {}
+        self.by_module: dict = {}
+        self._module_of: dict = {}  # dotted module name -> rel
+        self._imports: dict = {}  # rel -> {alias: dotted module}
+        self._from_imports: dict = {}  # rel -> {name: (module, attr)}
+        self._toplevel: dict = {}  # rel -> {func name: key}
+        self._methods: dict = {}  # (rel, cls) -> {method name: key}
+        for src in project.files:
+            if src.tree is not None:
+                self._module_of[_module_dotted(src.rel)] = src.rel
+        for src in project.files:
+            if src.tree is not None:
+                self._collect_imports(src)
+                self._register_functions(src)
+        for src in project.files:
+            if src.tree is not None:
+                self._collect_events(src)
+
+    # ------------------------------------------------------------ indexing
+
+    def _collect_imports(self, src: SourceFile) -> None:
+        imports: dict = {}
+        froms: dict = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        imports[alias.asname] = alias.name
+                    else:
+                        # "import a.b" binds "a"; chains through it
+                        # resolve segment-wise against known modules.
+                        head = alias.name.split(".")[0]
+                        imports.setdefault(head, head)
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for alias in node.names:
+                    froms[alias.asname or alias.name] = (
+                        node.module, alias.name
+                    )
+        self._imports[src.rel] = imports
+        self._from_imports[src.rel] = froms
+
+    @staticmethod
+    def _scoped_defs(body):
+        """def/class statements in ``body``, including ones nested in
+        loops/ifs/trys, but NOT inside other defs or classes (those are
+        a deeper scope)."""
+        stack = list(body)
+        while stack:
+            node = stack.pop(0)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                yield node
+                continue
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.stmt):
+                    stack.append(child)
+
+    def _register_functions(self, src: SourceFile) -> None:
+        top: dict = {}
+        self._toplevel[src.rel] = top
+        self.by_module[src.rel] = []
+
+        def visit(body, prefix: str, cls: Optional[str]):
+            for node in self._scoped_defs(body):
+                if isinstance(node, ast.ClassDef):
+                    self._methods.setdefault((src.rel, node.name), {})
+                    visit(node.body, f"{prefix}{node.name}.", node.name)
+                else:
+                    qual = f"{prefix}{node.name}"
+                    key = f"{src.rel}::{qual}"
+                    self.functions[key] = FunctionNode(
+                        key, src.rel, qual, node.name, cls, node,
+                        node.lineno,
+                    )
+                    self.by_module[src.rel].append(key)
+                    if prefix == "":
+                        top[node.name] = key
+                    elif cls is not None and prefix == f"{cls}.":
+                        self._methods[(src.rel, cls)][node.name] = key
+                    visit(node.body, f"{qual}.", cls)
+
+        visit(src.tree.body, "", None)
+
+    # ---------------------------------------------------------- resolution
+
+    def _module_func(self, rel: Optional[str], name: str) -> Optional[str]:
+        if rel is None:
+            return None
+        return self._toplevel.get(rel, {}).get(name)
+
+    def _resolve_dotted(self, rel: str, chain: List[str]) -> Optional[str]:
+        """Resolve ["mod", ..., "func"] through this module's imports to
+        a project function key (longest module prefix wins)."""
+        head = chain[0]
+        dotted = None
+        if head in self._imports.get(rel, {}):
+            dotted = self._imports[rel][head]
+        elif head in self._from_imports.get(rel, {}):
+            mod, attr = self._from_imports[rel][head]
+            dotted = f"{mod}.{attr}"
+        if dotted is None:
+            return None
+        parts = dotted.split(".") + chain[1:]
+        mod_rel = self._module_of.get(".".join(parts[:-1]))
+        return self._module_func(mod_rel, parts[-1])
+
+    def resolve(self, src: SourceFile, scope: List[str],
+                chain: List[str]) -> Optional[str]:
+        """Resolve a call's attr chain to a function key. ``scope`` is
+        the qualname chain of enclosing functions (innermost last)."""
+        if not chain:
+            return None
+        if len(chain) == 1:
+            name = chain[0]
+            for i in range(len(scope), 0, -1):
+                # Only function scopes host bare-name-visible nested defs
+                # (a class scope's methods need self./cls.).
+                parent = f"{src.rel}::{'.'.join(scope[:i])}"
+                if parent not in self.functions:
+                    continue
+                nested = f"{parent}.{name}"
+                if nested in self.functions:
+                    return nested
+            local = self._toplevel.get(src.rel, {}).get(name)
+            if local is not None:
+                return local
+            frm = self._from_imports.get(src.rel, {}).get(name)
+            if frm is not None:
+                mod, attr = frm
+                return self._module_func(self._module_of.get(mod), attr)
+            return None
+        if chain[0] in ("self", "cls") and len(chain) == 2:
+            cls = self._enclosing_class(src, scope)
+            if cls is not None:
+                return self._methods.get((src.rel, cls), {}).get(chain[1])
+            return None
+        return self._resolve_dotted(src.rel, chain)
+
+    def _enclosing_class(self, src: SourceFile,
+                         scope: List[str]) -> Optional[str]:
+        key = f"{src.rel}::{'.'.join(scope)}"
+        fn = self.functions.get(key)
+        return fn.cls if fn is not None else None
+
+    # -------------------------------------------------------------- events
+
+    def _collect_events(self, src: SourceFile) -> None:
+        graph = self
+
+        def event_for(call: ast.Call, scope: List[str]) -> CallEvent:
+            chain = attr_chain(call.func) or []
+            callee = graph.resolve(src, scope, chain)
+            return CallEvent(
+                lineno=call.lineno,
+                node=call,
+                callee=callee,
+                external="" if callee else ".".join(chain),
+                final=chain[-1] if chain else "",
+                chain=tuple(chain),
+            )
+
+        def walk_fn(fn_key: str, body, scope: List[str]) -> None:
+            events = graph.functions[fn_key].events
+
+            def visit(node):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    inner_scope = scope + [node.name]
+                    inner_key = f"{src.rel}::{'.'.join(inner_scope)}"
+                    if inner_key in graph.functions:
+                        walk_fn(inner_key, node.body, inner_scope)
+                    return
+                if isinstance(node, ast.ClassDef):
+                    for item in graph._scoped_defs(node.body):
+                        if isinstance(item, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                            m_scope = scope + [node.name, item.name]
+                            m_key = f"{src.rel}::{'.'.join(m_scope)}"
+                            if m_key in graph.functions:
+                                walk_fn(m_key, item.body, m_scope)
+                    return
+                if isinstance(node, ast.Call):
+                    ev = event_for(node, scope)
+                    events.append(ev)
+                    # callback edges: functions passed as arguments
+                    receiver = ev.final
+                    args = list(node.args) + [
+                        kw.value for kw in node.keywords
+                    ]
+                    for arg in args:
+                        a_chain = attr_chain(arg)
+                        if not a_chain:
+                            continue
+                        target = graph.resolve(src, scope, a_chain)
+                        if target is not None:
+                            events.append(CallEvent(
+                                lineno=getattr(arg, "lineno", node.lineno),
+                                node=arg,
+                                callee=target,
+                                external="",
+                                final=a_chain[-1],
+                                chain=tuple(a_chain),
+                                via=receiver or "<call>",
+                            ))
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+
+            for stmt in body:
+                visit(stmt)
+
+        for node in self._scoped_defs(src.tree.body):
+            self._visit_top(src, node, [], walk_fn)
+
+    def _visit_top(self, src, node, scope, walk_fn) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            key = f"{src.rel}::{'.'.join(scope + [node.name])}"
+            if key in self.functions:
+                walk_fn(key, node.body, scope + [node.name])
+        elif isinstance(node, ast.ClassDef):
+            for item in self._scoped_defs(node.body):
+                self._visit_top(src, item, scope + [node.name], walk_fn)
+
+    # ------------------------------------------------------------ reach
+
+    def reach(self, direct: dict, exclude_vias=frozenset()) -> dict:
+        """Transitive closure: ``direct`` maps function key -> truthy
+        mark for functions that directly exhibit a behavior; returns
+        {key: True} for every function that can reach one through call
+        or callback edges (minus ``exclude_vias`` callback funnels)."""
+        reached = {k: True for k, v in direct.items() if v}
+        changed = True
+        while changed:
+            changed = False
+            for key, fn in self.functions.items():
+                if key in reached:
+                    continue
+                for ev in fn.events:
+                    if ev.via and ev.via in exclude_vias:
+                        continue
+                    if ev.callee is not None and ev.callee in reached:
+                        reached[key] = True
+                        changed = True
+                        break
+        return reached
 
 
 def module_string_consts(tree: ast.AST) -> dict:

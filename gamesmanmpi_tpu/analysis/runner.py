@@ -15,11 +15,14 @@ import dataclasses
 from typing import Dict, List, Optional, Tuple
 
 from gamesmanmpi_tpu.analysis import (
+    atomic_write,
     env_parity,
     faults_parity,
     jax_tracing,
+    lifecycle,
     locks,
     metrics_parity,
+    spmd,
 )
 from gamesmanmpi_tpu.analysis.diagnostics import (
     Diagnostic,
@@ -38,6 +41,9 @@ CHECKERS = (
     env_parity.check,
     metrics_parity.check,
     faults_parity.check,
+    spmd.check,
+    lifecycle.check,
+    atomic_write.check,
 )
 
 
@@ -84,8 +90,13 @@ def _lines_for(project: Project, cache: Dict[str, List[str]],
     return cache[rel]
 
 
-def run_project(root, paths=None,
-                baseline_path: Optional[str] = None) -> LintResult:
+def run_project(root, paths=None, baseline_path: Optional[str] = None,
+                restrict=None) -> LintResult:
+    """``paths`` narrows what is *scanned* (fixture subsets);
+    ``restrict`` narrows what is *reported* while the whole project is
+    still scanned — the ``--changed-only`` contract, where the
+    registry-parity checkers must keep seeing every reader or every
+    unchanged read would look stale."""
     project = load_project(root, paths)
     diags: List[Diagnostic] = []
     for src in project.files:
@@ -93,6 +104,9 @@ def run_project(root, paths=None,
             diags.append(src.parse_error)
     for check in CHECKERS:
         diags.extend(check(project))
+    if restrict is not None:
+        keep = {str(r).replace("\\", "/") for r in restrict}
+        diags = [d for d in diags if d.path in keep]
     diags.sort()
 
     lines_cache: Dict[str, List[str]] = {}

@@ -1,0 +1,518 @@
+"""GM6xx — SPMD / collective safety.
+
+At multi-host scale every collective is a fleet-wide appointment: all
+ranks must dispatch the same collectives in the same order, or the job
+wedges silently (the Pentago-scale failure mode — one rank takes a
+different branch and its peers wait in an ``all_to_all`` forever).
+These checkers enforce the repo's collective conventions over the
+whole-program call graph (analysis/project.CallGraph), so a collective
+buried three calls deep under a rank test is still found.
+
+Collective sites (resolved through the call graph, including kernel
+builders handed to ``get_kernel``/``shard_map``):
+
+* device (ICI) collectives — ``all_to_all``, ``psum``, ``all_gather``,
+  ``pmax``, ``pmin``, ``ppermute``, ``pmean``;
+* host (DCN) collectives — ``process_allgather``,
+  ``sync_global_devices``, ``resume_digest`` (every rank must digest
+  the same checkpoint state);
+* consensus barriers — ``.barrier()`` / ``.propose()`` on a
+  coordination handle (receiver chain mentions ``coord``, or the
+  resolved method lives on ``EpochBarrier``/``Coordination``).
+
+Rank-dependence is a small dataflow index: an ``if`` test is
+rank-dependent when it reads ``jax.process_index()`` (directly, via a
+local assigned from it, via an attribute assigned from it in the same
+class, or via a parameter literally named ``rank``/``process_id``).
+``process_count()`` is NOT rank-dependent — it is uniform across ranks.
+
+| id | finding |
+|---|---|
+| GM601 | collective reachable in only one arm of a rank-dependent branch |
+| GM602 | both arms dispatch collectives, but in a different order |
+| GM603 | device collective dispatched outside ``_retry_collective`` routing (modules that define it) |
+| GM604 | collective/barrier invoked while holding a lock |
+
+A branch that ends in ``raise`` is exempt from GM601/GM602: aborting is
+the one divergence the runtime contracts (watchdog exit-124, barrier
+deadline) already handle.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from gamesmanmpi_tpu.analysis.diagnostics import Diagnostic
+from gamesmanmpi_tpu.analysis.project import (
+    CallEvent,
+    Project,
+    SourceFile,
+    attr_chain,
+    stmt_terminates,
+)
+
+#: Device-interconnect collectives: every participating rank's *device*
+#: must enter; the dispatch is what GM603's retry routing protects.
+ICI_COLLECTIVES = frozenset({
+    "all_to_all", "psum", "all_gather", "pmax", "pmin", "ppermute",
+    "pmean",
+})
+
+#: Host-side collectives: every *process* must call them together.
+HOST_COLLECTIVES = frozenset({
+    "process_allgather", "sync_global_devices", "resume_digest",
+})
+
+#: Methods that are consensus rounds when called on a coordination
+#: handle (receiver chain mentions "coord"), or resolved onto these
+#: classes.
+BARRIER_METHODS = frozenset({"barrier", "propose"})
+BARRIER_CLASSES = frozenset({"EpochBarrier", "Coordination"})
+
+#: Callback funnels that do NOT dispatch what they receive: background
+#: AOT compilation only builds, a thread target runs on its own thread
+#: (not in this rank's collective program order).
+NON_DISPATCH_VIAS = frozenset({"schedule_kernel", "Thread"})
+
+#: Callback funnels whose received function becomes a *traced* kernel
+#: body — its collectives dispatch where the built kernel is invoked,
+#: so the body itself is exempt from GM603.
+TRACED_VIAS = frozenset({
+    "shard_map", "jit", "pallas_call", "get_kernel", "checkify",
+})
+
+#: Names whose value is this process's rank.
+_RANK_CALLS = frozenset({"process_index", "process_id"})
+_RANK_NAMES = frozenset({"PROCESS_ID", "rank", "process_id"})
+
+#: Footprint expansion cap — divergence is decidable from a prefix;
+#: unbounded expansion through deep call chains buys nothing.
+_MAX_SEQ = 64
+
+
+# ---------------------------------------------------------------- analysis
+
+
+class _Collectives:
+    """Shared per-project index: which functions reach collectives, and
+    ordered per-function collective footprints."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.graph = project.callgraph()
+        direct_any: Dict[str, bool] = {}
+        direct_ici: Dict[str, bool] = {}
+        for key, fn in self.graph.functions.items():
+            for ev in fn.events:
+                kind = self.direct_kind(ev)
+                if kind is None:
+                    continue
+                direct_any[key] = True
+                if kind == "ici":
+                    direct_ici[key] = True
+        # Consensus primitives: propose/barrier on the coordination
+        # classes ARE rounds even though their bodies are socket code.
+        for key, fn in self.graph.functions.items():
+            if fn.cls in BARRIER_CLASSES and fn.name in BARRIER_METHODS:
+                direct_any[key] = True
+        self.reach_any = self.graph.reach(
+            direct_any, exclude_vias=NON_DISPATCH_VIAS)
+        self.reach_ici = self.graph.reach(
+            direct_ici, exclude_vias=NON_DISPATCH_VIAS)
+        self._seq_cache: Dict[str, List[str]] = {}
+
+    def direct_kind(self, ev: CallEvent) -> Optional[str]:
+        """"ici" / "host" / "barrier" when the event itself is a
+        collective call, else None. Callback events never are (passing
+        a function is not calling it)."""
+        if ev.via:
+            return None
+        if ev.final in ICI_COLLECTIVES:
+            return "ici"
+        if ev.final in HOST_COLLECTIVES:
+            return "host"
+        if ev.final in BARRIER_METHODS:
+            if any("coord" in part for part in ev.chain[:-1]):
+                return "barrier"
+            if ev.callee is not None:
+                target = self.graph.functions.get(ev.callee)
+                if target is not None and target.cls in BARRIER_CLASSES:
+                    return "barrier"
+        return None
+
+    def event_footprint(self, ev: CallEvent) -> List[str]:
+        """Ordered collective names this event dispatches."""
+        kind = self.direct_kind(ev)
+        if kind is not None:
+            return [ev.final]
+        if ev.via in NON_DISPATCH_VIAS:
+            return []
+        if ev.via:
+            return []  # callbacks dispatch at their receiver, not here
+        if ev.callee is not None and ev.callee in self.reach_any:
+            return self.func_seq(ev.callee)
+        return []
+
+    def func_seq(self, key: str) -> List[str]:
+        """Memoized ordered collective footprint of one function
+        (callback edges expand too — calling a function that *hands* a
+        kernel to get_kernel and invokes it dispatches the kernel)."""
+        cached = self._seq_cache.get(key)
+        if cached is not None:
+            return cached
+        self._seq_cache[key] = []  # cycle guard
+        fn = self.graph.functions.get(key)
+        out: List[str] = []
+        if fn is not None:
+            for ev in fn.events:
+                if len(out) >= _MAX_SEQ:
+                    break
+                kind = self.direct_kind(ev)
+                if kind is not None:
+                    out.append(ev.final)
+                elif (ev.callee is not None
+                      and ev.via not in NON_DISPATCH_VIAS
+                      and ev.callee in self.reach_any):
+                    out.extend(self.func_seq(ev.callee))
+        out = out[:_MAX_SEQ]
+        self._seq_cache[key] = out
+        return out
+
+    def branch_events(self, fn_key: str, stmts: list) -> List[CallEvent]:
+        """This function's events whose AST nodes sit inside ``stmts``
+        (source order preserved), nested defs excluded — their events
+        belong to the nested function."""
+        nodes = set()
+        for s in stmts:
+            for n in ast.walk(s):
+                nodes.add(id(n))
+        fn = self.graph.functions[fn_key]
+        return [ev for ev in fn.events if id(ev.node) in nodes]
+
+    def branch_seq(self, fn_key: str, stmts: list) -> List[str]:
+        out: List[str] = []
+        for ev in self.branch_events(fn_key, stmts):
+            out.extend(self.event_footprint(ev))
+        return out[:_MAX_SEQ]
+
+
+# ----------------------------------------------------------- rank taint
+
+
+class _RankTaint:
+    """Names/attributes in one module whose value depends on this
+    process's rank."""
+
+    def __init__(self, src: SourceFile):
+        self.src = src
+        #: attribute names assigned from a rank source anywhere in the
+        #: module (class-field taint: ``self.rank = jax.process_index()``)
+        self.attrs: Set[str] = set()
+        self._collect_attrs()
+
+    @staticmethod
+    def _expr_is_rank_source(node: ast.AST,
+                             local: Set[str] = frozenset(),
+                             attrs: Set[str] = frozenset()) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                chain = attr_chain(n.func)
+                if chain and chain[-1] in _RANK_CALLS:
+                    return True
+            elif isinstance(n, ast.Name):
+                if n.id == "PROCESS_ID" or n.id in local:
+                    return True
+            elif isinstance(n, ast.Attribute):
+                if n.attr == "PROCESS_ID" or n.attr in attrs:
+                    return True
+        return False
+
+    def _collect_attrs(self) -> None:
+        for node in ast.walk(self.src.tree):
+            value = None
+            target = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                target, value = node.target, node.value
+            if (
+                target is not None
+                and isinstance(target, ast.Attribute)
+                and self._expr_is_rank_source(value)
+            ):
+                self.attrs.add(target.attr)
+
+    def function_locals(self, fn) -> Set[str]:
+        """Rank-tainted local names inside one function: parameters
+        literally named rank/process_id, plus locals assigned from a
+        rank source (one forward pass — good enough for init-then-test
+        code)."""
+        local: Set[str] = set()
+        args = fn.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            if a.arg in ("rank", "process_id"):
+                local.add(a.arg)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                if self._expr_is_rank_source(node.value, local,
+                                             self.attrs):
+                    local.add(node.targets[0].id)
+        return local
+
+    def test_is_rank_dependent(self, test: ast.AST,
+                               local: Set[str]) -> bool:
+        return self._expr_is_rank_source(test, local, self.attrs)
+
+
+# ------------------------------------------------------------- checkers
+
+
+def _check_rank_branches(coll: _Collectives, src: SourceFile,
+                         diags: List[Diagnostic]) -> None:
+    taint = _RankTaint(src)
+    graph = coll.graph
+    for key in graph.by_module.get(src.rel, []):
+        fn = graph.functions[key]
+        if key not in coll.reach_any:
+            continue
+        local = taint.function_locals(fn.node)
+        _walk_rank_ifs(coll, src, key, fn.node.body, taint, local, diags)
+
+
+def _walk_rank_ifs(coll, src, key, stmts, taint, local, diags) -> None:
+    for i, node in enumerate(stmts):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue  # nested defs are walked under their own key
+        if isinstance(node, ast.If) and taint.test_is_rank_dependent(
+                node.test, local):
+            _check_one_if(coll, src, key, node, stmts[i + 1:], diags)
+        for child_body in _stmt_bodies(node):
+            _walk_rank_ifs(coll, src, key, child_body, taint, local,
+                           diags)
+
+
+def _stmt_bodies(node):
+    for field in ("body", "orelse", "finalbody"):
+        body = getattr(node, field, None)
+        if body:
+            yield body
+    for handler in getattr(node, "handlers", []) or []:
+        yield handler.body
+
+
+def _check_one_if(coll, src, key, node: ast.If, rest, diags) -> None:
+    t_body = stmt_terminates(node.body)
+    t_else = stmt_terminates(node.orelse)
+    rest_seq = coll.branch_seq(key, list(rest))
+    seq_a = coll.branch_seq(key, node.body)
+    seq_b = coll.branch_seq(key, node.orelse)
+    if t_body != "raise" and t_body != "return":
+        seq_a = seq_a + rest_seq
+    if t_else != "raise" and t_else != "return":
+        seq_b = seq_b + rest_seq
+    if t_body == "raise":
+        seq_a = seq_b  # aborting arm: divergence handled by contract
+    if t_else == "raise":
+        seq_b = seq_a
+    if seq_a == seq_b:
+        return
+    if sorted(seq_a) == sorted(seq_b):
+        diags.append(Diagnostic(
+            src.rel, node.lineno, "GM602",
+            "collective call order diverges between the arms of this "
+            "rank-dependent branch — ranks will meet different "
+            "collectives",
+        ))
+        return
+    # One-sided: name the first surplus collective at its own line.
+    surplus = _surplus_names(seq_a, seq_b)
+    line, name = _first_surplus_event(coll, key, node, rest, surplus)
+    diags.append(Diagnostic(
+        src.rel, line, "GM601",
+        f"collective {name!r} is reachable in only one arm of a "
+        "rank-dependent branch — ranks that skip it will wedge their "
+        "peers",
+    ))
+
+
+def _surplus_names(seq_a: List[str], seq_b: List[str]) -> Set[str]:
+    from collections import Counter
+
+    a, b = Counter(seq_a), Counter(seq_b)
+    return {n for n in (a | b) if a[n] != b[n]}
+
+
+def _first_surplus_event(coll, key, node: ast.If, rest, surplus):
+    for stmts in (node.body, node.orelse, list(rest)):
+        for ev in coll.branch_events(key, stmts):
+            for name in coll.event_footprint(ev):
+                if name in surplus:
+                    return ev.lineno, name
+    return node.lineno, sorted(surplus)[0] if surplus else "?"
+
+
+def _check_retry_routing(coll: _Collectives, src: SourceFile,
+                         diags: List[Diagnostic]) -> None:
+    """GM603: in modules that define ``_retry_collective``, device
+    collectives must be dispatched from a function routed through
+    ``_retry``/``_retry_collective`` (passed as its thunk)."""
+    graph = coll.graph
+    keys = graph.by_module.get(src.rel, [])
+    if not any(graph.functions[k].name == "_retry_collective"
+               for k in keys):
+        return
+    protected: Set[str] = set()
+    traced: Set[str] = set()
+    for k in keys:
+        for ev in graph.functions[k].events:
+            if ev.callee is None:
+                continue
+            if ev.via in ("_retry", "_retry_collective"):
+                protected.add(ev.callee)
+            if ev.via in TRACED_VIAS:
+                traced.add(ev.callee)
+    # closure: everything a protected/traced function calls inherits
+    changed = True
+    while changed:
+        changed = False
+        for k in keys:
+            if k in protected:
+                for ev in graph.functions[k].events:
+                    if ev.callee is not None and ev.callee not in protected:
+                        protected.add(ev.callee)
+                        changed = True
+            if k in traced:
+                for ev in graph.functions[k].events:
+                    if ev.callee is not None and ev.callee not in traced:
+                        traced.add(ev.callee)
+                        changed = True
+    # nesting: a def inside a protected/traced def inherits its context
+    for k in keys:
+        for container in (protected, traced):
+            if k in container:
+                prefix = graph.functions[k].qualname + "."
+                for other in keys:
+                    if graph.functions[other].qualname.startswith(prefix):
+                        container.add(other)
+    retry_fns = {k for k in keys
+                 if graph.functions[k].name in ("_retry",
+                                                "_retry_collective")}
+    # Kernel producers: functions that hand an ICI-collective kernel
+    # body to a build/trace funnel (get_kernel/shard_map/jit) and return
+    # the built callable — CALLING one is fetching a kernel the caller
+    # immediately dispatches. An ordinary call into a function that
+    # dispatches internally is NOT flagged at the caller: the dispatch
+    # site inside it is judged where it stands.
+    producers: Set[str] = set()
+    for k, fn in graph.functions.items():
+        for ev in fn.events:
+            if (ev.via in TRACED_VIAS and ev.callee is not None
+                    and ev.callee in coll.reach_ici):
+                producers.add(k)
+                break
+    for k in keys:
+        if k in protected or k in traced or k in retry_fns:
+            continue
+        fn = graph.functions[k]
+        for ev in fn.events:
+            if ev.via:
+                continue
+            is_direct = coll.direct_kind(ev) == "ici"
+            fetches = ev.callee is not None and ev.callee in producers
+            if is_direct or fetches:
+                diags.append(Diagnostic(
+                    src.rel, ev.lineno, "GM603",
+                    f"device collective dispatch ({ev.final}) outside "
+                    "_retry_collective routing — a transient here "
+                    "retries on one rank while peers enter the "
+                    "collective",
+                ))
+
+
+def _check_collective_under_lock(coll: _Collectives, project: Project,
+                                 src: SourceFile,
+                                 diags: List[Diagnostic]) -> None:
+    """GM604: a collective blocks until every rank arrives; holding a
+    lock across one starves every thread that needs it (and a peer's
+    death turns that into a permanent wedge)."""
+    mod = project.module_locks(src)
+    if not mod.lock_kind:
+        return
+    graph = coll.graph
+    events_by_node = {}
+    for k in graph.by_module.get(src.rel, []):
+        for ev in graph.functions[k].events:
+            events_by_node[id(ev.node)] = ev
+
+    def scan_expr(n, held):
+        """Report collective events in one expression subtree (nested
+        defs/lambdas excluded — their bodies run later, elsewhere)."""
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return
+        ev = events_by_node.get(id(n))
+        if ev is not None and not ev.via:
+            kind = coll.direct_kind(ev)
+            reaches = (ev.callee is not None
+                       and ev.callee in coll.reach_any)
+            if kind is not None or reaches:
+                diags.append(Diagnostic(
+                    src.rel, ev.lineno, "GM604",
+                    f"collective/barrier ({ev.final}) invoked while "
+                    "holding a lock — a slow or dead peer wedges "
+                    "every thread waiting on it",
+                ))
+        for c in ast.iter_child_nodes(n):
+            if not isinstance(c, ast.stmt):
+                scan_expr(c, held)
+
+    def walk(stmts, held):
+        for node in stmts:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(node, ast.With):
+                inner = set(held)
+                for item in node.items:
+                    ln = mod.with_lock(item.context_expr)
+                    if ln is not None:
+                        inner.add(ln)
+                    elif held:
+                        scan_expr(item.context_expr, held)
+                walk(node.body, inner)
+                continue
+            if held:
+                for c in ast.iter_child_nodes(node):
+                    if not isinstance(c, ast.stmt):
+                        scan_expr(c, held)
+            for body in _stmt_bodies(node):
+                walk(body, held)
+
+    def visit_functions(body, cls):
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                visit_functions(node.body, node.name)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                held = set()
+                req = mod.requires.get(node)
+                if req is not None:
+                    held.add(mod.canonical(req))
+                walk(node.body, held)
+                visit_functions(node.body, cls)
+
+    visit_functions(src.tree.body, None)
+
+
+def check(project: Project) -> List[Diagnostic]:
+    coll = _Collectives(project)
+    diags: List[Diagnostic] = []
+    for src in project.files:
+        if src.tree is None:
+            continue
+        _check_rank_branches(coll, src, diags)
+        _check_retry_routing(coll, src, diags)
+        _check_collective_under_lock(coll, project, src, diags)
+    return diags

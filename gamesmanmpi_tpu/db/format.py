@@ -130,7 +130,9 @@ def read_manifest(directory) -> dict:
     try:
         manifest = json.loads(path.read_text())
     except json.JSONDecodeError as e:
-        raise DbFormatError(f"{path}: manifest is not valid JSON ({e})")
+        raise DbFormatError(
+            f"{path}: manifest is not valid JSON ({e})"
+        ) from e
     if manifest.get("format") != FORMAT_NAME:
         raise DbFormatError(
             f"{path}: format {manifest.get('format')!r}, "
@@ -180,6 +182,10 @@ def parse_position(game, raw) -> int:
     return state
 
 
+# Payload streams to its final name; the caller records the returned
+# sha256 in the manifest, which write_manifest replaces atomically — a
+# death mid-write leaves an unsealed stray, never a half-readable DB.
+# sealed-write: GM801 write-then-seal payload helper (see above)
 def save_npy_hashed(path, arr: np.ndarray) -> str:
     """np.save + sha256 of the written bytes in ONE pass.
 
@@ -206,6 +212,7 @@ def save_npy_hashed(path, arr: np.ndarray) -> str:
         return writer.h.hexdigest()
 
 
+# sealed-write: same write-then-seal contract as save_npy_hashed.
 def save_blocks_hashed(path, blobs) -> str:
     """Write a framed block stream (compress/blocks.encode_array output)
     + sha256 of the written bytes in ONE pass — the v2 twin of

@@ -192,7 +192,7 @@ class DbReader:
                 raise DbFormatError(
                     f"{self.dir}: manifest spec "
                     f"{self.manifest['spec']!r} is not constructible: {e}"
-                )
+                ) from e
         if game.name != self.manifest["game"]:
             raise DbFormatError(
                 f"{self.dir} belongs to game {self.manifest['game']!r}, "

@@ -1592,17 +1592,29 @@ class ShardedSolver:
             total = int(ccounts.sum())
             if total > 0:
                 ccap = bucket_size(int(ccounts.max()), self.min_bucket)
-                children = self._resize_fn(uniq.shape[-1], ccap)(uniq)
                 kmax = min(k + J, g.num_levels - 1)
-                bad, per_target = self._level_check_fn(ccap)(
-                    children,
-                    np.full(1, k, np.int32),
-                    np.full(1, kmax, np.int32),
+
+                # Collective-safe retried unit (GM603): the resize +
+                # level-check kernels route children through an
+                # all_to_all/psum — `uniq` stays referenced across the
+                # step, so re-dispatch is idempotent.
+                def _check_step(ccap=ccap, uniq=uniq, k=k, kmax=kmax):
+                    children = self._resize_fn(uniq.shape[-1], ccap)(uniq)
+                    bad, per_target = self._level_check_fn(ccap)(
+                        children,
+                        np.full(1, k, np.int32),
+                        np.full(1, kmax, np.int32),
+                    )
+                    return children, int(bad), np.asarray(per_target)
+
+                children, bad, per_target = self._retry(
+                    "sharded.forward", _check_step, level=k,
+                    entry=lambda k=k: faults.fire("sharded.forward",
+                                                  level=k),
                 )
-                per_target = np.asarray(per_target)
-                if int(bad) > 0:
+                if bad > 0:
                     raise SolverError(
-                        f"game {g.name}: {int(bad)} children outside levels "
+                        f"game {g.name}: {bad} children outside levels "
                         f"({k}, {kmax}] — level_of/max_level_jump/"
                         "num_levels inconsistent"
                     )
@@ -1624,17 +1636,31 @@ class ShardedSolver:
                                 self._sharding,
                             )
                         pool = empty_pool
-                    merged, mcount = self._merge_fn(pool.shape[1], ccap)(
-                        pool, children, np.full(1, L, np.int32)
+
+                    # Same discipline for the merge dispatch: inputs
+                    # (pool, children) are held across the step, the
+                    # pools[L] assignment lands only on success.
+                    def _merge_step(pool=pool, children=children, L=L,
+                                    ccap=ccap):
+                        merged, mcount = self._merge_fn(
+                            pool.shape[1], ccap
+                        )(pool, children, np.full(1, L, np.int32))
+                        mcounts = np.asarray(mcount).reshape(-1) \
+                            .astype(np.int64)
+                        mcap = bucket_size(int(mcounts.max()),
+                                           self.min_bucket)
+                        return (
+                            self._resize_fn(merged.shape[-1], mcap)(merged),
+                            mcounts,
+                        )
+
+                    pools[L] = self._retry(
+                        "sharded.forward", _merge_step, level=k,
+                        entry=lambda k=k: faults.fire("sharded.forward",
+                                                      level=k),
                     )
                     self.bytes_sorted += (
                         S * (pool.shape[1] + ccap) * (item + compaction)
-                    )
-                    mcounts = np.asarray(mcount).reshape(-1).astype(np.int64)
-                    mcap = bucket_size(int(mcounts.max()), self.min_bucket)
-                    pools[L] = (
-                        self._resize_fn(merged.shape[-1], mcap)(merged),
-                        mcounts,
                     )
             if self.logger is not None:
                 self.logger.log(
@@ -1962,11 +1988,22 @@ class ShardedSolver:
             if k == root_level:
                 # The root answer leaves the device replicated (multi-host
                 # safe) — the only result a big-run solve must produce.
-                v, r = self._root_fn(cap)(
-                    rec.dev, values_dev, rem_dev,
-                    jnp.full((1,), init, dtype=g.state_dtype),
+                # The kernel psums across shards, so the dispatch is
+                # collective-safe-retried like every other step (GM603):
+                # its inputs stay referenced, re-dispatch is idempotent.
+                def _root_step(cap=cap, rec=rec, values_dev=values_dev,
+                               rem_dev=rem_dev):
+                    v, r = self._root_fn(cap)(
+                        rec.dev, values_dev, rem_dev,
+                        jnp.full((1,), init, dtype=g.state_dtype),
+                    )
+                    return int(v), int(r)
+
+                self._root_answer = self._retry(
+                    "sharded.backward", _root_step, level=k,
+                    entry=lambda k=k: faults.fire("sharded.backward",
+                                                  level=k),
                 )
-                self._root_answer = (int(v), int(r))
             if self.checkpointer is not None and not from_checkpoint:
                 # One npz per addressable shard — each multi-host process
                 # writes only the shards it owns, nothing global assembles.

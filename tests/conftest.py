@@ -22,3 +22,24 @@ force_platform("cpu", fake_devices=8)
 import os  # noqa: E402
 
 os.environ["GAMESMAN_DENSE_COUNTS_FILE"] = "0"
+
+# Runtime lock-order witness (docs/ANALYSIS.md "lockdep"): under
+# GAMESMAN_LOCKDEP=1 every obs/serve/resilience lock records its
+# acquisition edges, and a witnessed lock-order cycle fails the run at
+# session teardown — the dynamic validation of the GM2xx/GM6xx static
+# lock model.
+from gamesmanmpi_tpu.analysis import lockdep  # noqa: E402
+
+if lockdep.enabled_by_env():
+    lockdep.install()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if lockdep.enabled_by_env():
+        try:
+            lockdep.assert_acyclic()
+        except lockdep.LockOrderError as e:
+            import sys
+
+            print(f"\nGAMESMAN_LOCKDEP: {e}", file=sys.stderr)
+            session.exitstatus = 3

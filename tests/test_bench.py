@@ -48,6 +48,24 @@ def _run_bench(extra_env, timeout=600):
     return json.loads(lines[0]), proc.stderr
 
 
+def test_bench_hybrid_sym_subrun_keeps_engine():
+    """ADVICE r5 leftover (pinned by ISSUE 10): BENCH_ENGINE=hybrid must
+    NOT gate on game.sym — the secondary sym sub-run benches the SAME
+    hybrid engine as the primary, and the sym sub-record says which
+    engine actually ran so a silent demotion can never masquerade as a
+    hybrid measurement."""
+    record, stderr = _run_bench({
+        "BENCH_ENGINE": "hybrid",
+        "BENCH_SYM": "1",
+    })
+    assert record["engine"] == "hybrid", stderr[-1000:]
+    assert "demoting to the classic engine" not in stderr
+    sym = record.get("sym")
+    assert sym is not None, "sym sub-run missing from the record"
+    assert sym["engine"] == "hybrid", sym
+    assert sym["positions"] > 0
+
+
 @pytest.mark.slow
 def test_bench_dense_happy_path():
     record, _ = _run_bench({"BENCH_ENGINE": "dense"})

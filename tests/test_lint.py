@@ -590,6 +590,719 @@ def test_gm505_dynamic_fire_point(tmp_path):
     assert got == [("GM505", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
 
 
+# ------------------------------------------------- GM6xx: SPMD safety
+
+
+def test_gm601_collective_in_one_rank_arm(tmp_path):
+    build_project(tmp_path, {"mod.py": """
+        import jax
+
+        def step(x):
+            if jax.process_index() == 0:
+                y = jax.lax.psum(x, "i")  # MARK
+                return y
+            return x
+    """})
+    _, got = findings(tmp_path)
+    assert got == [("GM601", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
+
+
+def test_gm601_early_return_under_rank_test(tmp_path):
+    """`if rank != 0: return` then a collective: only rank 0 reaches it."""
+    build_project(tmp_path, {"mod.py": """
+        import jax
+
+        def step(x):
+            rank = jax.process_index()
+            if rank != 0:
+                return x
+            return jax.lax.all_to_all(x, "i", 0, 0)  # MARK
+    """})
+    _, got = findings(tmp_path)
+    assert got == [("GM601", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
+
+
+def test_gm601_through_call_graph(tmp_path):
+    """A collective two calls deep under the rank branch is still found."""
+    build_project(tmp_path, {"mod.py": """
+        import jax
+
+        def _deep(x):
+            return jax.lax.psum(x, "i")
+
+        def _helper(x):
+            return _deep(x)
+
+        def step(x, rank):
+            if rank == 0:
+                return _helper(x)  # MARK
+            return x
+    """})
+    _, got = findings(tmp_path)
+    assert got == [("GM601", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
+
+
+def test_gm601_rank_uniform_branches_pass(tmp_path):
+    """Same collective sequence in both arms, rank-0-only manifest
+    writes, raise-terminated arms, and process_count() tests are all
+    legitimate."""
+    build_project(tmp_path, {"mod.py": """
+        import jax
+
+        def seal(manifest):
+            return manifest
+
+        def step(x, manifest):
+            if jax.process_index() == 0:
+                seal(manifest)  # no collective: fine
+            if jax.process_index() == 0:
+                y = jax.lax.psum(x, "i")
+            else:
+                y = jax.lax.psum(x, "i")
+            if jax.process_count() > 1:
+                y = jax.lax.psum(y, "i")
+            if jax.process_index() > 8:
+                raise ValueError("abort path is exempt")
+            return y
+    """})
+    _, got = findings(tmp_path)
+    assert got == []
+
+
+def test_gm602_collective_order_divergence(tmp_path):
+    build_project(tmp_path, {"mod.py": """
+        import jax
+
+        def step(x, rank):
+            if rank == 0:  # MARK
+                a = jax.lax.psum(x, "i")
+                b = jax.lax.all_to_all(x, "i", 0, 0)
+            else:
+                b = jax.lax.all_to_all(x, "i", 0, 0)
+                a = jax.lax.psum(x, "i")
+            return a, b
+    """})
+    _, got = findings(tmp_path)
+    assert got == [("GM602", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
+
+
+def test_gm603_unrouted_dispatch(tmp_path):
+    """In a module with _retry_collective, fetching+invoking a built
+    collective kernel outside a retry thunk is flagged; the routed twin
+    passes."""
+    build_project(tmp_path, {"mod.py": """
+        import jax
+
+        def shard_map(f):
+            return f
+
+        def get_kernel(key, build):
+            return build()
+
+        class Eng:
+            def _retry(self, point, fn):
+                return self._retry_collective(point, fn)
+
+            def _retry_collective(self, point, fn):
+                return fn()
+
+            def _kernel_fn(self):
+                def build():
+                    def body(x):
+                        return jax.lax.all_to_all(x, "i", 0, 0)
+                    return shard_map(body)
+                return get_kernel("k", build)
+
+            def good(self, x):
+                def _step():
+                    return self._kernel_fn()(x)
+                return self._retry("p", _step)
+
+            def bad(self, x):
+                return self._kernel_fn()(x)  # MARK
+    """})
+    _, got = findings(tmp_path)
+    assert got == [("GM603", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
+
+
+def test_gm604_collective_under_lock(tmp_path):
+    build_project(tmp_path, {"mod.py": """
+        import threading
+        import jax
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self, x):
+                with self._lock:
+                    return jax.lax.psum(x, "i")  # MARK
+
+            def good(self, x):
+                with self._lock:
+                    y = x + 1
+                return jax.lax.psum(y, "i")
+    """})
+    _, got = findings(tmp_path)
+    assert got == [("GM604", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
+
+
+def test_gm604_barrier_on_coord_handle_under_lock(tmp_path):
+    build_project(tmp_path, {"mod.py": """
+        import threading
+
+        class Box:
+            def __init__(self, coord):
+                self._lock = threading.Lock()
+                self.coord = coord
+
+            def bad(self):
+                with self._lock:
+                    self.coord.barrier("resume")  # MARK
+    """})
+    _, got = findings(tmp_path)
+    assert got == [("GM604", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
+
+
+# -------------------------------------------- GM7xx: resource lifecycle
+
+
+def test_gm701_unguarded_open(tmp_path):
+    build_project(tmp_path, {"mod.py": """
+        def leak(path):
+            f = open(path)  # MARK
+            data = f.read()
+            f.close()
+            return data
+    """})
+    _, got = findings(tmp_path)
+    assert got == [("GM701", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
+
+
+def test_gm701_popen_discarded(tmp_path):
+    build_project(tmp_path, {"mod.py": """
+        import subprocess
+
+        def leak(cmd):
+            proc = subprocess.Popen(cmd)  # MARK
+            return proc.pid
+    """})
+    _, got = findings(tmp_path)
+    assert got == [("GM701", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
+
+
+def test_gm701_self_field_never_released(tmp_path):
+    build_project(tmp_path, {"mod.py": """
+        import subprocess
+
+        class Held:
+            def __init__(self, cmd):
+                self.proc = subprocess.Popen(cmd)  # MARK
+    """})
+    _, got = findings(tmp_path)
+    assert got == [("GM701", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
+
+
+def test_gm701_clean_patterns_pass(tmp_path):
+    """with, try/finally, ownership transfer (return / argument /
+    container / tracked self field), and daemon threads are all fine."""
+    build_project(tmp_path, {"mod.py": """
+        import subprocess
+        import threading
+
+        def ok_with(path):
+            with open(path) as f:
+                return f.read()
+
+        def ok_finally(path):
+            f = open(path)
+            try:
+                return f.read()
+            finally:
+                f.close()
+
+        def ok_return(path):
+            return open(path)
+
+        def ok_transfer(cmd, registry):
+            proc = subprocess.Popen(cmd)
+            registry.track(proc)
+
+        def ok_daemon():
+            t = threading.Thread(target=print, daemon=True)
+            t.start()
+
+        class Tracked:
+            def __init__(self, cmd):
+                self.proc = subprocess.Popen(cmd)
+
+            def stop(self):
+                self.proc.kill()
+                self.proc.wait()
+    """})
+    _, got = findings(tmp_path)
+    assert got == []
+
+
+def test_gm701_awaited_acquisition_does_not_crash(tmp_path):
+    """An acquisition under `await` unwraps to its binding instead of
+    crashing the scan (regression: NameError in _context_of)."""
+    build_project(tmp_path, {"mod.py": """
+        import os
+
+        async def ok(fd, registry):
+            f = await os.fdopen(fd)
+            registry.track(f)
+    """})
+    _, got = findings(tmp_path)
+    assert got == []
+
+
+def test_gm701_from_import_popen_still_flagged(tmp_path):
+    """`from subprocess import Popen` must not blind the checker."""
+    build_project(tmp_path, {"mod.py": """
+        from subprocess import Popen
+
+        def leak(cmd):
+            proc = Popen(cmd)  # MARK
+            return proc.pid
+    """})
+    _, got = findings(tmp_path)
+    assert got == [("GM701", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
+
+
+def test_gm702_from_import_lock_before_fork(tmp_path):
+    build_project(tmp_path, {"mod.py": """
+        import os
+        from threading import Lock
+
+        def bad_spawn():
+            lk = Lock()  # MARK
+            pid = os.fork()
+            return pid, lk
+    """})
+    _, got = findings(tmp_path)
+    assert got == [("GM702", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
+
+
+def test_gm702_thread_and_lock_before_fork(tmp_path):
+    build_project(tmp_path, {"mod.py": """
+        import os
+        import threading
+
+        def bad_spawn():
+            t = threading.Thread(target=print, daemon=True)  # MARK
+            t.start()
+            pid = os.fork()
+            return pid
+
+        def ok_spawn():
+            pid = os.fork()
+            if pid == 0:
+                t = threading.Thread(target=print, daemon=True)
+                t.start()
+            return pid
+    """})
+    _, got = findings(tmp_path)
+    assert got == [("GM702", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
+
+
+# ---------------------------------------- GM8xx: atomic-write discipline
+
+
+def test_gm801_direct_write_bypasses_discipline(tmp_path):
+    build_project(tmp_path, {"mod.py": """
+        import json
+        import os
+
+        import numpy as np
+
+        def good(path, manifest):
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as fh:
+                json.dump(manifest, fh)
+            os.replace(tmp, path)
+
+        def bad(path, arr):
+            np.savez(path, data=arr)  # MARK
+    """})
+    _, got = findings(tmp_path)
+    assert got == [("GM801", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
+
+
+def test_gm801_sealed_write_annotation_exempts(tmp_path):
+    build_project(tmp_path, {"mod.py": """
+        import numpy as np
+
+        # sealed-write: payload sealed by the caller's manifest
+        def payload_helper(path, arr):
+            np.save(path, arr)
+    """})
+    _, got = findings(tmp_path)
+    assert got == []
+
+
+def test_gm801_non_participating_module_exempt(tmp_path):
+    """A report tool that never practices atomicity is out of scope."""
+    build_project(tmp_path, {"mod.py": """
+        import json
+
+        def write_report(path, rows):
+            with open(path, "w") as fh:
+                json.dump(rows, fh)
+    """})
+    _, got = findings(tmp_path)
+    assert got == []
+
+
+def test_gm802_payload_after_seal(tmp_path):
+    build_project(tmp_path, {"mod.py": """
+        import os
+
+        import numpy as np
+
+        def bad(ckpt, path, arr):
+            ckpt.seal_level(3)
+            np.save(path + ".tmp", arr)  # MARK
+            os.replace(path + ".tmp", path)
+
+        def good(ckpt, path, arr):
+            np.save(path + ".tmp", arr)
+            os.replace(path + ".tmp", path)
+            ckpt.seal_level(3)
+    """})
+    _, got = findings(tmp_path)
+    assert got == [("GM802", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
+
+
+# ------------------------------------------------ lockdep (runtime witness)
+
+
+def test_lockdep_witnesses_cycle(tmp_path):
+    import threading
+
+    from gamesmanmpi_tpu.analysis import lockdep
+
+    with lockdep.witness(watch=(str(tmp_path),), check=False) as ld:
+        # construction sites must be inside the watched path
+        src = tmp_path / "locks_fixture.py"
+        src.write_text(
+            "import threading\n"
+            "a = threading.Lock()\n"
+            "b = threading.Lock()\n"
+        )
+        ns: dict = {}
+        exec(compile(src.read_text(), str(src), "exec"), ns)
+        a, b = ns["a"], ns["b"]
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert len(ld.edges()) == 2
+        assert ld.cycles()
+        with pytest.raises(lockdep.LockOrderError):
+            ld.assert_acyclic()
+    # uninstalled afterwards: plain locks again
+    assert type(threading.Lock()).__name__ != "_LockProxy"
+
+
+def test_lockdep_consistent_order_is_acyclic(tmp_path):
+    from gamesmanmpi_tpu.analysis import lockdep
+
+    with lockdep.witness(watch=(str(tmp_path),), check=False) as ld:
+        src = tmp_path / "ok_fixture.py"
+        src.write_text(
+            "import threading\n"
+            "a = threading.Lock()\n"
+            "b = threading.Lock()\n"
+        )
+        ns: dict = {}
+        exec(compile(src.read_text(), str(src), "exec"), ns)
+        a, b = ns["a"], ns["b"]
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert ld.edges() and ld.cycles() == []
+        ld.assert_acyclic()
+
+
+def test_lockdep_rlock_reentry_records_no_self_edge(tmp_path):
+    from gamesmanmpi_tpu.analysis import lockdep
+
+    with lockdep.witness(watch=(str(tmp_path),), check=False) as ld:
+        src = tmp_path / "rlock_fixture.py"
+        src.write_text("import threading\nr = threading.RLock()\n")
+        ns: dict = {}
+        exec(compile(src.read_text(), str(src), "exec"), ns)
+        r = ns["r"]
+        with r:
+            with r:
+                pass
+        assert ld.edges() == []
+        ld.assert_acyclic()
+
+
+def test_lockdep_condition_wait_releases_held_state(tmp_path):
+    """Condition.wait over an instrumented lock must drop the held
+    entry (no phantom edges from the waiting thread)."""
+    import threading
+
+    from gamesmanmpi_tpu.analysis import lockdep
+
+    with lockdep.witness(watch=(str(tmp_path),), check=False) as ld:
+        src = tmp_path / "cond_fixture.py"
+        src.write_text(
+            "import threading\n"
+            "lk = threading.Lock()\n"
+            "other = threading.Lock()\n"
+        )
+        ns: dict = {}
+        exec(compile(src.read_text(), str(src), "exec"), ns)
+        lk, other = ns["lk"], ns["other"]
+        cond = threading.Condition(lk)
+        done = []
+
+        def waiter():
+            with cond:
+                cond.wait(timeout=5)
+                done.append(1)
+            with other:  # held state clean: no lk->other edge pending
+                pass
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.2)
+        with cond:
+            cond.notify()
+        t.join(timeout=5)
+        assert done == [1]
+        assert all(a != b for a, b in ld.edges())
+        ld.assert_acyclic()
+
+
+def test_lockdep_same_site_locks_keep_distinct_nodes(tmp_path):
+    """Two locks born at the same line (a loop) must stay distinct
+    graph nodes — an inversion BETWEEN them is a real deadlock and must
+    still be witnessed."""
+    from gamesmanmpi_tpu.analysis import lockdep
+
+    with lockdep.witness(watch=(str(tmp_path),), check=False) as ld:
+        src = tmp_path / "same_site_fixture.py"
+        src.write_text(
+            "import threading\n"
+            "locks = [threading.Lock() for _ in range(2)]\n"
+        )
+        ns: dict = {}
+        exec(compile(src.read_text(), str(src), "exec"), ns)
+        a, b = ns["locks"]
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert len(ld.instrumented()) == 2  # distinct names per instance
+        assert ld.cycles(), ld.edges()
+
+
+def test_lockdep_condition_wait_over_reentrant_rlock(tmp_path):
+    """wait() on a Condition over an RLock held at depth 2 must restore
+    the proxy's depth on wake-up: the edges recorded AFTER the wait
+    prove the lock still counts as held."""
+    import threading
+
+    from gamesmanmpi_tpu.analysis import lockdep
+
+    with lockdep.witness(watch=(str(tmp_path),), check=False) as ld:
+        src = tmp_path / "rlock_cond_fixture.py"
+        src.write_text(
+            "import threading\n"
+            "r = threading.RLock()\n"
+            "other = threading.Lock()\n"
+        )
+        ns: dict = {}
+        exec(compile(src.read_text(), str(src), "exec"), ns)
+        r, other = ns["r"], ns["other"]
+        cond = threading.Condition(r)
+        done = []
+
+        def waiter():
+            with r:          # depth 1
+                with cond:   # depth 2 (condition aliases r)
+                    cond.wait(timeout=5)
+                    done.append(1)
+                with other:  # r still held: edge r -> other
+                    pass
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.2)
+        with cond:
+            cond.notify()
+        t.join(timeout=5)
+        assert done == [1]
+        assert any("rlock_cond_fixture.py:2" in a
+                   and "rlock_cond_fixture.py:3" in b
+                   for a, b in ld.edges()), ld.edges()
+        ld.assert_acyclic()
+
+
+def test_lockdep_witness_restores_outer_install(tmp_path):
+    """A scoped witness over a session-wide install (GAMESMAN_LOCKDEP=1
+    via conftest) must restore the outer watch list, edge graph, and
+    instrumentation on exit — not blind the rest of the session."""
+    import threading
+
+    from gamesmanmpi_tpu.analysis import lockdep
+
+    lockdep.install(watch=(str(tmp_path),))
+    try:
+        src = tmp_path / "outer_fixture.py"
+        src.write_text(
+            "import threading\n"
+            "a = threading.Lock()\n"
+            "b = threading.Lock()\n"
+        )
+        ns: dict = {}
+        exec(compile(src.read_text(), str(src), "exec"), ns)
+        with ns["a"]:
+            with ns["b"]:
+                pass
+        outer_edges = lockdep.edges()
+        assert len(outer_edges) == 1
+
+        with lockdep.witness(watch=("/nonexistent/",), check=False) as ld:
+            assert ld.edges() == []  # clean slate inside
+
+        # outer install intact: edges restored, still instrumenting
+        assert lockdep.edges() == outer_edges
+        ns2: dict = {}
+        exec(compile(src.read_text(), str(src), "exec"), ns2)
+        assert type(ns2["a"]).__name__ == "_LockProxy"
+    finally:
+        lockdep.uninstall()
+        lockdep.reset()
+
+
+def test_lockdep_instruments_real_subsystems():
+    """The ISSUE-10 acceptance wiring: under a witness, constructing the
+    real obs/serve/resilience lock users records their construction
+    sites, exercising them records any acquisition edges, and the
+    session-level acyclicity assertion passes."""
+    from gamesmanmpi_tpu.analysis import lockdep
+
+    with lockdep.witness() as ld:
+        from gamesmanmpi_tpu.obs.registry import MetricsRegistry
+        from gamesmanmpi_tpu.resilience.coordination import (
+            CoordinatorServer,
+            EpochBarrier,
+        )
+        from gamesmanmpi_tpu.serve.batcher import Batcher
+
+        reg = MetricsRegistry()
+        reg.counter("gamesman_lockdep_test_total", "d").inc()
+        reg.histogram("gamesman_lockdep_test_seconds", "d").observe(0.1)
+        reg.snapshot()
+
+        class _StubReader:
+            def lookup_best(self, positions):
+                return [None] * len(positions)
+
+        batcher = Batcher(_StubReader(), window=0.01, cache_size=8)
+        batcher.close()
+
+        srv = CoordinatorServer(1, deadline=5.0)
+        try:
+            bar = EpochBarrier(srv.address, 0, deadline=5.0)
+            assert bar.propose("lockdep", "ok") == "ok"
+        finally:
+            srv.close()
+
+        sites = ld.instrumented()
+        assert any("obs/registry" in s for s in sites), sites
+        assert any("serve/batcher" in s for s in sites), sites
+        assert any("resilience/coordination" in s for s in sites), sites
+        ld.assert_acyclic()
+
+
+# --------------------------------------------------------- --changed-only
+
+
+def _git(cwd, *argv):
+    return subprocess.run(
+        ["git", "-C", str(cwd), "-c", "user.email=l@l", "-c",
+         "user.name=lint", *argv],
+        capture_output=True, text=True, check=True,
+    )
+
+
+def test_changed_only_scopes_reporting_not_scanning(tmp_path, capsys):
+    """--changed-only: a finding in an UNchanged file is not reported,
+    a finding in a changed file fails the run with the same exit
+    semantics, and whole-project registry parity (GM303 needs every
+    reader) keeps working because the scan stays global."""
+    if shutil.which("git") is None:
+        pytest.skip("git not available")
+    build_project(
+        tmp_path,
+        {
+            "stale.py": """
+                import os
+                X = os.environ.get("PATH")
+            """,
+            "fresh.py": "x = 1\n",
+        },
+        config_md=CONFIG_HEADER,
+    )
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+
+    # Nothing changed: exit 0 even though stale.py holds a finding.
+    rc = lint_main(["--root", str(tmp_path), "--changed-only"])
+    assert rc == 0
+    assert "no lint targets changed" in capsys.readouterr().err
+
+    # Change ONLY fresh.py, introducing a new finding there.
+    (tmp_path / "pkg" / "fresh.py").write_text(
+        "import os\nY = os.environ.get(\"HOME\")\n"
+    )
+    rc = lint_main(["--root", str(tmp_path), "--changed-only"])
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "pkg/fresh.py" in out.out
+    assert "pkg/stale.py" not in out.out  # unchanged: not reported
+
+    # Baseline semantics unchanged: a baselined finding in the changed
+    # file demotes to exit 0.
+    from gamesmanmpi_tpu.analysis.runner import run_project
+
+    res = run_project(tmp_path)
+    write_baseline(tmp_path / "lint_baseline.json", res.fingerprints)
+    assert lint_main(["--root", str(tmp_path), "--changed-only"]) == 0
+    capsys.readouterr()
+
+    # The full run still sees both findings (scan scope never shrank).
+    assert lint_main(["--root", str(tmp_path), "--no-baseline"]) == 1
+    full = capsys.readouterr().out
+    assert "pkg/stale.py" in full and "pkg/fresh.py" in full
+
+    # Refuses to combine with --update-baseline or explicit paths.
+    assert lint_main(["--root", str(tmp_path), "--changed-only",
+                      "--update-baseline"]) == 2
+    assert lint_main(["--root", str(tmp_path), "--changed-only",
+                      "pkg/fresh.py"]) == 2
+    capsys.readouterr()
+
+    # A junk base ref is a usage error, not a traceback.
+    assert lint_main(["--root", str(tmp_path), "--changed-only",
+                      "--base-ref", "no_such_ref"]) == 2
+    capsys.readouterr()
+
+
 # --------------------------------------------- suppressions + baseline
 
 
@@ -808,6 +1521,9 @@ def test_repository_lints_clean():
     assert len(res.suppressed) <= 8, [d.format() for d in res.suppressed]
     assert len(res.project.files) > 50  # discovery actually found the repo
     assert elapsed < 60, f"lint took {elapsed:.1f}s — too slow for tier-1"
+    # The cross-module call graph (ISSUE 10) is the expensive index; the
+    # 60 s budget above holds because every checker shares ONE build.
+    assert res.project.callgraph_builds == 1
 
 
 def test_repository_passes_ruff():

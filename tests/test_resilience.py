@@ -193,6 +193,72 @@ def test_transient_absorbed_generic_path():
     assert full_table(result) == oracle
 
 
+def _fire_counts(game_spec, num_shards=2):
+    """Per-point fault fire sequence of one clean sharded solve —
+    locates specific retried units by visit index."""
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+
+    seq = []
+    real_fire = faults.fire
+
+    def recording_fire(point, **kw):
+        seq.append(point)
+        return real_fire(point, **kw)
+
+    faults.fire = recording_fire
+    try:
+        result = ShardedSolver(get_game(game_spec), num_shards=num_shards
+                               ).solve()
+    finally:
+        faults.fire = real_fire
+    return result, seq
+
+
+def test_transient_absorbed_generic_sharded_check_merge():
+    """GM603 regression (lint round 10): the generic forward path's
+    level-check and merge dispatches are collective-safe-retried. Visit
+    2 of sharded.forward on a multi-jump game is the first level's
+    check step (visit 1 is its frontier expansion) — a transient there
+    must be absorbed oracle-exact, not crash the solve."""
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+    from gamesmanmpi_tpu.solve.oracle import oracle_solve
+    from helpers import REF_GAMES, load_module
+
+    clean, seq = _fire_counts("nim:heaps=3-4-5")
+    forward_fires = seq.count("sharded.forward")
+    # the generic path must fire MORE than once per level now that the
+    # check/merge units are routed through _retry
+    assert forward_fires > clean.stats["levels"], (forward_fires, seq)
+    faults.configure("sharded.forward:transient:2")
+    result = ShardedSolver(get_game("nim:heaps=3-4-5"), num_shards=2
+                           ).solve()
+    assert result.stats["retries"] >= 1
+    _, _, oracle = oracle_solve(load_module(REF_GAMES / "nim_345.py"))
+    assert full_table(result) == oracle
+
+
+def test_transient_absorbed_at_sharded_root_step(c3_clean):
+    """GM603 regression (lint round 10): the backward root-answer
+    dispatch (a psum across shards) is retried too. The LAST
+    sharded.backward fire of a solve is the root step — inject a
+    transient exactly there and require absorption with the exact
+    root answer."""
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+
+    _, seq = _fire_counts(_C3)
+    last_backward_visit = seq.count("sharded.backward")
+    assert last_backward_visit > 0
+    faults.configure(
+        f"sharded.backward:transient:{last_backward_visit}"
+    )
+    result = ShardedSolver(get_game(_C3), num_shards=2).solve()
+    assert result.stats["retries"] >= 1
+    assert (result.value, result.remoteness) == (
+        c3_clean.value, c3_clean.remoteness
+    )
+    assert full_table(result) == full_table(c3_clean)
+
+
 def test_fatal_fails_fast_with_checkpoint_prefix_intact(tmp_path, c3_clean):
     """A fatal error mid-backward aborts immediately; the levels sealed
     before it remain loadable and the next run resumes to parity."""
