@@ -473,6 +473,160 @@ def _serve_bench() -> dict | None:
     return artifact
 
 
+def _store_bench() -> dict | None:
+    """BENCH_STORE=1: the block-store I/O-overlap A/B (ISSUE 11).
+
+    One spill-forcing sharded solve — device-store budget 0 so every
+    discovered level and edge array leaves HBM, host tier squeezed to a
+    few MB so edge arrays drop to the DISK tier (their sealed
+    per-(level, shard) files become the only copy) — run twice from a
+    cold checkpoint directory:
+
+    * **sync** — `GAMESMAN_STORE_PREFETCH_THREADS=0`,
+      `GAMESMAN_STORE_WRITEBEHIND=0`: every sealed read and every
+      DEFLATE+fsync blocks the solve thread, exactly the pre-store
+      code's behavior; `io_wait_secs` is the full I/O bill.
+    * **prefetch** — the store's defaults: the backward schedule's
+      readahead hints decode the next level's edge/checkpoint shards
+      while the current level computes, and payload writes ride the
+      write-behind worker.
+
+    Gates: the prefetch arm's `io_wait_secs` strictly below the sync
+    arm's, and the two `--table-out` tables byte-identical (the overlap
+    must change WHEN bytes move, never WHICH bytes). Runs in the PARENT
+    (subprocess-only, never touches jax); any failure is recorded, not
+    raised. Full record → BENCH_STORE_OUT; summary joins the bench
+    record under `store`.
+    """
+    if os.environ.get("BENCH_STORE", "0") in ("0", "", "off"):
+        return None
+    import tempfile
+
+    import numpy as np
+
+    spec = os.environ.get("BENCH_STORE_GAME", "connect4:w=4,h=4")
+    shards = int(_env_float("BENCH_STORE_SHARDS", 2))
+    out_path = os.environ.get("BENCH_STORE_OUT", "BENCH_store.json")
+    deadline = _env_float("GAMESMAN_BENCH_DEADLINE", 3000.0)
+    record: dict = {
+        "bench": "store_prefetch_ab",
+        "spec": spec,
+        "shards": shards,
+        "config": {
+            "GAMESMAN_DEVICE_STORE_MB": "0",
+            "GAMESMAN_STORE_CACHE_MB": "4",
+        },
+    }
+
+    def _arm(name: str, workdir: str, env: dict) -> dict:
+        table = os.path.join(workdir, f"{name}.npz")
+        metrics = os.path.join(workdir, f"{name}.jsonl")
+        base = {
+            "GAMESMAN_PLATFORM": "cpu",
+            "GAMESMAN_FAKE_DEVICES": str(shards),
+            # Spill-forcing: nothing stays in HBM between phases, and
+            # the host tier is too small for the edge arrays — the
+            # backward's edge loads come from sealed files on disk.
+            "GAMESMAN_DEVICE_STORE_MB": "0",
+            "GAMESMAN_STORE_CACHE_MB": "4",
+        }
+        base.update(env)
+        child_env = dict(os.environ)
+        child_env.pop("GAMESMAN_FAULTS", None)
+        child_env.update(base)
+        proc = subprocess.run(
+            [sys.executable, "-m", "gamesmanmpi_tpu.cli", spec,
+             "--devices", str(shards),
+             "--checkpoint-dir", os.path.join(workdir, f"{name}_ck"),
+             "--table-out", table, "--jsonl", metrics],
+            capture_output=True, text=True, timeout=deadline,
+            env=child_env, cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        arm: dict = {"rc": proc.returncode, "table": table}
+        if proc.returncode != 0:
+            arm["error"] = proc.stderr[-1000:]
+            return arm
+        done = None
+        try:
+            with open(metrics) as fh:
+                for line in fh:
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if rec.get("phase") == "done":
+                        done = rec
+        except OSError as e:
+            arm["error"] = f"metrics unreadable: {e}"
+            return arm
+        if done is None:
+            arm["error"] = "no done record in metrics stream"
+            return arm
+        for key in ("io_wait_secs", "prefetch_hits", "prefetch_misses",
+                    "prefetch_hit_rate", "writebehind_writes",
+                    "writebehind_queue_depth", "edges_bytes_disk",
+                    "edges_bytes_spilled", "positions", "secs_total",
+                    "positions_per_sec"):
+            if key in done:
+                arm[key] = done[key]
+        return arm
+
+    try:
+        with tempfile.TemporaryDirectory(prefix="bench_store_") as wd:
+            record["sync"] = _arm("sync", wd, {
+                "GAMESMAN_STORE_PREFETCH_THREADS": "0",
+                "GAMESMAN_STORE_WRITEBEHIND": "0",
+            })
+            record["prefetch"] = _arm("prefetch", wd, {
+                "GAMESMAN_STORE_PREFETCH_THREADS": "2",
+                "GAMESMAN_STORE_WRITEBEHIND": "1",
+            })
+            sync, pref = record["sync"], record["prefetch"]
+            if "error" not in sync and "error" not in pref:
+                record["io_wait_ok"] = bool(
+                    pref["io_wait_secs"] < sync["io_wait_secs"]
+                )
+                # Byte parity: --table-out is always PLAIN npz (the
+                # user-facing format), so member-wise equality IS the
+                # solved-table equality proof.
+                parity = True
+                with np.load(sync["table"]) as za, \
+                        np.load(pref["table"]) as zb:
+                    parity = sorted(za.files) == sorted(zb.files) and all(
+                        np.array_equal(za[f], zb[f]) for f in za.files
+                    )
+                record["parity_ok"] = bool(parity)
+                record["io_wait_ratio"] = round(
+                    pref["io_wait_secs"]
+                    / max(sync["io_wait_secs"], 1e-9), 4
+                )
+                record["ok"] = bool(
+                    record["io_wait_ok"] and record["parity_ok"]
+                )
+            else:
+                record["ok"] = False
+                record["error"] = (
+                    sync.get("error") or pref.get("error") or "arm failed"
+                )
+            # The table paths die with the tempdir — drop them from the
+            # committed artifact.
+            sync.pop("table", None)
+            pref.pop("table", None)
+    except Exception as e:  # noqa: BLE001 - must never kill the bench
+        record["ok"] = False
+        record["error"] = f"{type(e).__name__}: {e}"
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(record, fh, indent=1)
+            fh.write("\n")
+        print(f"store bench: wrote {out_path} "
+              f"(ok={record.get('ok')})", file=sys.stderr)
+    except OSError as e:
+        print(f"store bench: cannot write {out_path}: {e}",
+              file=sys.stderr)
+    return record
+
+
 def _db_compress_bench() -> dict | None:
     """BENCH_DB_COMPRESS=1: the compressed-DB ratio + latency benchmark
     (ROADMAP item 2 / ISSUE 9).
@@ -777,6 +931,19 @@ def main() -> int:
             if arm in dbc:
                 record["db_compress"][f"{arm}_p99_ms"] = \
                     dbc[arm].get("p99_ms")
+    sb = _store_bench()
+    if sb is not None:
+        # Summary only — the per-arm stats live in the artifact file
+        # (BENCH_STORE_OUT); the one-line record stays one line.
+        record["store"] = {
+            k: sb.get(k) for k in
+            ("ok", "io_wait_ok", "parity_ok", "io_wait_ratio", "error")
+            if k in sb
+        }
+        for arm in ("sync", "prefetch"):
+            if arm in sb and "io_wait_secs" in sb[arm]:
+                record["store"][f"{arm}_io_wait_secs"] = \
+                    sb[arm]["io_wait_secs"]
     sv = _serve_bench()
     if sv is not None:
         # Summary only — the full load/chaos record lives in the
@@ -971,6 +1138,11 @@ def inner() -> int:
                     traffic / max(stats.get("secs_total", 0.0), 1e-9)
                     / 1e9, 3),
             },
+            # ISSUE 11: seconds the solve thread spent blocked on block-
+            # store I/O (spill/checkpoint/edge loads + seal drains) —
+            # 0.0 for in-memory solves; future BENCH_*.json track I/O
+            # overlap alongside throughput.
+            "io_wait_secs": round(stats.get("io_wait_secs", 0.0), 3),
         }
         if "shards" in stats:
             # Sharded engine only: the shard count that ACTUALLY ran (a

@@ -28,10 +28,23 @@ Conventions:
   (``tmp``/``*.tmp``) and the same function calls ``os.replace``;
 * ``*.lock`` sentinel files are exempt — they carry no payload.
 
+**store-io (GM803)**: since ISSUE 11, every sealed payload READ —
+checkpoint/spill npz (``level_*``/``frontier*``/``edges_*``/
+``dense_*``), DB block streams (``.gmb``) and level ``.npy`` pairs —
+goes through ``gamesmanmpi_tpu/store/`` (crc-verified sealed reads,
+the shared byte-budget cache, prefetch). A direct ``np.load`` /
+``os.pread`` / ``open(..., "rb")`` of such a payload anywhere else
+bypasses the cache AND the single quarantine/degrade door, which is
+exactly how the three near-duplicate torn-read implementations this
+refactor deleted grew in the first place. Deliberate escapes (the
+integrity gate must read raw bytes) annotate with
+``# store-io: <why>`` on the call line or the comment line above.
+
 | id | finding |
 |---|---|
 | GM801 | direct write bypasses both atomic-write disciplines |
 | GM802 | payload written after the manifest seal in the same function |
+| GM803 | direct payload read bypasses the block store (store-io) |
 """
 
 from __future__ import annotations
@@ -161,10 +174,80 @@ def _check_function(src: SourceFile, fn,
             ))
 
 
+_STORE_IO_RE = re.compile(r"#\s*store-io:\s*(\S.*)")
+
+#: Payload-name evidence for GM803: any of these in a read call's
+#: string constants or source line marks the target as sealed payload.
+#: Narrow on purpose — a generic ``np.load(path)`` of a user artifact
+#: is not a finding; reading a checkpoint/DB payload by its naming
+#: convention is.
+_PAYLOAD_TOKEN_RE = re.compile(
+    r"\.gmb|level_\d|level_\{|\blevel_key|\blevel_cell"
+    r"|frontier|edges_|dense_|\.shard_"
+    r"|rec\[[\"'](?:keys|cells)[\"']\]"
+)
+
+#: Read calls GM803 audits: np.load (mmap or whole-file), os.pread, and
+#: binary open. (Writes are GM801's territory.)
+_READ_CALLS = {"np.load", "numpy.load", "os.pread"}
+
+
+def _is_payload_read(src: SourceFile, call: ast.Call) -> bool:
+    name = call_name(call)
+    is_open_rb = False
+    if name == "open":
+        # Positional or keyword mode — open(p, mode="rb") must not
+        # slip past the rule.
+        mode = call.args[1] if len(call.args) >= 2 else next(
+            (kw.value for kw in call.keywords if kw.arg == "mode"), None
+        )
+        is_open_rb = (
+            isinstance(mode, ast.Constant)
+            and isinstance(mode.value, str)
+            and "r" in mode.value and "b" in mode.value
+        )
+    if name not in _READ_CALLS and not is_open_rb:
+        return False
+    # Evidence: string constants inside the call, or the call's own
+    # source line(s) — covers f-strings, Path /-joins, and rec["keys"].
+    end = getattr(call, "end_lineno", call.lineno) or call.lineno
+    text = "\n".join(src.lines[call.lineno - 1:end])
+    if _PAYLOAD_TOKEN_RE.search(text):
+        return True
+    for n in ast.walk(call):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                and _PAYLOAD_TOKEN_RE.search(n.value):
+            return True
+    return False
+
+
+def _check_store_io(src: SourceFile, diags: List[Diagnostic]) -> None:
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not _is_payload_read(src, node):
+            continue
+        if any(_STORE_IO_RE.search(t)
+               for t in directive_lines(src.lines, node.lineno)):
+            continue  # annotated deliberate escape
+        diags.append(Diagnostic(
+            src.rel, node.lineno, "GM803",
+            "direct payload read bypasses the block store — route "
+            "through gamesmanmpi_tpu/store (sealed_read/loadz/"
+            "SealedBlockStream) or annotate a deliberate escape with "
+            "# store-io: <why>",
+        ))
+
+
 def check(project: Project) -> List[Diagnostic]:
     diags: List[Diagnostic] = []
     for src in project.files:
-        if src.tree is None or not _module_participates(src):
+        if src.tree is None:
+            continue
+        in_store = "store" in src.rel.replace("\\", "/").split("/")
+        if not in_store:
+            _check_store_io(src, diags)
+        if not _module_participates(src):
             continue
         for node in ast.walk(src.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):

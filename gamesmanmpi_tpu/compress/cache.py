@@ -33,12 +33,19 @@ from collections import OrderedDict
 class BlockCache:
     """LRU of decoded block payloads, bounded by total decoded bytes."""
 
-    def __init__(self, budget_bytes: int, *, registry=None, labels=None):
+    def __init__(self, budget_bytes: int, *, registry=None, labels=None,
+                 instruments=None):
         """labels: metric labels distinguishing THIS cache's series on a
         shared registry (DbReader passes ``db=<dir name>``). Without
         them, two caches in one process would share one registry child
         and the bytes gauge would be last-writer-wins — exactly the
-        multi-route fleet worker shape."""
+        multi-route fleet worker shape.
+
+        instruments: pre-built (hits, misses, evictions, bytes) registry
+        children for subclasses that export under a DIFFERENT family
+        name (store.TieredCache's ``gamesman_store_cache_*``) — metric
+        names must stay literal at their creation site (GM403), so the
+        name cannot be a constructor parameter here."""
         self.budget_bytes = int(budget_bytes)
         self._lock = threading.Lock()
         self._map: OrderedDict = OrderedDict()  # guarded-by: _lock
@@ -48,7 +55,10 @@ class BlockCache:
         self._evictions = 0  # guarded-by: _lock
         self._m_hits = self._m_misses = self._m_evictions = None
         self._m_bytes = None
-        if registry is not None:
+        if instruments is not None:
+            (self._m_hits, self._m_misses, self._m_evictions,
+             self._m_bytes) = instruments
+        elif registry is not None:
             lbl = dict(labels or {})
             self._m_hits = registry.counter(
                 "gamesman_db_cache_hits_total",
@@ -113,6 +123,13 @@ class BlockCache:
             self._m_evictions.inc(evicted)
         if self._m_bytes is not None:
             self._m_bytes.set(now_bytes)
+
+    def contains(self, key) -> bool:
+        """Residency peek: no recency refresh, no hit/miss accounting —
+        the store's hint() uses it so readahead probing never skews the
+        cache's observed hit rate."""
+        with self._lock:
+            return key in self._map
 
     def __len__(self) -> int:
         with self._lock:

@@ -97,8 +97,11 @@ def check_db(directory, verbose=None) -> list[str]:
                 if verbose is not None:
                     verbose(f"{tag}: {n} positions OK (blocked)")
             continue
+        # The integrity gate must see exactly what is on disk, never a
+        # cached decode, so it bypasses the block store by design.
+        # store-io: integrity gate reads raw payload bytes on purpose
         keys = np.load(directory / rec["keys"], mmap_mode="r")
-        cells = np.load(directory / rec["cells"], mmap_mode="r")
+        cells = np.load(directory / rec["cells"], mmap_mode="r")  # store-io: raw gate read
         if keys.dtype != dt:
             problems.append(
                 f"{tag}: keys dtype {keys.dtype}, manifest says {dt}"
@@ -187,6 +190,7 @@ def _check_blocked_level(directory, rec, dt, sentinel, tag, problems):
     total = 0
     undecided = 0
     try:
+        # store-io: block-by-block gate reads the raw streams on purpose
         with open(kpath, "rb") as kf, open(cpath, "rb") as cf:
             for b in range(num_blocks(kindex)):
                 keys, cells = _read_block_pair(
@@ -321,16 +325,20 @@ class _LevelRangeReader:
             self._coffs = index_offsets(self._cindex)
             self._kf = self._cf = None
             try:
+                # The equality verdict must not share a cache with the
+                # readers it is auditing.
+                # store-io: streaming compare reads raw bytes on purpose
                 self._kf = open(directory / rec["keys"], "rb")
-                self._cf = open(directory / rec["cells"], "rb")
+                self._cf = open(directory / rec["cells"], "rb")  # store-io: raw gate read
             except BaseException:
                 # A half-built reader is never returned to the caller's
                 # close() bookkeeping — release what DID open.
                 self.close()
                 raise
         else:
-            self._keys = np.load(directory / rec["keys"], mmap_mode="r")
-            self._cells = np.load(directory / rec["cells"], mmap_mode="r")
+            # store-io: raw gate read (see above)
+            self._keys = np.load(directory / rec["keys"], mmap_mode="r")  # store-io: raw gate read
+            self._cells = np.load(directory / rec["cells"], mmap_mode="r")  # store-io: raw gate read
 
     def _block(self, b):
         return _read_block_pair(

@@ -21,7 +21,6 @@ process compiles O(log max-batch) programs, not one per batch size.
 from __future__ import annotations
 
 import math
-import os
 import pathlib
 import time
 
@@ -29,13 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from gamesmanmpi_tpu.compress import (
-    BlockCache,
-    BlockCorruptError,
-    decode_block,
-    index_offsets,
-    validate_index,
-)
+from gamesmanmpi_tpu.compress import BlockCorruptError
 from gamesmanmpi_tpu.core.codec import unpack_cells_np
 from gamesmanmpi_tpu.core.values import LOSE, TIE, UNDECIDED, WIN
 from gamesmanmpi_tpu.db.format import (
@@ -48,88 +41,18 @@ from gamesmanmpi_tpu.obs import default_registry
 from gamesmanmpi_tpu.ops.padding import bucket_size, pad_to
 from gamesmanmpi_tpu.resilience import faults
 from gamesmanmpi_tpu.solve.engine import get_kernel, undecided_mask
-from gamesmanmpi_tpu.utils.env import env_int
+from gamesmanmpi_tpu.store import (
+    BlockStore,
+    SealedBlockStream,
+    TieredCache,
+    default_store,
+    open_npy_mmap,
+)
+from gamesmanmpi_tpu.utils.env import env_int, env_opt
 
 # Smallest query-kernel capacity: batches are tiny next to frontiers, and
 # every distinct capacity is a compiled program.
 _MIN_QUERY_BUCKET = 256
-
-#: Default hot-block cache budget (GAMESMAN_DB_CACHE_MB): 64 MB holds
-#: ~100 decoded 64Ki-position uint64 key+cell block pairs — the whole
-#: working set of a skewed query mix against a multi-GB level.
-_DEFAULT_CACHE_MB = 64
-
-
-class _BlockedLevel:
-    """One v2 level's probe-side handle: resident block router
-    (first_keys + derived offsets) over an fd read with os.pread, so
-    concurrent flush/breaker/caller threads — and forked fleet workers
-    sharing the parent's fds — never contend on a file position."""
-
-    def __init__(self, directory: pathlib.Path, level: int, rec: dict):
-        self.level = level
-        self.count = int(rec["count"])
-        self.keys_index = rec["keys_blocks"]
-        self.cells_index = rec["cells_blocks"]
-        self.first_keys = np.asarray(
-            rec.get("first_keys", []), dtype=np.uint64
-        )
-        self.keys_fd = self.cells_fd = -1
-        try:
-            self.keys_fd = os.open(directory / rec["keys"], os.O_RDONLY)
-            self.cells_fd = os.open(directory / rec["cells"], os.O_RDONLY)
-            # Validate the index against the real stream sizes at open:
-            # a truncated block file fails HERE (DbFormatError at reader
-            # construction / first touch), not as an out-of-range pread
-            # mid-probe.
-            validate_index(
-                self.keys_index,
-                stream_bytes=os.fstat(self.keys_fd).st_size,
-            )
-            validate_index(
-                self.cells_index,
-                stream_bytes=os.fstat(self.cells_fd).st_size,
-            )
-            if len(self.first_keys) != len(self.keys_index["lengths"]):
-                raise BlockCorruptError(
-                    f"level {level}: {len(self.first_keys)} first_keys "
-                    f"for {len(self.keys_index['lengths'])} blocks"
-                )
-        except BaseException:
-            self.close()
-            raise
-        self.keys_offsets = index_offsets(self.keys_index)
-        self.cells_offsets = index_offsets(self.cells_index)
-
-    @property
-    def num_blocks(self) -> int:
-        return len(self.first_keys)
-
-    def read_block(self, b: int):
-        """Decode block b -> (keys, cells) arrays (crc-verified)."""
-        kb = os.pread(
-            self.keys_fd,
-            int(self.keys_offsets[b + 1] - self.keys_offsets[b]),
-            int(self.keys_offsets[b]),
-        )
-        cb = os.pread(
-            self.cells_fd,
-            int(self.cells_offsets[b + 1] - self.cells_offsets[b]),
-            int(self.cells_offsets[b]),
-        )
-        return (
-            decode_block(self.keys_index, b, kb),
-            decode_block(self.cells_index, b, cb),
-        )
-
-    def close(self) -> None:
-        for fd in (self.keys_fd, self.cells_fd):
-            if fd >= 0:
-                try:
-                    os.close(fd)
-                except OSError:
-                    pass
-        self.keys_fd = self.cells_fd = -1
 
 
 def _canon_builder(game):
@@ -209,19 +132,50 @@ class DbReader:
         }
         self._arrays: dict = {}
         self._blocked: dict = {}
-        self._cache = None
+        self._store = None
+        self._private_store = False
         self._m_decode_secs = None
+        self._m_cache_hits = self._m_cache_misses = None
+        self._hits = 0  # guarded-by: _stats_lock
+        self._misses = 0  # guarded-by: _stats_lock
+        self._stats_lock = None
         if any(level_is_blocked(rec) for rec in self._levels.values()):
-            # Decompress-on-probe state (format v2): hot-block LRU +
-            # decode-latency series. Per-reader on purpose — each fleet
-            # route (and each forked worker, after copy-on-write) gets
-            # its own budget and its own observable cache behavior.
-            # db label: a multi-route fleet worker holds one reader per
-            # route on ONE registry — without it the per-reader series
-            # would collapse into a single shared child.
-            self._cache = BlockCache(
-                env_int("GAMESMAN_DB_CACHE_MB", _DEFAULT_CACHE_MB) << 20,
-                registry=reg, labels={"db": self.dir.name},
+            import threading
+
+            # Decompress-on-probe state (format v2), ISSUE 11: decoded
+            # blocks live in the SHARED block-store cache (one byte
+            # budget across every reader/route in the process — the
+            # private per-reader LRUs this replaces each held their own
+            # copy of the hot head). GAMESMAN_DB_CACHE_MB, when set
+            # explicitly, still carves a private store for this reader
+            # (legacy per-reader budget; tests use it to force
+            # eviction), labeled so two private caches on one registry
+            # keep separable series.
+            if env_opt("GAMESMAN_DB_CACHE_MB"):
+                self._store = BlockStore(
+                    cache=TieredCache(
+                        max(1, env_int("GAMESMAN_DB_CACHE_MB", 64)) << 20,
+                        registry=reg, labels={"db": self.dir.name},
+                    ),
+                    prefetch_threads=0, writebehind=False, registry=reg,
+                    labels={"db": self.dir.name},
+                )
+                self._private_store = True
+            else:
+                self._store = default_store()
+            self._stats_lock = threading.Lock()
+            # Per-reader hit/miss series survive the unification: the
+            # db label separates routes within one worker, the worker
+            # label separates workers (docs/OBSERVABILITY.md).
+            self._m_cache_hits = reg.counter(
+                "gamesman_db_cache_hits_total",
+                "probes answered from an already-decoded hot block",
+                db=self.dir.name,
+            )
+            self._m_cache_misses = reg.counter(
+                "gamesman_db_cache_misses_total",
+                "probes that had to decode a cold block",
+                db=self.dir.name,
             )
             self._m_decode_secs = reg.histogram(
                 "gamesman_db_block_decode_seconds",
@@ -254,16 +208,17 @@ class DbReader:
         return sorted(self._levels)
 
     def _level_arrays(self, level: int):
-        """(keys, cells) of one level, memory-mapped on first touch."""
+        """(keys, cells) of one level, memory-mapped on first touch
+        (store/sealed.open_npy_mmap — the v1 door)."""
         pair = self._arrays.get(level)
         if pair is None:
             rec = self._levels[level]
-            keys = np.load(self.dir / rec["keys"], mmap_mode="r")
-            cells = np.load(self.dir / rec["cells"], mmap_mode="r")
+            keys = open_npy_mmap(self.dir / rec["keys"])
+            cells = open_npy_mmap(self.dir / rec["cells"])
             pair = self._arrays[level] = (keys, cells)
         return pair
 
-    def _blocked_level(self, level: int) -> _BlockedLevel:
+    def _blocked_level(self, level: int) -> SealedBlockStream:
         """The v2 probe handle of one level, opened on first touch.
         Lock-free under concurrent probes: a race opens two handles and
         the setdefault loser closes its fds — strictly cheaper than
@@ -271,7 +226,7 @@ class DbReader:
         bl = self._blocked.get(level)
         if bl is None:
             try:
-                fresh = _BlockedLevel(
+                fresh = SealedBlockStream(
                     self.dir, level, self._levels[level]
                 )
             except (BlockCorruptError, OSError) as e:
@@ -287,18 +242,35 @@ class DbReader:
     def cache_stats(self):
         """Hot-block cache counters (dict), or None for a v1 DB — the
         serving batcher rides these on its serve_batch records so
-        per-worker cache behavior lands in the JSONL stream."""
-        return None if self._cache is None else self._cache.stats()
+        per-worker cache behavior lands in the JSONL stream. hits and
+        misses are THIS reader's probes; bytes/blocks/evictions are the
+        backing store cache's (shared across readers unless
+        GAMESMAN_DB_CACHE_MB carved a private one)."""
+        if self._store is None:
+            return None
+        backing = self._store.cache.stats()
+        with self._stats_lock:
+            hits, misses = self._hits, self._misses
+        return {
+            "hits": hits,
+            "misses": misses,
+            "evictions": backing["evictions"],
+            "bytes": backing["bytes"],
+            "blocks": backing["blocks"],
+        }
 
     def close(self) -> None:
-        """Drop the mmaps and decoded blocks, close block-stream fds
-        (everything also dies with the reader)."""
+        """Drop the mmaps, close block-stream fds (everything also dies
+        with the reader). Decoded blocks: a PRIVATE store's cache is
+        cleared; the shared store keeps its entries — they are keyed by
+        stream inode, so they can never leak into a different DB, and
+        another reader of the same DB may still be serving them."""
         self._arrays.clear()
         for bl in self._blocked.values():
             bl.close()
         self._blocked.clear()
-        if self._cache is not None:
-            self._cache.clear()
+        if self._private_store and self._store is not None:
+            self._store.close()
 
     def __enter__(self):
         return self
@@ -416,21 +388,32 @@ class DbReader:
         ) - 1
         np.clip(bids, 0, bl.num_blocks - 1, out=bids)
         for b in np.unique(bids):
-            pair = self._cache.get((lv, int(b)))
-            if pair is None:
+            # Shared-store read: keyed by the stream's inode identity
+            # (see SealedBlockStream.ident), so every reader/route of
+            # one DB shares one decoded copy, and an overwrite-swapped
+            # DB can never serve the old directory's blocks.
+            def _decode(bl=bl, b=int(b), lv=lv):
                 t0 = time.perf_counter()
                 try:
-                    pair = bl.read_block(int(b))
+                    pair = bl.read_block(b)
                 except (BlockCorruptError, OSError) as e:
                     raise DbFormatError(
-                        f"{self.dir}: level {lv} block {int(b)} "
+                        f"{self.dir}: level {lv} block {b} "
                         f"unreadable: {e}"
                     ) from e
                 self._m_decode_secs.observe(time.perf_counter() - t0)
-                self._cache.put(
-                    (lv, int(b)), pair,
-                    pair[0].nbytes + pair[1].nbytes,
-                )
+                return pair
+
+            pair, hit = self._store.read_ex((bl.ident, int(b)), _decode)
+            with self._stats_lock:
+                if hit:
+                    self._hits += 1
+                else:
+                    self._misses += 1
+            if hit and self._m_cache_hits is not None:
+                self._m_cache_hits.inc()
+            elif not hit and self._m_cache_misses is not None:
+                self._m_cache_misses.inc()
             bkeys, bcells = pair
             bsel = sel[bids == b]
             idx, hit = probe_sorted_np(

@@ -47,7 +47,15 @@ from gamesmanmpi_tpu.db.format import (
     save_npy_hashed,
     write_manifest,
 )
+from gamesmanmpi_tpu.store import WriteTicket, default_store
 from gamesmanmpi_tpu.utils.env import env_int
+
+#: Export pipeline depth: at most this many levels' arrays parked
+#: behind the write-behind worker before add_level blocks on the
+#: oldest. Bounds export memory at O(depth) levels — the whole point of
+#: the streaming level_sink — while the encode+DEFLATE+hash of level k
+#: overlaps the solver resolving level k-1.
+_EXPORT_PIPELINE = 2
 
 
 class DbWriter:
@@ -59,11 +67,19 @@ class DbWriter:
 
     def __init__(self, directory, game, spec: str, *,
                  overwrite: bool = False, compress: bool = False,
-                 block_positions: int | None = None):
+                 block_positions: int | None = None, store=None):
         """compress=True writes format v2: each level's keys/cells as
         independently-decodable blocks (compress/) with the per-block
         index in the manifest. block_positions overrides the block
-        size (positions per block; default GAMESMAN_DB_BLOCK)."""
+        size (positions per block; default GAMESMAN_DB_BLOCK).
+
+        Payload writes ride the block store's write-behind worker
+        (ISSUE 11): ``add_level`` validates on the calling thread, then
+        enqueues the encode+write+hash and returns — the solver's
+        backward loop (level_sink feeds add_level synchronously) no
+        longer waits on export DEFLATE. The manifest (the seal) is
+        written at finalize AFTER every ticket resolves, preserving the
+        write-then-seal discipline bit for bit."""
         self.compress = bool(compress)
         self.block_positions = int(
             block_positions
@@ -102,8 +118,35 @@ class DbWriter:
         self.dir.mkdir(parents=True, exist_ok=True)
         self.game = game
         self.spec = spec
-        self._levels: dict = {}
+        self.store = store if store is not None else default_store()
+        self._levels: dict = {}  # level -> record dict | WriteTicket
         self._finalized = False
+
+    def level_record(self, level: int) -> dict:
+        """The manifest record of one written level, waiting on its
+        write-behind ticket if still in flight (export progress logging
+        reads per-level stored bytes through this)."""
+        rec = self._levels[level]
+        if isinstance(rec, WriteTicket):
+            rec = self._levels[level] = rec.result()
+        return rec
+
+    def _enqueue_level(self, level: int, job, path_name: str) -> None:
+        """Park one level's encode+write+hash behind the store's worker
+        and bound the pipeline: beyond _EXPORT_PIPELINE unresolved
+        levels, block on the oldest — export memory stays O(depth)
+        levels, exactly what the streaming level_sink contract
+        promises. ``path_name`` is the level's REAL on-disk keys file
+        (v1 .npy or v2 .gmb) — the store.writebehind torn-fault target
+        must name a file the job actually writes."""
+        self._levels[level] = self.store.write(
+            job, path=str(self.dir / path_name)
+        )
+        # Insertion order == enqueue order == the worker's FIFO order.
+        pending = [k for k in self._levels
+                   if isinstance(self._levels[k], WriteTicket)]
+        for k in pending[:-_EXPORT_PIPELINE]:
+            self.level_record(k)
 
     def add_level(self, level: int, states, values=None, remoteness=None,
                   *, cells=None) -> None:
@@ -155,56 +198,76 @@ class DbWriter:
                 f"{states.shape[0]} keys"
             )
         if self.compress:
-            self._levels[level] = self._add_level_blocked(
-                level, states, cells
+            self._enqueue_level(
+                level, self._blocked_level_job(level, states, cells),
+                level_key_blocks_name(level),
             )
             return
         keys_name = level_key_name(level)
         cells_name = level_cell_name(level)
-        self._levels[level] = {
-            "count": int(states.shape[0]),
-            "keys": keys_name,
-            "cells": cells_name,
-            # One-pass save+hash: add_level runs synchronously inside the
-            # solver's backward loop (level_sink), so a post-save re-read
-            # would double export I/O per level.
-            "keys_sha256": save_npy_hashed(self.dir / keys_name, states),
-            "cells_sha256": save_npy_hashed(self.dir / cells_name, cells),
-        }
 
-    def _add_level_blocked(self, level: int, states, cells) -> dict:
-        """Format v2 level write: framed key/cell block streams + the
-        per-block index (and per-block first keys, the probe's block
-        router) destined for the manifest. Keys and cells share one
-        blocking so block b of cells scores block b of keys."""
+        def job(level=level, states=states, cells=cells):
+            # One-pass save+hash: a post-save re-read would double
+            # export I/O per level (save_npy_hashed streams the hash).
+            return {
+                "count": int(states.shape[0]),
+                "keys": keys_name,
+                "cells": cells_name,
+                "keys_sha256": save_npy_hashed(
+                    self.dir / keys_name, states
+                ),
+                "cells_sha256": save_npy_hashed(
+                    self.dir / cells_name, cells
+                ),
+            }
+
+        self._enqueue_level(level, job, keys_name)
+
+    def _blocked_level_job(self, level: int, states, cells):
+        """Format v2 level write job (runs on the write-behind worker —
+        block encoding is the expensive half of a compressed export, so
+        it overlaps the solver, not just the fsync): framed key/cell
+        block streams + the per-block index (and per-block first keys,
+        the probe's block router) destined for the manifest. Keys and
+        cells share one blocking so block b of cells scores block b of
+        keys."""
         bp = self.block_positions
-        keys_index, key_blobs = encode_array(states, bp, KEY_CANDIDATES)
-        cells_index, cell_blobs = encode_array(cells, bp, CELL_CANDIDATES)
-        keys_name = level_key_blocks_name(level)
-        cells_name = level_cell_blocks_name(level)
-        # One-pass save+hash, same discipline as the v1 path.
-        keys_sha = save_blocks_hashed(self.dir / keys_name, key_blobs)
-        cells_sha = save_blocks_hashed(self.dir / cells_name, cell_blobs)
-        return {
-            "count": int(states.shape[0]),
-            "keys": keys_name,
-            "cells": cells_name,
-            "keys_sha256": keys_sha,
-            "cells_sha256": cells_sha,
-            "keys_blocks": keys_index,
-            "cells_blocks": cells_index,
-            # Per-block first key: the reader's block router (one
-            # searchsorted over this small resident array finds the only
-            # block a canonical key can live in). JSON holds full uint64
-            # range exactly — Python ints are arbitrary precision.
-            "first_keys": [
-                int(states[b]) for b in range(0, states.shape[0], bp)
-            ],
-            "raw_bytes": int(states.nbytes + cells.nbytes),
-            "stored_bytes": int(
-                sum(keys_index["lengths"]) + sum(cells_index["lengths"])
-            ),
-        }
+
+        def job(level=level, states=states, cells=cells, bp=bp):
+            keys_index, key_blobs = encode_array(states, bp,
+                                                 KEY_CANDIDATES)
+            cells_index, cell_blobs = encode_array(cells, bp,
+                                                   CELL_CANDIDATES)
+            keys_name = level_key_blocks_name(level)
+            cells_name = level_cell_blocks_name(level)
+            # One-pass save+hash, same discipline as the v1 path.
+            keys_sha = save_blocks_hashed(self.dir / keys_name, key_blobs)
+            cells_sha = save_blocks_hashed(self.dir / cells_name,
+                                           cell_blobs)
+            return {
+                "count": int(states.shape[0]),
+                "keys": keys_name,
+                "cells": cells_name,
+                "keys_sha256": keys_sha,
+                "cells_sha256": cells_sha,
+                "keys_blocks": keys_index,
+                "cells_blocks": cells_index,
+                # Per-block first key: the reader's block router (one
+                # searchsorted over this small resident array finds the
+                # only block a canonical key can live in). JSON holds
+                # full uint64 range exactly — Python ints are arbitrary
+                # precision.
+                "first_keys": [
+                    int(states[b]) for b in range(0, states.shape[0], bp)
+                ],
+                "raw_bytes": int(states.nbytes + cells.nbytes),
+                "stored_bytes": int(
+                    sum(keys_index["lengths"])
+                    + sum(cells_index["lengths"])
+                ),
+            }
+
+        return job
 
     def add_level_table(self, level: int, table) -> None:
         """Engine hook adapter: consumes a solve/engine.LevelTable."""
@@ -217,14 +280,25 @@ class DbWriter:
         manifest — and possibly useful for debugging)."""
         if self._finalized or self.dir == self.final_dir:
             return
+        try:
+            # Never rmtree under an in-flight payload write.
+            self.store.drain()
+        except Exception:  # noqa: BLE001 - aborting anyway
+            pass
         import shutil
 
         shutil.rmtree(self.dir, ignore_errors=True)
 
     def finalize(self, extra: dict | None = None) -> dict:
-        """Seal the DB: write the manifest (atomically, last). -> manifest."""
+        """Seal the DB: write the manifest (atomically, last). -> manifest.
+
+        Every write-behind ticket resolves FIRST (payload on disk,
+        hashes known), then the manifest lands — the same
+        payload-before-seal order the synchronous writer had."""
         if not self._levels:
             raise DbFormatError("no levels written — refusing an empty DB")
+        for level in list(self._levels):
+            self.level_record(level)
         manifest = {
             "format": FORMAT_NAME,
             "version": (
@@ -326,24 +400,31 @@ def export_checkpoint(checkpointer, game, spec: str, directory, *,
     writer = DbWriter(directory, game, spec, overwrite=overwrite,
                       compress=compress)
     try:
+        counts = {}
         for level in levels:
             table = checkpointer.load_level(level)
             writer.add_level_table(level, table)
-            if logger is not None:
+            counts[level] = int(table.states.shape[0])
+        manifest_out = writer.finalize()
+        if logger is not None:
+            # Log AFTER finalize: every ticket has resolved by then, so
+            # the per-level compression figures (the material
+            # tools/obs_report.py folds into its ratio line) cost no
+            # ticket wait — logging per level DURING the loop would
+            # block on each just-enqueued write and collapse the
+            # export write-behind pipeline to depth 0.
+            for level in levels:
                 record = {
                     "phase": "export_db",
                     "level": level,
-                    "n": int(table.states.shape[0]),
+                    "n": counts[level],
                 }
-                rec = writer._levels[level]
+                rec = writer.level_record(level)
                 if "stored_bytes" in rec:
-                    # Per-level compression figures ride the export
-                    # stream so tools/obs_report.py can fold a ratio
-                    # column without re-reading the manifest.
                     record["raw_bytes"] = rec["raw_bytes"]
                     record["stored_bytes"] = rec["stored_bytes"]
                 logger.log(record)
-        return writer.finalize()
+        return manifest_out
     except BaseException:  # incl. KeyboardInterrupt: drop the staging dir
         writer.abort()
         raise

@@ -113,6 +113,7 @@ from gamesmanmpi_tpu.resilience.coordination import (
 )
 from gamesmanmpi_tpu.resilience.retry import is_transient, retry_call
 from gamesmanmpi_tpu.resilience.supervisor import maybe_watchdog
+from gamesmanmpi_tpu.store import WriteTicket, default_store
 from gamesmanmpi_tpu.utils.checkpoint import TORN_NPZ_ERRORS
 from gamesmanmpi_tpu.utils.env import (
     env_float as _env_float,
@@ -596,6 +597,30 @@ class ShardedSolver:
         self.fast = bool(game.uniform_level_jump) and not force_generic
         self.device_store_bytes = _device_store_bytes()
         self.backward_block = _backward_block()
+        # The async block store (ISSUE 11): shared with the checkpointer
+        # (one byte budget, one write-behind queue, one prefetch pool per
+        # process). Wrapped/stubbed checkpointers in tests may not expose
+        # a store — fall back to the process default.
+        self.store = (
+            getattr(checkpointer, "store", None) if checkpointer is not None
+            else None
+        ) or default_store()
+        #: store counters at solve start — stats() reports this solve's
+        #: deltas (the store is process-wide and outlives solves).
+        self._store_t0 = self.store.stats()
+        #: pipelined checkpoint seals (single-process write-behind): each
+        #: entry is (tickets, seal_fn); the oldest flushes when the queue
+        #: exceeds one level's worth, and everything flushes at phase
+        #: boundaries — so level k's DEFLATE+fsync overlaps level k-1's
+        #: compute while the payload-before-seal order stays absolute.
+        self._pending_seals: List = []
+        #: edge arrays dropped to the disk tier (sealed edge-shard files)
+        #: because the host-RAM spill budget was exhausted — reloaded via
+        #: the store (prefetch makes them cache hits) during backward.
+        self.edges_bytes_disk = 0
+        #: host-RAM bytes currently held by budget-evicted edge spills,
+        #: capped by the store cache budget (the host tier).
+        self._host_spill_bytes = 0
         # Route-capacity headroom (strict parse, fail-fast like the other
         # capacity knobs): see _initial_route_cap.
         raw = env_opt("GAMESMAN_ROUTE_HEADROOM")
@@ -1481,6 +1506,7 @@ class ShardedSolver:
                 cur = levels[k]
                 cur.ecap = route_cap
                 extra = eidx.nbytes + slot.nbytes
+                to_disk = False
                 if stored_bytes + extra <= self.device_store_bytes:
                     cur.eidx, cur.slot = eidx, slot
                     stored_bytes += extra
@@ -1488,7 +1514,25 @@ class ShardedSolver:
                     cur.eidx = _HostSpill.download(eidx)
                     cur.slot = _HostSpill.download(slot)
                     self.edges_bytes_spilled += extra
+                    # Disk tier (ISSUE 11): when the host-RAM tier (the
+                    # store cache budget) is exhausted too AND the edge
+                    # files are being sealed anyway, keep NO resident
+                    # copy — backward reloads them through the store,
+                    # where the level schedule's readahead hints turn
+                    # the loads into cache hits.
+                    to_disk = (
+                        self.checkpointer is not None
+                        and self._host_spill_bytes + extra
+                        > self.store.cache.budget_bytes
+                    )
+                    if not to_disk:
+                        self._host_spill_bytes += extra
                 self._ckpt_edges_level(k, cur)
+                if to_disk:
+                    # The save path extracted + enqueued its own host
+                    # copies above; the sealed files are authoritative.
+                    cur.eidx = cur.slot = None
+                    self.edges_bytes_disk += extra
             if k + 1 >= g.num_levels:
                 raise SolverError(
                     f"game {g.name}: children found at level {k + 1} but "
@@ -1824,6 +1868,9 @@ class ShardedSolver:
         """
         g = self.game
         S = self.S
+        # Forward's seals (edges + frontier levels) must all be visible
+        # before the backward reads edge_level_info/completed_levels.
+        self._flush_seals()
         resolved: Dict[int, LevelTable] = {}
         dev_cache: Dict[int, tuple] = {}
         # Window levels wider than window_block per shard live here as host
@@ -1839,13 +1886,24 @@ class ShardedSolver:
             # All edge-backward shapes are known now; compile them in the
             # background, deepest-first, while the deep levels execute.
             self._schedule_backward_edges(levels, completed)
-        for k in sorted(levels, reverse=True):
+        order = sorted(levels, reverse=True)
+        for i, k in enumerate(order):
             b0 = (self.bytes_routed, self.bytes_sorted, self.bytes_gathered)
+            io0 = self.store.stats()["io_wait_secs"]
             rec = levels[k]
             self.progress = {
                 "phase": "backward", "level": k, "rank": self.rank,
                 "n": int(rec.counts.sum()),
             }
+            # Batched readahead from the level schedule: while THIS
+            # level resolves, the store's pool decodes the NEXT level's
+            # sealed checkpoint/edge shards — the solve thread's loads
+            # one iteration from now become cache hits (today's
+            # synchronous spill loads, overlapped away).
+            if i + 1 < len(order):
+                self._hint_backward_level(
+                    order[i + 1], levels[order[i + 1]], completed
+                )
             from_checkpoint = k in completed
             # Edge-cached resolve when this level's forward edges exist
             # (in memory, spilled, or sealed in the checkpoint dir) AND the
@@ -2034,8 +2092,30 @@ class ShardedSolver:
                 bytes_routed=self.bytes_routed - b0[0],
                 bytes_sorted=self.bytes_sorted - b0[1],
                 bytes_gathered=self.bytes_gathered - b0[2],
+                io_wait_secs=round(
+                    self.store.stats()["io_wait_secs"] - io0, 6
+                ),
             )
         return resolved
+
+    def _hint_backward_level(self, k: int, rec, completed) -> None:
+        """Readahead hints for one upcoming backward level: its sealed
+        checkpoint shards (resume) and/or its disk-tiered edge shards.
+        Hinting is advisory — an evicted or rejected hint degrades to
+        the synchronous sealed read, never a wrong answer."""
+        ck = self.checkpointer
+        if ck is None or not hasattr(ck, "prefetch_level_shards"):
+            return  # stubbed checkpointers in tests: skip readahead
+        manifest = ck.load_manifest()
+        if k in completed:
+            if manifest.get("sharded_levels", {}).get(str(k)) == self.S:
+                ck.prefetch_level_shards(k, self.S, manifest)
+            else:
+                ck.prefetch_level(k)
+        elif self.use_edges and rec.eidx is None:
+            info = manifest.get("edge_levels", {}).get(str(k))
+            if info and info.get("shards") == self.S:
+                ck.prefetch_edges_level(k, self.S, manifest)
 
     def _load_checkpointed_level(self, k: int, rec, cap: int,
                                  root_level: int):
@@ -2177,7 +2257,8 @@ class ShardedSolver:
             return rec.eidx, rec.slot, rec.ecap
         if self.checkpointer is None:
             return None
-        info = self.checkpointer.edge_level_info(k)
+        manifest = self.checkpointer.load_manifest()
+        info = manifest.get("edge_levels", {}).get(str(k))
         if (not info or info.get("shards") != self.S
                 or info.get("slot_len") != cap * self.game.max_moves):
             return None
@@ -2187,7 +2268,7 @@ class ShardedSolver:
         try:
             es, ss = [], []
             for s in range(self.S):
-                e, sl = self.checkpointer.load_edges_shard(k, s)
+                e, sl = self.checkpointer.load_edges_shard(k, s, manifest)
                 es.append(np.asarray(e, dtype=np.int32))
                 ss.append(np.asarray(sl, dtype=np.int32))
         except TORN_NPZ_ERRORS:
@@ -2298,17 +2379,23 @@ class ShardedSolver:
         """
         if self.checkpointer is None:
             return
+        tickets: List = []
         for s in range(self.S):
             rows = self._shard_rows(rec, s)
             if rows is not None:
                 self._count_ckpt_bytes(
-                    self.checkpointer.save_forward_level_shard(k, s, rows)
+                    self.checkpointer.save_forward_level_shard(k, s, rows),
+                    tickets,
                 )
-        self._sync_processes(f"forward_level_{k}_shards_written")
-        if jax.process_index() == 0:
-            self.checkpointer.finish_forward_level(
-                k, self.S, ranks=self._shard_ranks()
-            )
+
+        def _seal(k=k):
+            self._sync_processes(f"forward_level_{k}_shards_written")
+            if jax.process_index() == 0:
+                self.checkpointer.finish_forward_level(
+                    k, self.S, ranks=self._shard_ranks(), drain=False
+                )
+
+        self._seal_after_writes(tickets, _seal)
 
     def _checkpoint_frontier_shards(self, levels) -> None:
         """Per-shard frontier snapshot files, one shard at a time.
@@ -2319,6 +2406,8 @@ class ShardedSolver:
         writes only the shards its devices own (process 0 seals the
         manifest).
         """
+        self._flush_seals()  # the consolidated snapshot supersedes them
+        tickets: List = []
         for s in range(self.S):
             pools = {}
             for k, rec in levels.items():
@@ -2326,10 +2415,15 @@ class ShardedSolver:
                 if rows is not None:
                     pools[k] = rows
             if pools or jax.process_count() == 1:
-                self.checkpointer.save_frontier_shard(s, pools)
+                self._count_ckpt_bytes(
+                    self.checkpointer.save_frontier_shard(s, pools),
+                    tickets,
+                )
+        # Once-per-solve seal: run it eagerly (no pipelining partner).
+        self._run_seal(tickets, lambda: None)
         self._sync_processes("frontier_shards_written")
         if jax.process_index() == 0:
-            self.checkpointer.finish_frontier_shards(self.S)
+            self.checkpointer.finish_frontier_shards(self.S, drain=False)
 
     def _checkpoint_level_shards(self, k: int, rec, values_dev,
                                  rem_dev) -> None:
@@ -2347,29 +2441,102 @@ class ShardedSolver:
             }
 
         sv, sr, ss = rows(values_dev), rows(rem_dev), rows(rec.dev)
+        tickets: List = []
         for s, states in ss.items():
             n = int(rec.counts[s])
             cells = pack_cells_np(sv[s][:n], sr[s][:n])
             self._count_ckpt_bytes(
-                self.checkpointer.save_level_shard(k, s, states[:n], cells)
-            )
-        self._sync_processes(f"level_{k}_shards_written")
-        if jax.process_index() == 0:
-            self.checkpointer.finish_level_shards(
-                k, self.S, ranks=self._shard_ranks()
+                self.checkpointer.save_level_shard(k, s, states[:n], cells),
+                tickets,
             )
 
-    def _count_ckpt_bytes(self, sizes) -> None:
-        """Fold one checkpoint write's (raw, stored) byte pair into the
-        run totals (stats ckpt_bytes_raw/ckpt_bytes_stored). The pair is
-        an optional accounting hint: wrapped/stubbed checkpointers (the
-        resume tests' recording shims) may return None — skip, don't
-        crash a solve over bookkeeping."""
+        def _seal(k=k):
+            self._sync_processes(f"level_{k}_shards_written")
+            if jax.process_index() == 0:
+                self.checkpointer.finish_level_shards(
+                    k, self.S, ranks=self._shard_ranks(), drain=False
+                )
+
+        self._seal_after_writes(tickets, _seal)
+
+    def _count_ckpt_bytes(self, sizes, tickets=None) -> None:
+        """Fold one checkpoint write's result into the run totals (stats
+        ckpt_bytes_raw/ckpt_bytes_stored). ``sizes`` is a WriteTicket
+        (write-behind — resolved later, when its seal waits on it; the
+        ``tickets`` list collects it), a (raw, stored) pair (inline
+        write), or None — wrapped/stubbed checkpointers (the resume
+        tests' recording shims) may return None — skip, don't crash a
+        solve over bookkeeping."""
         if not sizes:
+            return
+        if isinstance(sizes, WriteTicket):
+            if tickets is not None:
+                tickets.append(sizes)
             return
         raw, stored = sizes
         self.ckpt_bytes_raw += int(raw)
         self.ckpt_bytes_stored += int(stored)
+
+    # ------------------------------------------------ seal pipelining
+    # Payload writes ride the store's write-behind queue; seals (manifest
+    # RMW) stay on the SOLVE thread, deferred one level: the seal for
+    # level k's files runs when level k-1's checkpoint call arrives (or
+    # at the next phase boundary), after waiting on exactly level k's
+    # write tickets. Manifest mutation therefore never leaves this
+    # thread, payload-before-seal stays absolute, and a death mid-queue
+    # leaves unsealed strays resume already ignores (chaos: the
+    # store.writebehind fault point). Multi-process runs seal eagerly —
+    # the post-write barrier is a collective and cannot be deferred.
+
+    def _run_seal(self, tickets, seal_fn) -> None:
+        t0 = time.perf_counter()
+        for t in tickets:
+            self._count_ckpt_bytes(t.result())
+        waited = time.perf_counter() - t0
+        if waited > 1e-6:
+            self.store._note_wait(waited)
+        seal_fn()
+
+    def _seal_after_writes(self, tickets, seal_fn) -> None:
+        """Schedule one artifact-set seal after its payload writes."""
+        if self.num_processes > 1 or not self.store.writebehind:
+            self._run_seal(tickets, seal_fn)
+            return
+        self._pending_seals.append((tickets, seal_fn))
+        # Depth 2 = one level's artifacts (edges + frontier, or one
+        # level seal) in flight: flushing the OLDER level here is what
+        # buys a full level of compute to overlap its writes.
+        while len(self._pending_seals) > 2:
+            self._run_seal(*self._pending_seals.pop(0))
+
+    def _flush_seals(self) -> None:
+        """Run every deferred seal (phase boundaries, solve end, and
+        before any manifest read that must see them)."""
+        while self._pending_seals:
+            self._run_seal(*self._pending_seals.pop(0))
+
+    def store_stats(self) -> dict:
+        """This solve's block-store I/O deltas (the store is process-
+        wide): io_wait_secs is every second the solve thread spent
+        blocked on store I/O — the sync-vs-prefetch A/B observable —
+        prefetch_hit_rate is reads served by cache/in-flight prefetch,
+        and writebehind_queue_depth is the peak since process start."""
+        now = self.store.stats()
+        t0 = self._store_t0
+        hits = now["prefetch_hits"] - t0["prefetch_hits"]
+        misses = now["prefetch_misses"] - t0["prefetch_misses"]
+        return {
+            "io_wait_secs": now["io_wait_secs"] - t0["io_wait_secs"],
+            "prefetch_hits": hits,
+            "prefetch_misses": misses,
+            "prefetch_hit_rate": (
+                hits / (hits + misses) if hits + misses else 0.0
+            ),
+            "writebehind_writes": (
+                now["writebehind_writes"] - t0["writebehind_writes"]
+            ),
+            "writebehind_queue_depth": now["writebehind_queue_depth_peak"],
+        }
 
     @staticmethod
     def _rows_of(arr, s: int):
@@ -2399,21 +2566,28 @@ class ShardedSolver:
         """
         if self.checkpointer is None:
             return
+        tickets: List = []
         for s in range(self.S):
             e = self._rows_of(rec.eidx, s)
             sl = self._rows_of(rec.slot, s)
             if e is not None and sl is not None:
                 self._count_ckpt_bytes(
-                    self.checkpointer.save_edges_shard(k, s, e, sl)
+                    self.checkpointer.save_edges_shard(k, s, e, sl),
+                    tickets,
                 )
-        self._sync_processes(f"edges_level_{k}_shards_written")
-        if jax.process_index() == 0:
-            slot_len = (rec.slot.cap if isinstance(rec.slot, _HostSpill)
-                        else rec.slot.shape[1])
-            self.checkpointer.finish_edges_level(
-                k, self.S, rec.ecap, int(slot_len),
-                ranks=self._shard_ranks(),
-            )
+        slot_len = (rec.slot.cap if isinstance(rec.slot, _HostSpill)
+                    else rec.slot.shape[1])
+        ecap = rec.ecap
+
+        def _seal(k=k, slot_len=int(slot_len), ecap=ecap):
+            self._sync_processes(f"edges_level_{k}_shards_written")
+            if jax.process_index() == 0:
+                self.checkpointer.finish_edges_level(
+                    k, self.S, ecap, slot_len,
+                    ranks=self._shard_ranks(), drain=False,
+                )
+
+        self._seal_after_writes(tickets, _seal)
 
     # ------------------------------------------------------------------ solve
 
@@ -2426,6 +2600,14 @@ class ShardedSolver:
         try:
             return self._solve_impl()
         finally:
+            # Pending pipelined seals are safe to run even on the error
+            # path — their payload writes are already queued and waited
+            # on — and losing them would unseal levels whose files are
+            # intact. Never mask the primary failure with a seal error.
+            try:
+                self._flush_seals()
+            except Exception:  # noqa: BLE001 - secondary failure only
+                pass
             if wd is not None:
                 wd.stop()
             if self.coord is not None:
@@ -2511,6 +2693,10 @@ class ShardedSolver:
         # valid in store_tables=False mode too.
         num_positions = sum(int(rec.counts.sum()) for rec in levels.values())
         resolved = self._backward(levels, start_level, init)
+        # Settle the tail of the pipeline before accounting: deferred
+        # seals run, their tickets resolve into ckpt_bytes_*, and the
+        # store deltas below include every write this solve issued.
+        self._flush_seals()
         t_total = time.perf_counter() - t0
         root_value, root_rem = self._root_answer
         stats = {
@@ -2524,6 +2710,7 @@ class ShardedSolver:
             "backward": self.backward_mode,
             "backward_edges_levels": self.backward_edges_levels,
             "edges_bytes_spilled": self.edges_bytes_spilled,
+            "edges_bytes_disk": self.edges_bytes_disk,
             "ckpt_bytes_raw": self.ckpt_bytes_raw,
             "ckpt_bytes_stored": self.ckpt_bytes_stored,
             "secs_forward": t_forward,
@@ -2533,6 +2720,7 @@ class ShardedSolver:
             "bytes_routed": self.bytes_routed,
             "bytes_sorted": self.bytes_sorted,
             "bytes_gathered": self.bytes_gathered,
+            **self.store_stats(),
         }
         self.progress = {"phase": "done", "rank": self.rank}
         if self.logger is not None:

@@ -88,6 +88,11 @@ KNOWN_POINTS = {
     "ckpt.save_level": "checkpoint: after a solved level is sealed",
     "ckpt.load_level": "checkpoint: at the top of a resume level load",
     "db.probe": "DbReader: at the top of every batched level probe",
+    "store.writebehind": "block store: after one write-behind payload "
+                         "write lands, before any seal can run (a kill "
+                         "here is the death-between-payload-and-seal "
+                         "shape; resume must treat the unsealed stray "
+                         "as absent)",
     "serve.flush": "Batcher worker: before the coalesced reader probe",
     "serve.worker_spawn": "fleet worker: at process start, before the "
                           "warm-start verify/self-probe gate",

@@ -495,6 +495,12 @@ class Batcher:
                     # the busiest route's counters.
                     record["db_cache_hits"] = db_cache["hits"]
                     record["db_cache_misses"] = db_cache["misses"]
+                    # Resident decoded bytes in the backing store tier
+                    # (ISSUE 11: shared across readers — the same figure
+                    # every route reports, by design): lets obs_report
+                    # square per-route hit rates against one budget.
+                    if "bytes" in db_cache:
+                        record["db_cache_bytes"] = db_cache["bytes"]
                     db_dir = getattr(self.reader, "dir", None)
                     if db_dir is not None:
                         record["db"] = db_dir.name

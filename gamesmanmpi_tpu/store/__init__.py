@@ -1,0 +1,32 @@
+"""gamesmanmpi_tpu.store — the one async block-store engine.
+
+Everything that moves bytes between RAM and disk in this repo goes
+through here (ROADMAP item 2): sealed crc-verified reads
+(:mod:`store.sealed`), a byte-budget host-RAM tier
+(:class:`TieredCache`), and the prefetch/write-behind engine
+(:class:`BlockStore`). Consumers: ``utils/checkpoint.py`` (npz
+framing + seals), ``parallel/sharded.py`` (edge/frontier spill +
+readahead hints), ``db/reader.py`` (decompress-on-probe serving),
+``db/writer.py`` (export write-behind). See docs/ARCHITECTURE.md
+"Block store".
+"""
+
+from gamesmanmpi_tpu.store.blockstore import (  # noqa: F401
+    BlockStore,
+    WriteTicket,
+    default_store,
+    file_key,
+)
+from gamesmanmpi_tpu.store.cache import TieredCache  # noqa: F401
+from gamesmanmpi_tpu.store.sealed import (  # noqa: F401
+    BLOCKS_META_MEMBER,
+    BlockedNpzView,
+    CorruptSealError,
+    SealedBlockStream,
+    TORN_SEAL_ERRORS,
+    file_crc32,
+    loadz,
+    open_npy_mmap,
+    read_npz_members,
+    verify_crc,
+)

@@ -1,0 +1,549 @@
+"""BlockStore: one async engine under every tiered I/O path.
+
+Four hand-rolled synchronous paths used to move every on-disk byte
+(checkpoint npz framing, DB block streams, sharded edge/frontier spill
+files, the reader's pread+LRU), and every spill load blocked the solve
+thread — compression was a storage win but not a speed win. This module
+is the unification ROADMAP item 2 calls for: crc-sealed block
+read/write with a background prefetch + write-behind pool, pluggable
+codecs via the existing keydelta/cellpack registry (the sealed readers
+decode through ``compress/``), and one byte-budget host-RAM cache
+(:class:`~gamesmanmpi_tpu.store.cache.TieredCache`) in front of the
+disk tier.
+
+Read side
+=========
+
+``read(key, loader)`` is the one door: cache hit → return; an in-flight
+prefetch for the same key → wait for it (the wait, not the whole load,
+is the solve thread's I/O cost); otherwise load synchronously. ``hint``
+schedules the loader on the prefetch pool — the solver's level schedule
+hints level N-1's edge/checkpoint shards while level N computes, so the
+next level's loads are decoded before the solve thread asks
+(overlapping level N's compute with level N-1's decode/disk I/O is the
+design "Compressed Game Solving" and the 7x6 Connect-Four solve both
+show out-of-core retrograde lives or dies on). A hinted-but-evicted
+key degrades to a synchronous read — never a wrong answer, never a
+wait on a lost future.
+
+Error contract: a loader exception on the pool is *stored* and
+re-raised at the consuming ``read`` on the caller's thread — a torn or
+bit-rotted block surfaced by a background prefetch still raises into
+``TORN_NPZ_ERRORS`` on the solve thread, where quarantine-and-degrade
+lives. Loaders must therefore be pure (see store/sealed.py).
+
+Write side
+==========
+
+``write(fn, path=...)`` enqueues a payload write (the DEFLATE+fsync of
+one ``_savez``) on a single ordered worker and returns a
+:class:`WriteTicket`; ``drain()`` barriers on the queue and re-raises
+the first failure. Ordering with seals (the GM8xx discipline): payload
+writes go through the queue, manifest seals stay on the caller's
+thread and call ``drain()`` first — so write-behind completes before
+anything is sealed, and a death mid-queue leaves unsealed strays the
+resume machinery already ignores (chaos-verified at the
+``store.writebehind`` fault point). The worker is ONE thread on
+purpose: FIFO order is the correctness argument, and the overlap win
+is solve-thread-vs-writer, not writer-vs-writer.
+
+Accounting (the A/B observable): ``io_wait_secs`` accumulates every
+second the *calling* thread spent blocked on store I/O — synchronous
+loads, waits on in-flight prefetches, drains, and (write-behind off)
+inline writes. A sync-vs-prefetch A/B of the same solve moves the same
+bytes; only io_wait shrinks (BENCH_store_r11.json gates on exactly
+that). ``prefetch_hit_rate`` and ``writebehind_queue_depth`` ride the
+same stats dict into solver stats, JSONL records, and the
+``gamesman_store_*`` registry series (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+
+from gamesmanmpi_tpu.obs import default_registry
+from gamesmanmpi_tpu.resilience import faults
+from gamesmanmpi_tpu.store.cache import TieredCache
+from gamesmanmpi_tpu.utils.env import env_bool, env_int
+
+#: Host-RAM tier default: 256 MB holds the decoded working set of a
+#: spill-heavy mid-size solve (a few hundred 64Ki-position block pairs)
+#: while staying invisible next to the frontier arrays themselves.
+_DEFAULT_CACHE_MB = 256
+_DEFAULT_PREFETCH_THREADS = 2
+
+
+class WriteTicket:
+    """One enqueued write-behind payload write.
+
+    ``result()`` blocks until the write lands and returns the write
+    fn's return value (the checkpoint savers return (raw, stored)
+    bytes), re-raising the write's failure. Resolved synchronously when
+    write-behind is off."""
+
+    __slots__ = ("path", "consumed", "_event", "_value", "_error")
+
+    def __init__(self, path=None):
+        self.path = path
+        #: True once result() delivered the outcome to a caller — a
+        #: failure somebody already handled must not be re-raised at a
+        #: later, unrelated drain() (see BlockStore.drain).
+        self.consumed = False
+        self._event = threading.Event()
+        self._value = None
+        self._error = None
+
+    def _resolve(self, value=None, error=None) -> None:
+        self._value = value
+        self._error = error
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"write-behind of {self.path} still queued")
+        self.consumed = True
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class _Inflight:
+    """One key's in-progress background load."""
+
+    __slots__ = ("event", "value", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value = None
+        self.error = None
+
+
+def file_key(path):
+    """Cache key for a sealed FILE payload: (path, mtime_ns, size).
+
+    Stat-qualified so a rewritten/truncated/quarantined file can never
+    serve stale cached bytes: the key a reader computes after the
+    change differs from the key the old content was cached under, and
+    the read degrades to a fresh sealed load. Returns None (bypass the
+    cache, load synchronously) when the file cannot be stat'ed — the
+    loader then raises the honest FileNotFoundError."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return (str(path), st.st_mtime_ns, st.st_size)
+
+
+class BlockStore:
+    """Async block-store engine: tiered cache + prefetch + write-behind."""
+
+    def __init__(self, *, cache: TieredCache | None = None,
+                 prefetch_threads: int = _DEFAULT_PREFETCH_THREADS,
+                 writebehind: bool = True, registry=None, labels=None):
+        """labels: metric labels for THIS store's gamesman_store_*
+        series. The process default store emits unlabeled; a private
+        store (DbReader's legacy GAMESMAN_DB_CACHE_MB budget) passes
+        ``db=<name>`` so its io_wait/prefetch counts never fold into
+        the shared store's series (the same conflation class PR 9
+        fixed for gamesman_db_cache_*)."""
+        reg = registry if registry is not None else default_registry()
+        lbl = dict(labels or {})
+        self.cache = cache if cache is not None else TieredCache(
+            _DEFAULT_CACHE_MB << 20, registry=reg
+        )
+        self.prefetch_threads = max(0, int(prefetch_threads))
+        self.writebehind = bool(writebehind)
+        self._lock = threading.Lock()
+        self._inflight: dict = {}  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
+        # Counters (plain numbers under the one lock; snapshotted by
+        # stats() — same pattern as the serving batcher's).
+        self._io_wait_secs = 0.0  # guarded-by: _lock
+        self._prefetch_hits = 0  # guarded-by: _lock
+        self._prefetch_misses = 0  # guarded-by: _lock
+        self._prefetch_issued = 0  # guarded-by: _lock
+        self._reads = 0  # guarded-by: _lock
+        # Prefetch pool: lazy daemon threads over one work deque.
+        self._pf_cond = threading.Condition(self._lock)
+        self._pf_queue: collections.deque = collections.deque()
+        self._pf_started = 0  # guarded-by: _lock
+        # Write-behind: ONE ordered daemon worker (see module doc).
+        self._wb_cond = threading.Condition(self._lock)
+        self._wb_queue: collections.deque = collections.deque()
+        self._wb_busy = False  # guarded-by: _lock
+        self._wb_failed = None  # guarded-by: _lock (first failed ticket)
+        self._wb_thread = None
+        self._wb_writes = 0  # guarded-by: _lock
+        self._wb_depth_peak = 0  # guarded-by: _lock
+        self._m_io_wait = reg.counter(
+            "gamesman_store_io_wait_seconds_total",
+            "seconds calling threads spent blocked on store I/O "
+            "(sync loads, prefetch waits, drains, inline writes)",
+            **lbl,
+        )
+        self._m_pf_hits = reg.counter(
+            "gamesman_store_prefetch_hits_total",
+            "store reads satisfied by the cache or an in-flight prefetch",
+            **lbl,
+        )
+        self._m_pf_misses = reg.counter(
+            "gamesman_store_prefetch_misses_total",
+            "store reads that fell back to a synchronous sealed load",
+            **lbl,
+        )
+        self._m_wb_depth = reg.gauge(
+            "gamesman_store_writebehind_queue_depth",
+            "payload writes parked behind the write-behind worker now",
+            **lbl,
+        )
+        self._m_wb_writes = reg.counter(
+            "gamesman_store_writebehind_writes_total",
+            "payload writes executed by the write-behind worker",
+            **lbl,
+        )
+
+    @classmethod
+    def from_env(cls, registry=None) -> "BlockStore":
+        reg = registry if registry is not None else default_registry()
+        return cls(
+            cache=TieredCache(
+                max(1, env_int("GAMESMAN_STORE_CACHE_MB",
+                               _DEFAULT_CACHE_MB)) << 20,
+                registry=reg,
+            ),
+            prefetch_threads=env_int(
+                "GAMESMAN_STORE_PREFETCH_THREADS",
+                _DEFAULT_PREFETCH_THREADS,
+            ),
+            writebehind=env_bool("GAMESMAN_STORE_WRITEBEHIND", True),
+            registry=reg,
+        )
+
+    # -------------------------------------------------------------- reads
+
+    def read(self, key, loader, nbytes=None):
+        """The one read door; see read_ex."""
+        return self.read_ex(key, loader, nbytes=nbytes)[0]
+
+    def read_ex(self, key, loader, nbytes=None):
+        """-> (value, hit). Cache hit / in-flight wait count as hits
+        (the solve thread did not run the load itself); a synchronous
+        fallback counts as a miss. ``key=None`` bypasses the cache
+        entirely (unstat-able file — see file_key).
+
+        ``nbytes`` sizes the cache entry; None derives it from the
+        value's ``.nbytes`` fields (arrays or tuples/dicts of arrays).
+        """
+        entry = None
+        if key is not None:
+            with self._lock:
+                self._reads += 1
+            # Cache lookup outside the store lock: the cache has its own
+            # lock, and nested unrelated locks are how deadlocks start.
+            value = self.cache.get(key)
+            if value is not None:
+                with self._lock:
+                    self._prefetch_hits += 1
+                self._m_pf_hits.inc()
+                return value, True
+            with self._lock:
+                entry = self._inflight.get(key)
+            if entry is not None:
+                t0 = time.perf_counter()
+                entry.event.wait()
+                self._note_wait(time.perf_counter() - t0)
+                if entry.error is not None:
+                    # Background corruption re-raises HERE, on the
+                    # consuming thread — quarantine/degrade run where
+                    # they always did. The entry was already dropped by
+                    # the worker, so a retry reloads fresh.
+                    with self._lock:
+                        self._prefetch_misses += 1
+                    self._m_pf_misses.inc()
+                    raise entry.error
+                with self._lock:
+                    self._prefetch_hits += 1
+                self._m_pf_hits.inc()
+                return entry.value, True
+        with self._lock:
+            if key is None:
+                self._reads += 1
+            self._prefetch_misses += 1
+        self._m_pf_misses.inc()
+        t0 = time.perf_counter()
+        try:
+            value = loader()
+        finally:
+            self._note_wait(time.perf_counter() - t0)
+        if key is not None:
+            self.cache.put(key, value, self._sizeof(value, nbytes))
+        return value, False
+
+    def hint(self, key, loader, nbytes=None) -> None:
+        """Schedule a background load of ``key`` (the readahead half of
+        the level schedule's batched hints). No-op when the key is
+        None, already cached, already in flight, or the pool is
+        disabled (GAMESMAN_STORE_PREFETCH_THREADS=0 — the sync A/B
+        arm)."""
+        if key is None or self.prefetch_threads <= 0:
+            return
+        if self.cache.contains(key):
+            return  # peek, not get: a hint must not skew hit accounting
+        spawn = 0
+        with self._lock:
+            if self._closed or key in self._inflight:
+                return
+            self._inflight[key] = _Inflight()
+            self._prefetch_issued += 1
+            self._pf_queue.append((key, loader, nbytes))
+            # Grow the pool lazily up to prefetch_threads (an idle
+            # spare thread is cheaper than per-thread busy tracking).
+            # The Thread construction/start happens OUTSIDE the lock.
+            if self._pf_started < self.prefetch_threads:
+                self._pf_started += 1
+                spawn = self._pf_started
+            self._pf_cond.notify()
+        if spawn:
+            threading.Thread(
+                target=self._prefetch_loop,
+                name=f"gamesman-store-prefetch-{spawn - 1}",
+                daemon=True,
+            ).start()
+
+    def _prefetch_loop(self) -> None:
+        while True:
+            with self._pf_cond:
+                while not self._pf_queue and not self._closed:
+                    self._pf_cond.wait()
+                if self._closed and not self._pf_queue:
+                    return
+                key, loader, nbytes = self._pf_queue.popleft()
+                entry = self._inflight.get(key)
+            if entry is None:  # pragma: no cover - defensive
+                continue
+            try:
+                value = loader()
+            except BaseException as e:  # noqa: BLE001 - re-raised at read
+                entry.error = e
+                with self._lock:
+                    self._inflight.pop(key, None)
+                entry.event.set()
+                continue
+            entry.value = value
+            self.cache.put(key, value, self._sizeof(value, nbytes))
+            with self._lock:
+                self._inflight.pop(key, None)
+            entry.event.set()
+
+    @staticmethod
+    def _sizeof(value, nbytes) -> int:
+        if nbytes is not None:
+            return int(nbytes)
+        if hasattr(value, "nbytes"):
+            return int(value.nbytes)
+        if isinstance(value, dict):
+            vals = value.values()
+        elif isinstance(value, (tuple, list)):
+            vals = value
+        else:
+            return 0
+        return int(sum(getattr(v, "nbytes", 0) for v in vals))
+
+    # ------------------------------------------------------------- writes
+
+    def write(self, fn, path=None) -> WriteTicket:
+        """Enqueue one payload write (write-behind on) or execute it
+        inline (off / closed), returning its ticket. ``path`` names the
+        target file for diagnostics and the ``store.writebehind`` fault
+        point's torn-write target."""
+        ticket = WriteTicket(path)
+        enqueued = False
+        if self.writebehind:
+            with self._wb_cond:
+                if not self._closed:
+                    self._wb_queue.append((ticket, fn))
+                    depth = len(self._wb_queue) + (
+                        1 if self._wb_busy else 0
+                    )
+                    self._wb_depth_peak = max(self._wb_depth_peak, depth)
+                    if self._wb_thread is None:
+                        self._wb_thread = threading.Thread(
+                            target=self._writebehind_loop,
+                            name="gamesman-store-writebehind", daemon=True,
+                        )
+                        self._wb_thread.start()
+                    self._wb_cond.notify()
+                    enqueued = True
+            if enqueued:
+                self._m_wb_depth.set(depth)
+                return ticket
+        self._run_write(ticket, fn)
+        return ticket
+
+    def _run_write(self, ticket: WriteTicket, fn) -> None:
+        """Execute one write on the CALLING thread (sync mode): the
+        solve thread is blocked for the duration, so it counts as
+        io_wait — the denominator the write-behind A/B shrinks. The
+        failure raises directly (the caller IS the writer here); it is
+        not recorded for drain(), which would double-surface it."""
+        t0 = time.perf_counter()
+        try:
+            value = fn()
+            # Inside the try: an armed transient/fatal at the fault
+            # point must behave exactly like a write failure (resolve
+            # the ticket, surface to the caller), never leave an
+            # unresolved ticket behind. kill/torn kinds exit outright.
+            faults.fire("store.writebehind", path=ticket.path)
+        except BaseException as e:  # noqa: BLE001 - also surfaced via ticket
+            ticket._resolve(error=e)
+            self._note_wait(time.perf_counter() - t0)
+            with self._lock:
+                self._wb_writes += 1
+            raise
+        self._note_wait(time.perf_counter() - t0)
+        with self._lock:
+            self._wb_writes += 1
+        ticket._resolve(value)
+
+    def _writebehind_loop(self) -> None:
+        while True:
+            with self._wb_cond:
+                self._wb_busy = False
+                self._wb_cond.notify_all()  # wake drain()ers
+                while not self._wb_queue and not self._closed:
+                    self._wb_cond.wait()
+                if not self._wb_queue:
+                    return  # closed and drained
+                ticket, fn = self._wb_queue.popleft()
+                self._wb_busy = True
+                depth = len(self._wb_queue) + 1
+            self._m_wb_depth.set(depth)
+            try:
+                value = fn()
+                # Fire AFTER the payload lands and BEFORE any seal can
+                # run (seals drain first): a kill here is the death-
+                # between-payload-and-seal shape — resume must see an
+                # unsealed stray and recompute, never a sealed-but-
+                # missing level. INSIDE the try: an injected transient/
+                # fatal must resolve the ticket and surface at the
+                # seal, not kill this daemon and wedge every drain.
+                faults.fire("store.writebehind", path=ticket.path)
+            except BaseException as e:  # noqa: BLE001 - surfaced at drain
+                with self._lock:
+                    self._wb_writes += 1
+                    if self._wb_failed is None:
+                        self._wb_failed = ticket
+                ticket._resolve(error=e)
+                self._m_wb_depth.set(len(self._wb_queue))
+                continue
+            with self._lock:
+                self._wb_writes += 1
+            ticket._resolve(value)
+            # The honest remaining depth, INCLUDING the idle case: a
+            # gauge stuck at 1 after the last write reads as a wedged
+            # worker on an operator dashboard.
+            self._m_wb_depth.set(len(self._wb_queue))
+
+    def drain(self) -> None:
+        """Barrier on the write-behind queue; re-raise the first queued
+        write's failure — unless its ticket was already consumed by
+        result() (the seal that owned it surfaced the error; re-raising
+        at a later, unrelated drain would misattribute an old failure
+        to a healthy quarantine/seal cycle). Cleared either way: one
+        failure surfaces exactly once. Called by every seal before it
+        writes a manifest: payload-before-seal is the whole ordering
+        contract."""
+        t0 = time.perf_counter()
+        with self._wb_cond:
+            while self._wb_queue or self._wb_busy:
+                self._wb_cond.wait()
+            failed, self._wb_failed = self._wb_failed, None
+        waited = time.perf_counter() - t0
+        if waited > 1e-6:
+            self._note_wait(waited)
+        if failed is not None and not failed.consumed:
+            failed.consumed = True
+            raise failed._error
+
+    # -------------------------------------------------------------- misc
+
+    def _note_wait(self, secs: float) -> None:
+        with self._lock:
+            self._io_wait_secs += secs
+        self._m_io_wait.inc(max(0.0, secs))
+
+    def stats(self) -> dict:
+        """Point-in-time counters (the solver snapshots these at solve
+        start and reports per-solve deltas in its stats)."""
+        with self._lock:
+            reads = self._prefetch_hits + self._prefetch_misses
+            return {
+                "io_wait_secs": self._io_wait_secs,
+                "reads": self._reads,
+                "prefetch_hits": self._prefetch_hits,
+                "prefetch_misses": self._prefetch_misses,
+                "prefetch_issued": self._prefetch_issued,
+                "prefetch_hit_rate": (
+                    self._prefetch_hits / reads if reads else 0.0
+                ),
+                "writebehind_writes": self._wb_writes,
+                "writebehind_queue_depth": (
+                    len(self._wb_queue) + (1 if self._wb_busy else 0)
+                ),
+                "writebehind_queue_depth_peak": self._wb_depth_peak,
+            }
+
+    def close(self) -> None:
+        """Drain writes, stop accepting background work, release the
+        cache. Late ``write`` calls degrade to inline execution and
+        late ``hint`` calls no-op, so a consumer holding a stale store
+        (after default_store() rebuilt on an env change) stays correct,
+        just synchronous."""
+        self.drain()
+        with self._wb_cond:
+            self._closed = True
+            self._wb_cond.notify_all()
+            self._pf_cond.notify_all()
+        self.cache.clear()
+
+
+#: Process-wide store singleton, keyed on the env knobs it was built
+#: from: a test (or operator) changing GAMESMAN_STORE_* gets a fresh
+#: store on the next default_store() call instead of a stale config.
+_DEFAULT_LOCK = threading.Lock()
+_DEFAULT: tuple | None = None
+
+
+def default_store() -> BlockStore:
+    """The shared store every consumer defaults to — one byte budget,
+    one prefetch pool, one write-behind queue per process (checkpoint
+    writers, spill readers, and DB serving all meet here, which is the
+    unification that replaces the per-reader private LRUs)."""
+    global _DEFAULT
+    knobs = (
+        env_int("GAMESMAN_STORE_CACHE_MB", _DEFAULT_CACHE_MB),
+        env_int("GAMESMAN_STORE_PREFETCH_THREADS",
+                _DEFAULT_PREFETCH_THREADS),
+        env_bool("GAMESMAN_STORE_WRITEBEHIND", True),
+    )
+    with _DEFAULT_LOCK:
+        if _DEFAULT is not None and _DEFAULT[0] == knobs:
+            return _DEFAULT[1]
+        old = _DEFAULT[1] if _DEFAULT is not None else None
+        store = BlockStore(
+            cache=TieredCache(max(1, knobs[0]) << 20,
+                              registry=default_registry()),
+            prefetch_threads=knobs[1],
+            writebehind=knobs[2],
+            registry=default_registry(),
+        )
+        _DEFAULT = (knobs, store)
+    if old is not None:
+        old.close()
+    return store

@@ -1,0 +1,56 @@
+"""TieredCache: the block store's unified host-RAM tier.
+
+One byte-budget LRU per :class:`~gamesmanmpi_tpu.store.BlockStore`,
+shared by every consumer that used to run a private LRU (the DbReader
+hot-block cache, the checkpoint/spill loaders, backward edge reloads).
+The mechanics are exactly ``compress/cache.BlockCache`` — byte-budget
+LRU, lock-held bookkeeping only, decode-outside-the-lock — the only
+difference is the metric family: a *store* cache's behavior is a
+process-level observable (``gamesman_store_cache_*``), not a per-reader
+one, so the series carries no per-reader labels by default (private
+legacy caches — ``GAMESMAN_DB_CACHE_MB`` — pass a ``db=`` label to stay
+separable).
+
+The tier model (docs/ARCHITECTURE.md "Block store"): device HBM is the
+solver's own ``GAMESMAN_DEVICE_STORE_MB`` budget, this cache is the
+host-RAM tier (``GAMESMAN_STORE_CACHE_MB``), and the disk tier is the
+sealed checkpoint/spill/DB files themselves — a miss here falls through
+to a crc-verified sealed read, never to a wrong answer.
+"""
+
+from __future__ import annotations
+
+from gamesmanmpi_tpu.compress.cache import BlockCache
+
+
+class TieredCache(BlockCache):
+    """Byte-budget LRU over decoded blocks/arrays, host-RAM tier."""
+
+    def __init__(self, budget_bytes: int, *, registry=None, labels=None):
+        instruments = None
+        if registry is not None:
+            lbl = dict(labels or {})
+            instruments = (
+                registry.counter(
+                    "gamesman_store_cache_hits_total",
+                    "store reads answered from the host-RAM tier",
+                    **lbl,
+                ),
+                registry.counter(
+                    "gamesman_store_cache_misses_total",
+                    "store reads that fell through to the disk tier",
+                    **lbl,
+                ),
+                registry.counter(
+                    "gamesman_store_cache_evictions_total",
+                    "entries evicted by the byte budget "
+                    "(GAMESMAN_STORE_CACHE_MB)",
+                    **lbl,
+                ),
+                registry.gauge(
+                    "gamesman_store_cache_bytes",
+                    "decoded bytes resident in the host-RAM tier",
+                    **lbl,
+                ),
+            )
+        super().__init__(int(budget_bytes), instruments=instruments)

@@ -14,8 +14,6 @@ from __future__ import annotations
 import json
 import os
 import pathlib
-import zipfile
-import zlib
 
 import jax.numpy as jnp
 import numpy as np
@@ -25,7 +23,6 @@ from gamesmanmpi_tpu.compress import (
     DEFAULT_BLOCK_POSITIONS,
     GENERIC_CANDIDATES,
     KEY_CANDIDATES,
-    decode_array,
     encode_array,
 )
 from gamesmanmpi_tpu.core.codec import (
@@ -34,52 +31,28 @@ from gamesmanmpi_tpu.core.codec import (
     unpack_cells_np,
 )
 from gamesmanmpi_tpu.resilience import faults
-from gamesmanmpi_tpu.utils.env import env_int, env_str
-
-
-class CorruptCheckpointError(ValueError):
-    """A sealed checkpoint file failed its manifest crc32 — silent
-    bit-rot or an overwrite the torn-zip errors cannot see. Subclasses
-    ValueError so every existing TORN_NPZ_ERRORS degrade path treats it
-    as one more torn-file shape."""
-
-
-#: What a torn/truncated/deleted npz read can raise (ADVICE r5): missing
-#: file, a zip whose central directory never landed, a short read surfacing
-#: as a bare OSError, a zip that lost a member (KeyError on z["name"]), or
-#: overwritten-with-garbage content (np.load raises ValueError when the
-#: bytes are neither zip nor npy; CorruptCheckpointError — a crc32
-#: mismatch against the manifest — is a ValueError too). Loaders that
-#: degrade to an intact prefix catch exactly this tuple.
-TORN_NPZ_ERRORS = (
-    FileNotFoundError, zipfile.BadZipFile, OSError, KeyError, ValueError
+# The sealed-read path (crc verify, torn-error tuple, the one np.load
+# door) and the async engine live in store/ now; the names below are
+# re-exports so every historical import site keeps working. ISSUE 11
+# deleted the private copies — this module holds the npz FRAMING and
+# the manifest/seal logic, the store holds the I/O.
+from gamesmanmpi_tpu.store import (
+    BLOCKS_META_MEMBER,
+    CorruptSealError as CorruptCheckpointError,  # noqa: F401 - re-export
+    TORN_SEAL_ERRORS as TORN_NPZ_ERRORS,
+    default_store,
+    file_crc32,
+    file_key,
+    loadz as _loadz,  # noqa: F401 - re-export (tests compare tables)
+    read_npz_members,
 )
-
-
-def file_crc32(path, chunk: int = 1 << 20) -> int:
-    """Streaming crc32 of a file (zlib polynomial, chunked reads — disk
-    speed, constant memory, so sealing a multi-GB shard stays cheap)."""
-    crc = 0
-    with open(path, "rb") as fh:
-        while True:
-            block = fh.read(chunk)
-            if not block:
-                break
-            crc = zlib.crc32(block, crc)
-    return crc & 0xFFFFFFFF
+from gamesmanmpi_tpu.utils.env import env_int, env_str
 
 
 def _verify_enabled() -> bool:
     return env_str("GAMESMAN_CKPT_VERIFY", "1") not in (
         "0", "off", "false"
     )
-
-
-#: npz member name of the block-framing metadata (GAMESMAN_CKPT_COMPRESS=
-#: blocks): JSON bytes mapping each framed member to its block index.
-#: Double-underscored so it can never collide with a real array name
-#: (states/cells/eidx/slot/level_NNNN...).
-BLOCKS_META_MEMBER = "__blocks__"
 
 
 def _block_candidates(name: str, arr: np.ndarray):
@@ -194,64 +167,67 @@ def _savez(path, allow_block_framing=True, **arrays) -> tuple[int, int]:
         tmp.unlink(missing_ok=True)
 
 
-class _BlockedNpzView:
-    """Dict-like view over a block-framed npz (the ``blocks`` flavor of
-    _savez): same ``files`` / ``[]`` / context-manager surface as
-    np.load's NpzFile, decoding framed members on access. Corrupt blocks
-    raise BlockCorruptError (ValueError) from ``[]`` — exactly where a
-    torn plain npz raises — so every TORN_NPZ_ERRORS consumer degrades
-    identically for both storage flavors."""
-
-    def __init__(self, z, meta: dict):
-        self._z = z
-        self._meta = meta
-
-    @property
-    def files(self):
-        return [n for n in self._z.files if n != BLOCKS_META_MEMBER]
-
-    def __getitem__(self, name):
-        raw = self._z[name]
-        index = self._meta.get(name)
-        if index is None:
-            return raw
-        return decode_array(index, raw.tobytes())
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self._z.close()
-        return False
-
-    def close(self):
-        self._z.close()
-
-
-def _loadz(path):
-    """np.load for checkpoint npz files, transparent to block framing:
-    plain npz returns as-is; a ``__blocks__`` member returns the
-    decoding view. The single load door for every checkpoint/spill
-    consumer — which is what makes the compressed format invisible to
-    the resume/quarantine machinery above it."""
-    z = np.load(path)
-    if BLOCKS_META_MEMBER not in z.files:
-        return z
-    try:
-        meta = json.loads(bytes(z[BLOCKS_META_MEMBER]))
-    except (ValueError, KeyError):
-        z.close()
-        raise  # ValueError: a TORN_NPZ_ERRORS member — degrade as torn
-    return _BlockedNpzView(z, meta)
-
-
 class LevelCheckpointer:
-    """Saves solved levels as they complete; loads them for resume."""
+    """Saves solved levels as they complete; loads them for resume.
 
-    def __init__(self, directory: str):
+    All payload I/O routes through the block store (``store=``, default
+    the process-wide :func:`default_store`): sealed reads go through the
+    store's cache (so a level hinted by the solver's readahead is
+    decoded before the solve thread asks), payload writes go
+    write-behind (the solve thread never waits on DEFLATE+fsync), and
+    every ``finish_*`` seal waits for its payload writes first — the
+    GM8xx ordering invariant, chaos-verified at ``store.writebehind``.
+    """
+
+    def __init__(self, directory: str, store=None):
         self.dir = pathlib.Path(directory)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.manifest_path = self.dir / "manifest.json"
+        self._store = store
+
+    @property
+    def store(self):
+        """The block store serving this checkpointer (late-bound: the
+        default store re-reads its env knobs, so a test flipping
+        GAMESMAN_STORE_* between solves gets the fresh config)."""
+        return self._store if self._store is not None else default_store()
+
+    def flush_writes(self) -> None:
+        """Barrier on pending write-behind payload writes (re-raising
+        the first failure). Every seal path calls this unless its
+        caller already waited on the specific tickets (the sharded
+        solver's pipelined seals pass ``drain=False``)."""
+        self.store.drain()
+
+    # ------------------------------------------------------ sealed reads
+    # The one read door (store.read over store/sealed.read_npz_members):
+    # crc-verified, cache-served, prefetch-aware. Loaders are pure —
+    # corruption discovered on a prefetch thread re-raises HERE, on the
+    # consuming thread, where quarantine decisions live.
+
+    def _npz_read_plan(self, path, names, manifest=None):
+        """(key, loader) for one sealed npz payload — the SAME plan for
+        hints and reads, so a hinted load is always a later cache hit."""
+        want = None
+        if _verify_enabled():
+            if manifest is None:
+                manifest = self.load_manifest()
+            want = manifest.get("crc", {}).get(pathlib.Path(path).name)
+        return file_key(path), (
+            lambda: read_npz_members(path, names, crc=want)
+        )
+
+    def _read_npz(self, path, names, manifest=None):
+        """Sealed members of one checkpoint npz, through the store."""
+        key, loader = self._npz_read_plan(path, names, manifest)
+        return self.store.read(key, loader)
+
+    def _hint_npz(self, path, names, manifest=None) -> None:
+        """Readahead hint for one sealed npz (decoded on the prefetch
+        pool; a later _read_npz of the same unchanged file is a cache
+        hit; an evicted or changed file degrades to a sync read)."""
+        key, loader = self._npz_read_plan(path, names, manifest)
+        self.store.hint(key, loader)
 
     def _level_path(self, level: int) -> pathlib.Path:
         return self.dir / f"level_{level:04d}.npz"
@@ -273,41 +249,23 @@ class LevelCheckpointer:
 
     # ------------------------------------------------------------ integrity
     # Per-file crc32, recorded in the manifest when a file is sealed and
-    # verified when it is loaded for resume. Atomic _savez already rules
+    # verified when it is loaded for resume (store/sealed.verify_crc,
+    # captured into each sealed-read plan). Atomic _savez already rules
     # out torn WRITES; the crc catches what atomicity cannot — silent
     # bit-rot, a partial overwrite by a foreign process, a filesystem
     # that lied about durability. A mismatching file is quarantined
-    # (renamed .corrupt, unsealed from the manifest) and the loader
-    # raises CorruptCheckpointError, which every TORN_NPZ_ERRORS degrade
-    # path already turns into "recompute this level from the intact
-    # prefix".
-
-    def _check_crc(self, path: pathlib.Path, manifest=None) -> None:
-        """Verify one sealed file against its recorded crc (no-op when
-        no crc was recorded — pre-integrity checkpoint directories keep
-        loading — or when GAMESMAN_CKPT_VERIFY=0). ``manifest`` lets a
-        loop verify many files against ONE manifest read (a sharded
-        level is S files; S redundant manifest reads on a shared
-        checkpoint filesystem are not free)."""
-        if not _verify_enabled():
-            return
-        if manifest is None:
-            manifest = self.load_manifest()
-        want = manifest.get("crc", {}).get(path.name)
-        if want is None or not path.exists():
-            return
-        got = file_crc32(path)
-        if got != int(want):
-            raise CorruptCheckpointError(
-                f"{path.name}: crc32 {got:#010x} != sealed {int(want):#010x}"
-                " — quarantine and recompute"
-            )
+    # (renamed .corrupt, unsealed from the manifest) by the CONSUMING
+    # thread — the pure read may have run on a prefetch thread — and
+    # the loader raises CorruptCheckpointError, which every
+    # TORN_NPZ_ERRORS degrade path already turns into "recompute this
+    # level from the intact prefix".
 
     def quarantine_level(self, level: int) -> None:
         """Rename a sealed level's file(s) to ``.corrupt`` and unseal it,
         so the run degrades to the intact prefix: the level recomputes
         (its frontier is still known) and re-seals over the quarantine.
         Idempotent — callers may race the loader's own quarantine."""
+        self.flush_writes()  # never quarantine around an in-flight write
         manifest = self.load_manifest()
         paths = [self._level_path(level)]
         num = manifest.get("sharded_levels", {}).get(str(level))
@@ -477,13 +435,11 @@ class LevelCheckpointer:
         path = self._level_path(level)
         if path.exists():
             try:
-                self._check_crc(path)
+                states, cells = self._read_npz(path, ("states", "cells"))
             except CorruptCheckpointError:
                 self.quarantine_level(level)
                 raise
-            with _loadz(path) as z:
-                states = z["states"]
-                values, remoteness = unpack_cells(jnp.asarray(z["cells"]))
+            values, remoteness = unpack_cells(jnp.asarray(cells))
             return LevelTable(
                 states=states,
                 values=np.asarray(values),
@@ -531,8 +487,9 @@ class LevelCheckpointer:
         return sorted(self.load_manifest().get("dense_levels", []))
 
     def load_dense_level(self, level: int) -> np.ndarray:
-        with _loadz(self.dir / f"dense_{level:04d}.npz") as z:
-            return z["cells"]
+        (cells,) = self._read_npz(self.dir / f"dense_{level:04d}.npz",
+                                  ("cells",))
+        return cells
 
     # ------------------------------------------------- sharded (per-shard)
     # One file per (level, shard) and per (frontier snapshot, shard): no
@@ -544,18 +501,38 @@ class LevelCheckpointer:
     def _shard_level_path(self, level: int, shard: int) -> pathlib.Path:
         return self.dir / f"level_{level:04d}.shard_{shard:04d}.npz"
 
-    def save_level_shard(self, level: int, shard: int, states,
-                         cells) -> tuple[int, int]:
-        """-> (raw, stored) bytes — the sharded engine accumulates them
-        into its ckpt_bytes_* stats so an operator can see what the
+    def _savez_behind(self, path, **arrays):
+        """Write-behind _savez: enqueue the DEFLATE+tmp+os.replace on
+        the store's ordered worker and return the WriteTicket (resolved
+        to the (raw, stored) byte pair). Arrays are materialized HERE,
+        on the calling thread — device downloads must not happen on the
+        writer. With write-behind off the write runs inline and the
+        ticket is already resolved — callers are agnostic."""
+        arrays = {k: np.asarray(v) for k, v in arrays.items()}
+
+        def job(path=path, arrays=arrays):
+            return _savez(path, **arrays)
+
+        return self.store.write(job, path=str(path))
+
+    def save_level_shard(self, level: int, shard: int, states, cells):
+        """-> WriteTicket resolving to (raw, stored) bytes — the sharded
+        engine folds them into its ckpt_bytes_* stats (after the seal
+        waits on the ticket) so an operator can see what the
         spill/checkpoint tier costs (and what ``blocks`` compression
         saves) without stat-ing the directory."""
-        return _savez(
+        return self._savez_behind(
             self._shard_level_path(level, shard), states=states, cells=cells
         )
 
     def finish_level_shards(self, level: int, num_shards: int,
-                            ranks=None) -> None:
+                            ranks=None, drain: bool = True) -> None:
+        """Seal one level's shard set. ``drain=False`` is for callers
+        that already waited on this level's write tickets (the sharded
+        solver's pipelined seals) — a global drain there would stall on
+        NEWER levels' queued payloads and collapse the pipeline."""
+        if drain:
+            self.flush_writes()
         manifest = self.load_manifest()
         manifest.setdefault("sharded_levels", {})[str(level)] = num_shards
         # The sealer (process 0, post-barrier) records every shard file's
@@ -585,12 +562,27 @@ class LevelCheckpointer:
         ``manifest`` instead of paying a read per shard."""
         path = self._shard_level_path(level, shard)
         try:
-            self._check_crc(path, manifest)
+            return self._read_npz(path, ("states", "cells"), manifest)
         except CorruptCheckpointError:
             self.quarantine_level(level)
             raise
-        with _loadz(path) as z:
-            return z["states"], z["cells"]
+
+    def prefetch_level_shards(self, level: int, num_shards: int,
+                              manifest=None) -> None:
+        """Readahead hint for one sealed level's shard files (the
+        solver's level schedule calls this one level AHEAD of the
+        backward resolve that will load them)."""
+        if manifest is None:
+            manifest = self.load_manifest()
+        for s in range(num_shards):
+            self._hint_npz(self._shard_level_path(level, s),
+                           ("states", "cells"), manifest)
+
+    def prefetch_level(self, level: int) -> None:
+        """Readahead hint for one sealed GLOBAL level file."""
+        path = self._level_path(level)
+        if path.exists():
+            self._hint_npz(path, ("states", "cells"))
 
     def lookup_level_state(self, level: int, state):
         """(value, remoteness) of one CANONICAL packed state, served from
@@ -620,8 +612,7 @@ class LevelCheckpointer:
         if cache is not None and cache[0] == cache_key:
             states, cells = cache[1]
         elif cache_key[1] is None:
-            with _loadz(path) as z:
-                states, cells = z["states"], z["cells"]
+            states, cells = self._read_npz(path, ("states", "cells"))
         else:
             states, cells = self.load_level_shard(level, cache_key[1])
         # Memoize the last-loaded table: a batch of point queries often
@@ -654,18 +645,21 @@ class LevelCheckpointer:
     def _edges_path(self, level: int, shard: int) -> pathlib.Path:
         return self.dir / f"edges_{level:04d}.shard_{shard:04d}.npz"
 
-    def save_edges_shard(self, level: int, shard: int, eidx,
-                         slot) -> tuple[int, int]:
-        """-> (raw, stored) bytes, like save_level_shard."""
-        return _savez(
+    def save_edges_shard(self, level: int, shard: int, eidx, slot):
+        """-> WriteTicket resolving to (raw, stored) bytes, like
+        save_level_shard."""
+        return self._savez_behind(
             self._edges_path(level, shard),
             eidx=np.asarray(eidx, dtype=np.int32),
             slot=np.asarray(slot, dtype=np.int32),
         )
 
     def finish_edges_level(self, level: int, num_shards: int, ecap: int,
-                           slot_len: int, ranks=None) -> None:
+                           slot_len: int, ranks=None,
+                           drain: bool = True) -> None:
         """Seal one level's edge-shard set (process 0, post-barrier)."""
+        if drain:
+            self.flush_writes()
         manifest = self.load_manifest()
         manifest.setdefault("edge_levels", {})[str(level)] = {
             "shards": num_shards, "ecap": int(ecap),
@@ -678,10 +672,26 @@ class LevelCheckpointer:
         """{"shards", "ecap", "slot_len"} of a sealed edge level, or None."""
         return self.load_manifest().get("edge_levels", {}).get(str(level))
 
-    def load_edges_shard(self, level: int, shard: int):
-        """-> (eidx [S*ecap] int32, slot [cap*M] int32) of one shard."""
-        with _loadz(self._edges_path(level, shard)) as z:
-            return z["eidx"], z["slot"]
+    def load_edges_shard(self, level: int, shard: int, manifest=None):
+        """-> (eidx [S*ecap] int32, slot [cap*M] int32) of one shard.
+        Callers looping over a level's shards pass one pre-loaded
+        ``manifest`` instead of paying a read per shard."""
+        return self._read_npz(self._edges_path(level, shard),
+                              ("eidx", "slot"), manifest)
+
+    def prefetch_edges_level(self, level: int, num_shards: int,
+                             manifest=None) -> None:
+        """Readahead hint for one sealed level's edge-shard files (the
+        backward schedule hints level N-1's edges while level N
+        resolves — today's synchronous disk-spilled edge loads become
+        cache hits). Pass the already-loaded ``manifest``: S redundant
+        manifest reads per hinted level on a shared checkpoint
+        filesystem would pay back part of the overlap win."""
+        if manifest is None:
+            manifest = self.load_manifest()
+        for s in range(num_shards):
+            self._hint_npz(self._edges_path(level, s), ("eidx", "slot"),
+                           manifest)
 
     # Incremental per-(level, shard) forward saves — the sharded analog of
     # save_frontier_level: written as each level is discovered, superseded
@@ -689,21 +699,23 @@ class LevelCheckpointer:
     # format load_frontier_shards/load_frontiers already resume from, which
     # also supports shard-count changes), then deleted.
 
-    def save_forward_level_shard(self, level: int, shard: int,
-                                 states) -> tuple[int, int]:
-        """-> (raw, stored) bytes, like save_level_shard."""
-        return _savez(
+    def save_forward_level_shard(self, level: int, shard: int, states):
+        """-> WriteTicket resolving to (raw, stored) bytes, like
+        save_level_shard."""
+        return self._savez_behind(
             self.dir / f"frontier_{level:04d}.shard_{shard:04d}.npz",
             states=np.asarray(states),
         )
 
     def finish_forward_level(self, level: int, num_shards: int,
-                             ranks=None) -> None:
+                             ranks=None, drain: bool = True) -> None:
         """Seal one forward level's shard set (process 0, post-barrier —
         same write discipline as finish_level_shards, including the
         per-file crc so a torn per-rank frontier file is caught and
         quarantined on resume rather than silently resuming a holed
         discovery prefix)."""
+        if drain:
+            self.flush_writes()
         manifest = self.load_manifest()
         manifest.setdefault("forward_level_shards", {})[str(level)] = (
             num_shards
@@ -755,21 +767,30 @@ class LevelCheckpointer:
         manifest = self.load_manifest()
         rec = manifest.get("forward_level_shards", {})
         out: dict = {}
+        if any(rec[k] != num_shards for k in rec):
+            return {}
+        # Batched readahead over the WHOLE prefix before the first read:
+        # resume loads are the serial head of a solve, and the prefetch
+        # pool decodes level j+1's shards while level j's arrays are
+        # consumed.
+        for k in sorted(rec, key=int):
+            for s in range(num_shards):
+                self._hint_npz(
+                    self.dir / f"frontier_{int(k):04d}.shard_{s:04d}.npz",
+                    ("states",), manifest,
+                )
         # Levels in ascending order: the consumer (_forward_fast) resumes
         # only a contiguous-from-root prefix, so a torn level truncates
         # there — everything below it is still a valid (shorter) resume.
         for k in sorted(rec, key=int):
-            if rec[k] != num_shards:
-                return {}
             arrs = []
             try:
                 for s in range(num_shards):
                     path = self.dir / (
                         f"frontier_{int(k):04d}.shard_{s:04d}.npz"
                     )
-                    self._check_crc(path, manifest)
-                    with _loadz(path) as z:
-                        arrs.append(z["states"])
+                    (states,) = self._read_npz(path, ("states",), manifest)
+                    arrs.append(states)
             except TORN_NPZ_ERRORS:
                 # Torn or crc-mismatching per-rank file (a death between
                 # unlink and manifest write in an older layout, a
@@ -800,16 +821,20 @@ class LevelCheckpointer:
             ):
                 path.unlink(missing_ok=True)
 
-    def save_frontier_shard(self, shard: int, pools) -> None:
-        """One shard's slice of every frontier level, one file."""
+    def save_frontier_shard(self, shard: int, pools):
+        """One shard's slice of every frontier level, one file.
+        -> WriteTicket (write-behind, like save_level_shard)."""
         arrays = {
             f"level_{k:04d}": np.asarray(v) for k, v in pools.items()
         }
-        _savez(
+        return self._savez_behind(
             self.dir / f"frontiers.shard_{shard:04d}.npz", **arrays
         )
 
-    def finish_frontier_shards(self, num_shards: int) -> None:
+    def finish_frontier_shards(self, num_shards: int,
+                               drain: bool = True) -> None:
+        if drain:
+            self.flush_writes()
         manifest = self.load_manifest()
         manifest["frontier_shards"] = num_shards
         self._write_manifest(manifest)
@@ -817,16 +842,19 @@ class LevelCheckpointer:
     def load_frontier_shards(self, num_shards: int):
         """-> {level: [per-shard arrays]} when saved with num_shards, else
         None (caller falls back to load_frontiers + repartition)."""
-        saved = self.load_manifest().get("frontier_shards")
-        if saved != num_shards:
+        manifest = self.load_manifest()
+        if manifest.get("frontier_shards") != num_shards:
             return None
+        paths = [self.dir / f"frontiers.shard_{s:04d}.npz"
+                 for s in range(num_shards)]
+        for path in paths:  # batched readahead before the first read
+            self._hint_npz(path, None, manifest)
         out: dict = {}
-        for s in range(num_shards):
-            path = self.dir / f"frontiers.shard_{s:04d}.npz"
-            with _loadz(path) as z:
-                for name in z.files:
-                    k = int(name.split("_")[1])
-                    out.setdefault(k, [None] * num_shards)[s] = z[name]
+        for s, path in enumerate(paths):
+            members = self._read_npz(path, None, manifest)
+            for name, arr in members.items():
+                k = int(name.split("_")[1])
+                out.setdefault(k, [None] * num_shards)[s] = arr
         return out
 
     # Forward-phase snapshot: all per-level frontiers after discovery, so a
@@ -865,14 +893,18 @@ class LevelCheckpointer:
         intact prefix below it (re-expansion resumes from its deepest),
         exactly like the sharded loader's torn-directory handling."""
         out = {}
-        for k in sorted(self.load_manifest().get("forward_levels", []),
-                        key=int):
+        manifest = self.load_manifest()
+        ks = sorted(manifest.get("forward_levels", []), key=int)
+        for k in ks:  # batched readahead before the first read
+            self._hint_npz(self.dir / f"frontier_{int(k):04d}.npz",
+                           ("states",), manifest)
+        for k in ks:
             path = self.dir / f"frontier_{int(k):04d}.npz"
             try:
-                self._check_crc(path)
-                with _loadz(path) as z:
-                    out[int(k)] = z["states"]
+                (out[int(k)],) = self._read_npz(path, ("states",),
+                                                manifest)
             except TORN_NPZ_ERRORS:
+                out.pop(int(k), None)
                 self._quarantine_frontier(int(k))
                 break
         return out
@@ -911,12 +943,11 @@ class LevelCheckpointer:
             path = self.dir / "frontiers.npz"
             if path.exists():
                 try:
-                    self._check_crc(path)
-                    out = {}
-                    with _loadz(path) as z:
-                        for name in z.files:
-                            out[int(name.split("_")[1])] = z[name]
-                    return out
+                    members = self._read_npz(path, None, manifest)
+                    return {
+                        int(name.split("_")[1]): arr
+                        for name, arr in members.items()
+                    }
                 except TORN_NPZ_ERRORS:
                     # Corrupt global snapshot: quarantine it and fall
                     # through to the other resume sources (or a fresh

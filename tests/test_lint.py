@@ -955,6 +955,58 @@ def test_gm801_non_participating_module_exempt(tmp_path):
     assert got == []
 
 
+def test_gm803_direct_payload_read_flagged(tmp_path):
+    """np.load / os.pread / open-rb of a checkpoint/DB payload outside
+    store/ bypasses the sealed-read door + shared cache (ISSUE 11)."""
+    build_project(tmp_path, {"mod.py": """
+        import os
+
+        import numpy as np
+
+        def resume(d, fd):
+            z = np.load(d / "level_0001.shard_0000.npz")  # MARK
+            blob = os.pread(fd, 10, 0)  # MARK2: level_0002.gmb stream
+            with open(d / "frontier_0003.npz", "rb") as fh:  # MARK3
+                fh.read()
+            with open(d / "edges_0004.npz", mode="rb") as fh:  # MARK4
+                fh.read()
+            return z, blob
+
+        def user_artifact(path):
+            # A generic npy read names no payload: out of scope.
+            return np.load(path)
+    """})
+    _, got = findings(tmp_path)
+    assert got == [
+        ("GM803", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py")),
+        ("GM803", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py", "MARK2")),
+        ("GM803", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py", "MARK3")),
+        ("GM803", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py", "MARK4")),
+    ]
+
+
+def test_gm803_store_modules_and_annotated_escapes_exempt(tmp_path):
+    build_project(tmp_path, {
+        "store/__init__.py": "",
+        "store/sealed.py": """
+            import numpy as np
+
+            def loadz(path):
+                return np.load(path)  # the one door: level_0001.npz etc.
+        """,
+        "gate.py": """
+            import numpy as np
+
+            def audit(d, rec):
+                # store-io: integrity gate reads raw bytes on purpose
+                keys = np.load(d / rec["keys"], mmap_mode="r")
+                return keys
+        """,
+    })
+    _, got = findings(tmp_path)
+    assert got == []
+
+
 def test_gm802_payload_after_seal(tmp_path):
     build_project(tmp_path, {"mod.py": """
         import os

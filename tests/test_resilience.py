@@ -665,7 +665,15 @@ def c4_clean_table(tmp_path_factory):
 
 @pytest.mark.slow
 @pytest.mark.parametrize(
-    "point", ["sharded.forward", "sharded.backward", "ckpt.save_level"]
+    "point",
+    [
+        "sharded.forward", "sharded.backward", "ckpt.save_level",
+        # ISSUE 11: death on the write-behind worker right after a
+        # queued payload write lands, BEFORE its seal can run — the
+        # unsealed stray must be invisible to resume (the solve thread
+        # may already be a level ahead when the kill fires).
+        "store.writebehind",
+    ],
 )
 def test_chaos_kill_and_resume_parity_sharded_c4(point, tmp_path,
                                                  c4_clean_table):

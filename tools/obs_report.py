@@ -97,6 +97,7 @@ def _fold_one_rank(records: list[dict]) -> list[dict]:
                 "retries": 0,
                 "bytes_sorted": 0,
                 "bytes_gathered": 0,
+                "io_wait_secs": 0.0,
             },
         )
         if phase in ("retry", "ckpt_degraded"):
@@ -105,6 +106,10 @@ def _fold_one_rank(records: list[dict]) -> list[dict]:
         secs = float(rec.get("secs", 0.0))
         row["bytes_sorted"] += int(rec.get("bytes_sorted", 0))
         row["bytes_gathered"] += int(rec.get("bytes_gathered", 0))
+        # ISSUE 11: seconds this level's resolve spent blocked on block-
+        # store I/O (spill/edge/checkpoint loads + seal drains) — the
+        # prefetch overlap observable, per level.
+        row["io_wait_secs"] += float(rec.get("io_wait_secs", 0.0))
         if phase == "forward":
             row["fwd_secs"] += secs
             # The frontier size IS the level's position count; backward's
@@ -144,12 +149,12 @@ def format_table(rows: list[dict]) -> str:
     header = (
         f"{'level':>5}  {'positions':>10}  {'fwd_s':>8}  {'bwd_s':>8}  "
         f"{'total_s':>8}  {'pos/s':>12}  {'retries':>7}  {'sort_MB':>9}  "
-        f"{'gather_MB':>9}"
+        f"{'gather_MB':>9}  {'io_s':>7}"
     )
     lines = [header]
     tot = {
         "positions": 0, "fwd_secs": 0.0, "bwd_secs": 0.0, "retries": 0,
-        "bytes_sorted": 0, "bytes_gathered": 0,
+        "bytes_sorted": 0, "bytes_gathered": 0, "io_wait_secs": 0.0,
     }
     for r in rows:
         total = r["fwd_secs"] + r["bwd_secs"]
@@ -159,7 +164,8 @@ def format_table(rows: list[dict]) -> str:
             f"{r['bwd_secs']:>8.3f}  {total:>8.3f}  {pps:>12.1f}  "
             f"{r.get('retries', 0):>7}  "
             f"{r['bytes_sorted'] / 1e6:>9.1f}  "
-            f"{r['bytes_gathered'] / 1e6:>9.1f}"
+            f"{r['bytes_gathered'] / 1e6:>9.1f}  "
+            f"{r.get('io_wait_secs', 0.0):>7.3f}"
         )
         for k in tot:
             tot[k] += r.get(k, 0)
@@ -170,7 +176,8 @@ def format_table(rows: list[dict]) -> str:
         f"{tot['bwd_secs']:>8.3f}  {total:>8.3f}  {pps:>12.1f}  "
         f"{tot['retries']:>7}  "
         f"{tot['bytes_sorted'] / 1e6:>9.1f}  "
-        f"{tot['bytes_gathered'] / 1e6:>9.1f}"
+        f"{tot['bytes_gathered'] / 1e6:>9.1f}  "
+        f"{tot['io_wait_secs']:>7.3f}"
     )
     return "\n".join(lines)
 
