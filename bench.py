@@ -627,6 +627,155 @@ def _store_bench() -> dict | None:
     return record
 
 
+def _campaign_bench() -> dict | None:
+    """BENCH_CAMPAIGN=1: the self-healing campaign proof (ISSUE 12).
+
+    One `tools/run_campaign.py` invocation drives a sharded solve —
+    BENCH_CAMPAIGN_PROCESSES > 1 makes each attempt a real
+    launch_multihost world — through THREE injected SIGKILLs at
+    distinct points (forward, backward, mid-write-behind; rank 0 in a
+    world, its peers exiting through the coordinated abort) to
+    completion with zero operator input. Gates: campaign rc 0, the
+    ledger records every attempt with the injected causes, and the
+    final `--table-out` table is byte-identical to an uninterrupted
+    solve of the same config. Full record (ledger included) →
+    BENCH_CAMPAIGN_OUT; summary joins the bench record under
+    `campaign`. Runs in the PARENT (subprocess-only); failures are
+    recorded, never raised.
+    """
+    if os.environ.get("BENCH_CAMPAIGN", "0") in ("0", "", "off"):
+        return None
+    import tempfile
+
+    import numpy as np
+
+    spec = os.environ.get("BENCH_CAMPAIGN_GAME", "connect4:w=5,h=4")
+    processes = int(_env_float("BENCH_CAMPAIGN_PROCESSES", 2))
+    shards = int(_env_float("BENCH_CAMPAIGN_SHARDS", 4))
+    out_path = os.environ.get("BENCH_CAMPAIGN_OUT", "BENCH_campaign.json")
+    deadline = _env_float("GAMESMAN_BENCH_DEADLINE", 3000.0)
+    chaos = [
+        "sharded.forward:kill:3",       # mid-forward
+        "sharded.backward:kill:2",      # mid-backward
+        "store.writebehind:kill:1",     # mid-write-behind payload
+    ]
+    record: dict = {
+        "bench": "self_healing_campaign",
+        "spec": spec,
+        "processes": processes,
+        "shards": shards,
+        "chaos": chaos,
+    }
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def _resumed_table(workdir: str) -> str:
+        # An N-process attempt rank-qualifies --table-out; the table is
+        # the GLOBAL solved table either way, so rank0's file is
+        # canonical. The golden solve is always single-process.
+        if processes > 1:
+            return os.path.join(workdir, "resumed.rank0.npz")
+        return os.path.join(workdir, "resumed.npz")
+
+    try:
+        with tempfile.TemporaryDirectory(prefix="bench_campaign_") as wd:
+            child_env = dict(os.environ)
+            child_env.pop("GAMESMAN_FAULTS", None)
+            child_env.update({
+                "GAMESMAN_PLATFORM": "cpu",
+                "GAMESMAN_CAMPAIGN_BACKOFF_BASE_SECS": "0.2",
+                # A dead rank must resolve into a coordinated abort,
+                # not a wedged world the attempt timeout reaps.
+                "GAMESMAN_BARRIER_SECS": "30",
+                "GAMESMAN_COLLECTIVE_TIMEOUT": "120",
+            })
+            t0 = time.time()
+            golden_cmd = [
+                sys.executable, "-m", "gamesmanmpi_tpu.cli", spec,
+                "--devices", str(shards),
+                "--table-out", os.path.join(wd, "golden.npz"),
+            ]
+            golden_env = dict(child_env)
+            golden_env["GAMESMAN_FAKE_DEVICES"] = str(shards)
+            golden = subprocess.run(
+                golden_cmd, capture_output=True, text=True,
+                timeout=deadline, env=golden_env, cwd=repo,
+            )
+            record["golden_secs"] = round(time.time() - t0, 3)
+            if golden.returncode != 0:
+                record["ok"] = False
+                record["error"] = "golden: " + golden.stderr[-1000:]
+                raise StopIteration
+            ck = os.path.join(wd, "ck")
+            cmd = [
+                sys.executable, os.path.join(repo, "tools",
+                                             "run_campaign.py"),
+                spec, "--checkpoint-dir", ck,
+                "--processes", str(processes),
+            ]
+            for c in chaos:
+                cmd += ["--chaos", c]
+            cmd += ["--", "--devices", str(shards),
+                    "--table-out", os.path.join(wd, "resumed.npz")]
+            t0 = time.time()
+            camp = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=deadline,
+                env=child_env, cwd=repo,
+            )
+            record["campaign_rc"] = camp.returncode
+            record["campaign_secs"] = round(time.time() - t0, 3)
+            ledger = []
+            try:
+                with open(os.path.join(ck, "campaign.jsonl")) as fh:
+                    for line in fh:
+                        try:
+                            ledger.append(json.loads(line))
+                        except json.JSONDecodeError:
+                            continue
+            except OSError:
+                pass
+            record["ledger"] = ledger
+            attempts = [r for r in ledger
+                        if r.get("phase") == "campaign_attempt"]
+            record["attempts"] = len(attempts)
+            record["causes"] = [a.get("cause") for a in attempts]
+            record["resume_levels"] = [a.get("resume_level")
+                                       for a in attempts]
+            if camp.returncode != 0:
+                record["ok"] = False
+                record["error"] = camp.stderr[-2000:]
+                raise StopIteration
+            # The three injected deaths really happened, then it healed.
+            record["chaos_ok"] = bool(
+                len(attempts) == len(chaos) + 1
+                and all(a.get("cause") == "killed"
+                        for a in attempts[:len(chaos)])
+                and attempts[-1].get("cause") == "complete"
+            )
+            parity = True
+            with np.load(os.path.join(wd, "golden.npz")) as za, \
+                    np.load(_resumed_table(wd)) as zb:
+                parity = sorted(za.files) == sorted(zb.files) and all(
+                    np.array_equal(za[f], zb[f]) for f in za.files
+                )
+            record["parity_ok"] = bool(parity)
+            record["ok"] = bool(record["chaos_ok"] and parity)
+    except StopIteration:
+        pass
+    except Exception as e:  # noqa: BLE001 - must never kill the bench
+        record["ok"] = False
+        record["error"] = f"{type(e).__name__}: {e}"
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(record, fh, indent=1)
+            fh.write("\n")
+        print(f"campaign bench: wrote {out_path} "
+              f"(ok={record.get('ok')})", file=sys.stderr)
+    except OSError as e:
+        print(f"campaign bench: cannot write {out_path}: {e}",
+              file=sys.stderr)
+    return record
+
+
 def _db_compress_bench() -> dict | None:
     """BENCH_DB_COMPRESS=1: the compressed-DB ratio + latency benchmark
     (ROADMAP item 2 / ISSUE 9).
@@ -944,6 +1093,16 @@ def main() -> int:
             if arm in sb and "io_wait_secs" in sb[arm]:
                 record["store"][f"{arm}_io_wait_secs"] = \
                     sb[arm]["io_wait_secs"]
+    cb = _campaign_bench()
+    if cb is not None:
+        # Summary only — the full ledger lives in the artifact file
+        # (BENCH_CAMPAIGN_OUT); the one-line record stays one line.
+        record["campaign"] = {
+            k: cb.get(k) for k in
+            ("ok", "chaos_ok", "parity_ok", "attempts", "causes",
+             "campaign_rc", "campaign_secs", "error")
+            if k in cb
+        }
     sv = _serve_bench()
     if sv is not None:
         # Summary only — the full load/chaos record lives in the

@@ -24,9 +24,18 @@ guarded-field checks: construction is single-threaded by contract.
 | GM202 | non-reentrant lock re-acquired while held (with-block or a call that acquires it) — deadlock |
 | GM203 | blocking call (queue.get / socket I/O / np.load / .result() / thread join / sleep / subprocess) while a lock is held |
 | GM204 | method annotated requires-lock called without the lock held |
+| GM205 | lock acquisition reachable from a registered signal handler |
 
-Analysis is lexical and name-based per module (the repo convention:
-one lock name means one lock), so it needs no imports and no types.
+GM201-GM204 are lexical and name-based per module (the repo convention:
+one lock name means one lock), so they need no imports and no types.
+GM205 is whole-program: CPython delivers signals on the main thread, so
+a handler that (transitively, through the cross-module call graph)
+acquires a lock can interrupt the very ``with lock:`` region it then
+blocks on — the self-deadlock class PR 7's fourth review pass fixed by
+hand in the serve supervisor's ``request_stop``. Handlers must stay
+lock-free: set a flag, write a pipe, ``os.kill`` a child. Functions a
+handler only *spawns* (``Thread``/``Timer`` targets — their bodies run
+on another thread's program order) do not propagate.
 """
 
 from __future__ import annotations
@@ -390,6 +399,99 @@ def _walk_functions(mod: _ModuleLocks, diags: List[Diagnostic]) -> None:
     visit(mod.src.tree.body, None)
 
 
+#: Callback funnels that do NOT propagate lock reach to the registered
+#: handler: a handler that merely SPAWNS a locking function runs it on
+#: another thread (Thread/Timer targets), which cannot deadlock the
+#: interrupted main thread.
+_HANDLER_SAFE_VIAS = frozenset({"Thread", "Timer"})
+
+
+def _direct_acquires(mod: _ModuleLocks, fn_node) -> Set[str]:
+    """Locks ``fn_node`` acquires IN ITS OWN BODY (``with`` blocks and
+    explicit ``.acquire()``), nested defs excluded. GM205 must not use
+    the module inventory's transitively-closed acquire sets: that
+    closure counts every ``self.x`` mention as a call, so a handler
+    merely passing a locking method as a Thread target would be marked
+    — the cross-module call graph (which knows callback funnels) does
+    the closing instead."""
+    from gamesmanmpi_tpu.analysis.project import walk_scoped
+
+    acq: Set[str] = set()
+    for node in walk_scoped(fn_node):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                ln = mod.with_lock(item.context_expr)
+                if ln is not None:
+                    acq.add(ln)
+        elif isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            if (
+                chain
+                and chain[-1] == "acquire"
+                and len(chain) >= 2
+                and mod.canonical(chain[-2]) in mod.lock_kind
+            ):
+                acq.add(mod.canonical(chain[-2]))
+    return acq
+
+
+def _signal_handler_findings(project: Project) -> List[Diagnostic]:
+    """GM205: whole-program — every function registered via
+    ``signal.signal(sig, handler)`` must not reach a lock acquisition
+    through the call graph (see module docstring)."""
+    cg = project.callgraph()
+    direct: dict = {}
+    lock_names: dict = {}
+    for src in project.files:
+        if src.tree is None:
+            continue
+        mod = project.module_locks(src)
+        if not mod.lock_kind:
+            continue
+        for key in cg.by_module.get(src.rel, []):
+            acq = _direct_acquires(mod, cg.functions[key].node)
+            if acq:
+                direct[key] = True
+                lock_names[key] = sorted(acq)
+    if not direct:
+        return []
+    reached = cg.reach(direct, exclude_vias=_HANDLER_SAFE_VIAS)
+
+    def locks_reached(start: str) -> List[str]:
+        """Names of the locks ``start`` can reach — BFS over the same
+        edges reach() closed, so the finding names the actual hazard."""
+        seen, queue, found = {start}, [start], set()
+        while queue:
+            key = queue.pop(0)
+            found.update(lock_names.get(key, ()))
+            for ev in cg.functions[key].events:
+                if ev.via and ev.via in _HANDLER_SAFE_VIAS:
+                    continue
+                if ev.callee is not None and ev.callee in reached \
+                        and ev.callee not in seen:
+                    seen.add(ev.callee)
+                    queue.append(ev.callee)
+        return sorted(found)
+
+    diags: List[Diagnostic] = []
+    for fn in cg.functions.values():
+        for ev in fn.events:
+            # Callback edges into signal.signal: the handler argument.
+            if ev.via != "signal" or ev.callee is None:
+                continue
+            if ev.callee in reached:
+                locks = ", ".join(locks_reached(ev.callee)) or "a lock"
+                handler = cg.functions[ev.callee].qualname
+                diags.append(Diagnostic(
+                    fn.rel, ev.lineno, "GM205",
+                    f"signal handler {handler!r} can reach acquisition "
+                    f"of {locks} — a handler interrupting a thread that "
+                    "holds it deadlocks; keep handlers lock-free (set a "
+                    "flag, write a pipe, signal a child)",
+                ))
+    return diags
+
+
 def check(project: Project) -> List[Diagnostic]:
     diags: List[Diagnostic] = []
     for src in project.files:
@@ -401,4 +503,5 @@ def check(project: Project) -> List[Diagnostic]:
         if not mod.guarded and not mod.requires and not mod.lock_kind:
             continue
         _walk_functions(mod, diags)
+    diags.extend(_signal_handler_findings(project))
     return diags

@@ -732,10 +732,44 @@ def _solve_main(args, t0: float, logger) -> int:
             store_tables=not args.no_tables,
         )
     from gamesmanmpi_tpu.resilience.coordination import CoordinatedAbort
+    from gamesmanmpi_tpu.resilience.preempt import (
+        GRACE_EXIT_CODE,
+        PreemptionRequested,
+        install_grace_handler,
+    )
 
+    # Preemption grace (docs/DISTRIBUTED.md "Campaigns"): SIGTERM/SIGUSR1
+    # drain the solve to the next level boundary — everything complete is
+    # sealed by the solve's own teardown — and exit 75 (resumable). Only
+    # a CHECKPOINTED solve gets the handlers: exit 75 promises "restart
+    # me against the same checkpoint directory", and a solve with
+    # nothing to seal should keep dying promptly on SIGTERM (systemd /
+    # k8s stop) instead of computing to the next boundary for a lie.
+    restore_grace = (
+        install_grace_handler() if checkpointer is not None
+        else (lambda: None)
+    )
     try:
         with maybe_profile(args.profile_dir):
             result = solver.solve()
+    except PreemptionRequested as e:
+        progress = getattr(solver, "progress", {})
+        print(f"preempted: {e}\nprogress: {progress}", file=sys.stderr)
+        sys.stderr.flush()
+        if logger is not None:
+            logger.log({"phase": "preempted", "detail": str(e)[:200],
+                        **{("in_phase" if k == "phase" else k): v
+                           for k, v in progress.items()
+                           if isinstance(v, (int, str, float))}})
+            logger.close()
+        import jax
+
+        if jax.process_count() > 1:
+            # Same contract as the coordinated abort below: a clean exit
+            # would block in jax's distributed-shutdown barrier when a
+            # peer is already gone.
+            os._exit(GRACE_EXIT_CODE)
+        return GRACE_EXIT_CODE
     except CoordinatedAbort as e:
         # The fleet agreed to stop (a peer died, diverged, or timed out):
         # same resumable-abort contract as the watchdog — diagnostics to
@@ -760,6 +794,8 @@ def _solve_main(args, t0: float, logger) -> int:
         # until the coordination service SIGABRTs this process ~100 s
         # later — the watchdog contract is "gone within the deadline".
         os._exit(WATCHDOG_EXIT_CODE)
+    finally:
+        restore_grace()
     _report(result, args.devices, time.perf_counter() - t0, args)
     return 0
 

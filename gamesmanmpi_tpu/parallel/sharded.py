@@ -103,6 +103,7 @@ from gamesmanmpi_tpu.ops.provenance import (
 from gamesmanmpi_tpu.obs import Span, default_registry
 from gamesmanmpi_tpu.parallel.mesh import AXIS, make_mesh, shard_map
 from gamesmanmpi_tpu.resilience import faults
+from gamesmanmpi_tpu.resilience import preempt
 from gamesmanmpi_tpu.resilience.coordination import (
     ABORT,
     OK,
@@ -726,6 +727,34 @@ class ShardedSolver:
         # (same shard count over different device sets must not share).
         self._mesh_key = tuple(d.id for d in self.mesh.devices.flat)
         self._sharding = NamedSharding(self.mesh, P(AXIS))
+
+    def _check_preempt(self, phase: str, level) -> None:
+        """Rank-coordinated level-boundary preemption point (ISSUE 12).
+
+        Single-process: one flag check. Multi-process: every rank folds
+        its local grace flag into an epoch round at this boundary — the
+        signal lands asynchronously, so without consensus rank A could
+        unwind at level k while rank B enters level k's first collective
+        and wedges until the collective deadline. The round (ABORT from
+        any preempted rank beats OK) makes every rank raise
+        :class:`PreemptionRequested` at the SAME program point, so the
+        whole world drains to exit 75 together with the deepest mutually
+        sealed prefix on disk. A CoordinationError here converts to
+        CoordinatedAbort via _propose_step — exit 124, still resumable.
+        """
+        flagged = preempt.requested()
+        if self.coord is not None:
+            decision = self._propose_step(
+                "preempt", level, 0, phase, ABORT if flagged else OK, None
+            )
+            flagged = flagged or decision != OK
+        if flagged:
+            preempt.check(phase, level=level, logger=self.logger)
+            # A peer was preempted but this rank's own flag is unset
+            # (its signal is still in flight): same unwind, attributed.
+            raise preempt.PreemptionRequested(
+                f"peer rank preempted at {phase} boundary (level {level})"
+            )
 
     def _retry(self, point: str, fn, reset=None, level=None, entry=None):
         """Level-step retry wrapper (see resilience.retry): the sharded
@@ -1454,6 +1483,10 @@ class ShardedSolver:
                 "phase": "forward", "level": k, "rank": self.rank,
                 "frontier": int(levels[k].counts.sum()),
             }
+            # Level boundary: level k's incremental frontier (and edge)
+            # files are already enqueued/sealed — a grace signal stops
+            # HERE and resume re-expands from the deepest sealed level.
+            self._check_preempt("forward", k)
             b0 = (self.bytes_routed, self.bytes_sorted)
             route_cap = self._initial_route_cap(cap)
             eidx = slot = None
@@ -1598,6 +1631,7 @@ class ShardedSolver:
             t0 = time.perf_counter()
             self.progress = {"phase": "forward", "level": k,
                              "rank": self.rank}
+            self._check_preempt("forward", k)
             b0 = (self.bytes_routed, self.bytes_sorted)
             frontier, counts = pools.pop(k)
             rec = _SLevel(counts, frontier, None)
@@ -1895,6 +1929,7 @@ class ShardedSolver:
                 "phase": "backward", "level": k, "rank": self.rank,
                 "n": int(rec.counts.sum()),
             }
+            self._check_preempt("backward", k)
             # Batched readahead from the level schedule: while THIS
             # level resolves, the store's pool decodes the NEXT level's
             # sealed checkpoint/edge shards — the solve thread's loads
@@ -2697,6 +2732,15 @@ class ShardedSolver:
         # seals run, their tickets resolve into ckpt_bytes_*, and the
         # store deltas below include every write this solve issued.
         self._flush_seals()
+        if self.checkpointer is not None:
+            try:
+                # Refresh the gamesman_ckpt_bytes{kind} disk gauges with
+                # everything this solve left on disk (the campaign's
+                # disk monitor reads the same accounting between
+                # attempts).
+                self.checkpointer.disk_usage()
+            except (OSError, AttributeError):
+                pass  # stubbed checkpointers / racing cleanup
         t_total = time.perf_counter() - t0
         root_value, root_rem = self._root_answer
         stats = {

@@ -24,9 +24,20 @@ dying under reader faults. Three pieces, one subsystem:
   checkpoint prefix intact — turning the heartbeat's "observed wedge"
   into a recoverable abort.
 
+Above them (ISSUE 12) sit the campaign pieces:
+
+* ``preempt`` — preemption grace: SIGTERM/SIGUSR1 drain the solve to
+  the next level boundary (rank-coordinated in the sharded engine) and
+  exit 75 resumable, with a hard deadline behind it.
+* ``campaign`` — the solve-side supervisor (``tools/run_campaign.py``):
+  auto-resume with bounded backoff, a no-progress breaker with a
+  diagnosis bundle, disk-budget GC-and-retry, and an append-only
+  ``campaign.jsonl`` ledger. docs/DISTRIBUTED.md "Campaigns".
+
 The capstone test, ``tests/test_resilience.py``, kills a solve at every
 registered fault point, resumes it, and asserts byte parity with an
-uninterrupted solve. docs/CONFIG.md lists every knob.
+uninterrupted solve; ``tests/test_campaign.py`` does the same one layer
+up, to whole campaigns. docs/CONFIG.md lists every knob.
 """
 
 from gamesmanmpi_tpu.resilience.faults import (
@@ -38,10 +49,16 @@ from gamesmanmpi_tpu.resilience.faults import (
     fire,
     known_points,
 )
+from gamesmanmpi_tpu.resilience.preempt import (
+    GRACE_EXIT_CODE,
+    PreemptionRequested,
+)
 from gamesmanmpi_tpu.resilience.retry import is_transient, retry_call
 from gamesmanmpi_tpu.resilience.supervisor import Watchdog, maybe_watchdog
 
 __all__ = [
+    "GRACE_EXIT_CODE",
+    "PreemptionRequested",
     "FaultError",
     "TransientFault",
     "FatalFault",

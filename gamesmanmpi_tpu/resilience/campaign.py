@@ -1,0 +1,625 @@
+"""Self-healing solve campaigns: auto-resume supervision above the solve.
+
+The stack below this module already turns every failure it can into a
+clean, resumable death — transient retry (PR 4), coordinated abort /
+exit 124 with an intact checkpoint prefix (PR 6), preemption grace /
+exit 75 (resilience/preempt.py) — but nothing *above* the solve resumed
+it: every witness run still needed an operator watching. This is the
+solve-side sibling of the serve fleet's supervisor (serve/supervisor.py)
+for the multi-day 5x6 → 6x6 → 7x6 campaign regime (ROADMAP item 1),
+where "Strongly Solving 7x6 Connect-Four on Consumer Grade Hardware"
+(arXiv 2507.05267) and the Pentago solve (arXiv 1404.0743) show the
+binding constraint is surviving crashes, preemptions, and disk
+exhaustion — not FLOPs.
+
+One :class:`Campaign` drives one game to completion:
+
+* **attempts** — launch the solve (a single process, or the whole
+  ``tools/launch_multihost.py`` world) against one checkpoint
+  directory; every death classified from exit codes + log tails; resume
+  is just the next attempt (the engines' own resume machinery does the
+  rest);
+* **backoff** — bounded exponential between failed attempts, reset
+  whenever an attempt made progress (sealed something new);
+* **no-progress breaker** — K consecutive attempts dying without
+  sealing a new level abort the campaign with a diagnosis bundle (last
+  checkpoint progress, quarantine inventory, per-rank log tails):
+  retrying a deterministic failure forever is not resilience;
+* **disk budget** — free space below the soft threshold (or an
+  ENOSPC-classified death) triggers retention GC of superseded
+  artifacts (utils/checkpoint.gc_superseded); below the hard floor the
+  campaign aborts cleanly, prefix intact;
+* **ledger** — an append-only ``campaign.jsonl`` (fsync per record)
+  makes every witness run a committed, auditable, resumable artifact;
+  ``tools/obs_report.py`` folds it into the campaign summary.
+
+Exit codes: 0 solved; 3 no-progress breaker / attempts exhausted;
+4 disk hard floor; 75 the campaign itself was preempted (SIGTERM —
+forwarded to the attempt, which drains gracefully; rerun the same
+command to continue).
+
+This module is deliberately jax-free at import (like coordination.py):
+the supervisor must start instantly and survive anything the solve
+process does to itself. The one jax-importing dependency
+(LevelCheckpointer, for GC) is imported lazily when a GC actually runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import pathlib
+import shutil
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from gamesmanmpi_tpu.resilience.preempt import GRACE_EXIT_CODE
+from gamesmanmpi_tpu.resilience.faults import (
+    KILL_EXIT_CODE,
+    TORN_EXIT_CODE,
+)
+from gamesmanmpi_tpu.resilience.supervisor import WATCHDOG_EXIT_CODE
+from gamesmanmpi_tpu.utils.env import env_float, env_int
+
+#: Campaign exit codes (documented in docs/DISTRIBUTED.md "Campaigns").
+NO_PROGRESS_EXIT_CODE = 3
+DISK_FLOOR_EXIT_CODE = 4
+
+#: Log-tail markers that classify a death as disk exhaustion (the
+#: injected ``enospc`` fault kind and the real OSError both match).
+ENOSPC_MARKERS = ("ENOSPC", "No space left on device", "[Errno 28]")
+
+#: Bytes of each attempt log kept in the diagnosis bundle.
+LOG_TAIL_BYTES = 4000
+
+_REPO = pathlib.Path(__file__).resolve().parents[2]
+
+
+def checkpoint_progress(directory) -> dict:
+    """A jax-free snapshot of what the checkpoint tree has sealed —
+    the campaign's progress observable. Tolerates a missing or torn
+    manifest (a brand-new campaign has neither)."""
+    path = pathlib.Path(directory) / "manifest.json"
+    try:
+        manifest = json.loads(path.read_text())
+    except (OSError, ValueError):
+        manifest = {}
+    solved = set(int(k) for k in manifest.get("levels", []))
+    solved |= {int(k) for k in manifest.get("sharded_levels", {})}
+    forward = set(int(k) for k in manifest.get("forward_levels", []))
+    forward |= {int(k) for k in manifest.get("forward_level_shards", {})}
+    dense = [int(k) for k in manifest.get("dense_levels", [])]
+    return {
+        "solved_levels": sorted(solved),
+        "deepest_solved": max(solved) if solved else None,
+        "forward_levels": len(forward),
+        "frontiers_complete": bool(
+            manifest.get("frontiers_complete") or manifest.get("frontiers")
+            or manifest.get("frontier_shards")
+        ),
+        "dense_levels": len(dense),
+        "epoch": int(manifest.get("run", {}).get("epoch", 0)),
+    }
+
+
+def progress_score(progress: dict) -> tuple:
+    """Monotone progress measure, compared lexicographically. A flat
+    count would lie at the forward->backward seam: consolidating the
+    frontier snapshot DELETES the per-level forward seals it supersedes
+    (drop_forward_level_shards), so an attempt that finished forward
+    would read as regression. Phase-ordered, that transition is always
+    an increase: frontiers-complete beats any forward count, a newly
+    solved level beats anything within the backward phase."""
+    return (
+        int(progress["frontiers_complete"]),
+        len(progress["solved_levels"]),
+        progress["forward_levels"],
+        progress["dense_levels"],
+    )
+
+
+class _Ledger:
+    """Append-only JSONL, one fsync'd line per record: the ledger must
+    survive the campaign process dying mid-write (the same durability
+    stance as the checkpoint manifest, without its atomic-replace —
+    appends never tear earlier records, and obs_report's loader skips a
+    torn tail line)."""
+
+    def __init__(self, path):
+        self.path = pathlib.Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+
+    def log(self, record: dict) -> None:
+        line = json.dumps({"wall_time": time.time(), **record},
+                          default=str)
+        with open(self.path, "a") as fh:
+            fh.write(line + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+
+
+@dataclasses.dataclass
+class CampaignConfig:
+    """One campaign's shape. Every numeric default reads its
+    ``GAMESMAN_CAMPAIGN_*`` / ``GAMESMAN_CKPT_DISK_*`` env twin
+    (docs/CONFIG.md), so ``tools/run_campaign.py`` flags and env agree.
+    """
+
+    solver_args: List[str]  # game spec + solve CLI flags (no ckpt flag)
+    checkpoint_dir: str
+    processes: int = 1  # >1 = a real launch_multihost world
+    max_attempts: int = None  # type: ignore[assignment]
+    no_progress_limit: int = None  # type: ignore[assignment]
+    backoff_base_secs: float = None  # type: ignore[assignment]
+    backoff_max_secs: float = None  # type: ignore[assignment]
+    attempt_timeout_secs: float = None  # type: ignore[assignment]
+    disk_soft_mb: float = None  # type: ignore[assignment]
+    disk_floor_mb: float = None  # type: ignore[assignment]
+    ledger_path: Optional[str] = None  # default <ckpt>/campaign.jsonl
+    log_dir: Optional[str] = None  # default <ckpt>/logs
+    #: per-attempt chaos: attempt i (1-based) runs with GAMESMAN_FAULTS
+    #: set to chaos[i-1] ("" = clean); attempts past the list run clean.
+    #: Multi-process attempts arm rank 0 only (the other ranks die by
+    #: coordinated abort — the realistic preemption shape).
+    chaos: List[str] = dataclasses.field(default_factory=list)
+    local_devices: Optional[int] = None  # multihost fake devices/rank
+
+    def __post_init__(self):
+        if self.max_attempts is None:
+            self.max_attempts = env_int("GAMESMAN_CAMPAIGN_MAX_ATTEMPTS", 8)
+        if self.no_progress_limit is None:
+            self.no_progress_limit = env_int(
+                "GAMESMAN_CAMPAIGN_NO_PROGRESS", 3
+            )
+        if self.backoff_base_secs is None:
+            self.backoff_base_secs = env_float(
+                "GAMESMAN_CAMPAIGN_BACKOFF_BASE_SECS", 1.0
+            )
+        if self.backoff_max_secs is None:
+            self.backoff_max_secs = env_float(
+                "GAMESMAN_CAMPAIGN_BACKOFF_MAX_SECS", 60.0
+            )
+        if self.attempt_timeout_secs is None:
+            self.attempt_timeout_secs = env_float(
+                "GAMESMAN_CAMPAIGN_ATTEMPT_SECS", 0.0
+            )
+        if self.disk_soft_mb is None:
+            self.disk_soft_mb = env_float("GAMESMAN_CKPT_DISK_SOFT_MB", 0.0)
+        if self.disk_floor_mb is None:
+            self.disk_floor_mb = env_float(
+                "GAMESMAN_CKPT_DISK_FLOOR_MB", 0.0
+            )
+        if self.ledger_path is None:
+            self.ledger_path = str(
+                pathlib.Path(self.checkpoint_dir) / "campaign.jsonl"
+            )
+        if self.log_dir is None:
+            self.log_dir = str(pathlib.Path(self.checkpoint_dir) / "logs")
+
+
+class CampaignAborted(RuntimeError):
+    """The campaign gave up (breaker / disk floor); ``code`` is the
+    process exit code, the diagnosis bundle is already on disk."""
+
+    def __init__(self, reason: str, code: int):
+        super().__init__(reason)
+        self.code = code
+
+
+class Campaign:
+    """Drives one solve to completion across attempts. ``run()`` returns
+    the campaign exit code (see module docstring)."""
+
+    def __init__(self, config: CampaignConfig, echo=None):
+        self.cfg = config
+        self.ledger = _Ledger(config.ledger_path)
+        self.echo = echo or (lambda msg: print(msg, file=sys.stderr,
+                                               flush=True))
+        pathlib.Path(config.checkpoint_dir).mkdir(parents=True,
+                                                  exist_ok=True)
+        pathlib.Path(config.log_dir).mkdir(parents=True, exist_ok=True)
+        #: written by the signal handler (lock-free: a plain flag plus
+        #: os.kill of the recorded child pids — GM205's contract).
+        self._preempted = False
+        self._child_pids: List[int] = []
+
+    # ------------------------------------------------------------ signals
+
+    def request_preempt(self) -> None:
+        # Lock-free by contract (GM205): CPython delivers signals on
+        # this (main) thread, so the handler must not take any lock the
+        # interrupted code could hold. Forward the grace signal to every
+        # live attempt process; they drain and exit 75.
+        self._preempted = True
+        for pid in list(self._child_pids):
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass
+
+    def install_signal_handlers(self):
+        """SIGTERM/SIGINT preempt the campaign (and, forwarded, the
+        attempt). Returns a restore callable; no-op off the main
+        thread."""
+        previous = {}
+
+        def _on_signal(signum, frame):
+            self.request_preempt()
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                previous[sig] = signal.signal(sig, _on_signal)
+            except ValueError:
+                pass
+
+        def restore():
+            for sig, handler in previous.items():
+                signal.signal(sig, handler)
+
+        return restore
+
+    # ----------------------------------------------------------- attempts
+
+    def _attempt_env(self, attempt: int) -> dict:
+        env = dict(os.environ)
+        env.pop("GAMESMAN_FAULTS", None)
+        for k in list(env):
+            if k.startswith("GAMESMAN_FAULTS_RANK_"):
+                env.pop(k)
+        spec = ""
+        if attempt <= len(self.cfg.chaos):
+            spec = self.cfg.chaos[attempt - 1]
+        if spec:
+            if self.cfg.processes > 1:
+                env["GAMESMAN_FAULTS_RANK_0"] = spec
+            else:
+                env["GAMESMAN_FAULTS"] = spec
+        return env
+
+    def _solver_args(self) -> List[str]:
+        return list(self.cfg.solver_args) + [
+            "--checkpoint-dir", str(self.cfg.checkpoint_dir),
+        ]
+
+    def _run_attempt(self, attempt: int) -> dict:
+        """Launch one attempt and wait it out; -> {"rcs": {rank: rc},
+        "log_tails": {name: str}, "wall_secs": float}. A ``None`` rc
+        means the attempt timeout killed a straggler."""
+        t0 = time.monotonic()
+        timeout = self.cfg.attempt_timeout_secs or None
+        if self.cfg.processes > 1:
+            out = self._run_attempt_world(attempt, timeout)
+        else:
+            out = self._run_attempt_single(attempt, timeout)
+        out["wall_secs"] = time.monotonic() - t0
+        return out
+
+    def _run_attempt_single(self, attempt: int, timeout) -> dict:
+        log_dir = pathlib.Path(self.cfg.log_dir)
+        out_path = log_dir / f"attempt_{attempt:03d}.out"
+        err_path = log_dir / f"attempt_{attempt:03d}.err"
+        with open(out_path, "w") as out_f, open(err_path, "w") as err_f:
+            proc = subprocess.Popen(
+                [sys.executable, str(_REPO / "solve_launcher.py"),
+                 *self._solver_args()],
+                cwd=str(_REPO), env=self._attempt_env(attempt),
+                stdout=out_f, stderr=err_f,
+            )
+            self._child_pids.append(proc.pid)
+            try:
+                try:
+                    rc: Optional[int] = proc.wait(timeout=timeout)
+                except subprocess.TimeoutExpired:
+                    rc = None
+            finally:
+                self._child_pids.remove(proc.pid)
+                if proc.poll() is None:
+                    # Timeout — or an unwinding exception (Ctrl-C):
+                    # either way the attempt must not outlive its
+                    # supervisor.
+                    proc.kill()
+                    proc.wait()
+        return {
+            "rcs": {0: rc},
+            "log_tails": {
+                "attempt": _tail(err_path) + _tail(out_path),
+            },
+        }
+
+    def _run_attempt_world(self, attempt: int, timeout) -> dict:
+        # Lazy import: tools/ lives at the repo root, next to the
+        # package — resolvable from the package path without assuming
+        # the caller's cwd.
+        if str(_REPO) not in sys.path:
+            sys.path.insert(0, str(_REPO))
+        from tools.launch_multihost import start_world
+
+        env = self._attempt_env(attempt)
+        world = start_world(
+            self._solver_args(),
+            processes=self.cfg.processes,
+            log_dir=str(pathlib.Path(self.cfg.log_dir)
+                        / f"attempt_{attempt:03d}"),
+            env=env,
+            local_devices=self.cfg.local_devices,
+        )
+        self._child_pids.extend(world.pids())
+        results = None
+        try:
+            # timeout None = wait forever, same as the single-process
+            # path: the attempt-timeout knob is OFF by default and a
+            # hidden cap would reap multi-day world attempts.
+            results = world.wait(timeout)
+        finally:
+            for pid in world.pids():
+                if pid in self._child_pids:
+                    self._child_pids.remove(pid)
+            if results is None:
+                # An unwinding exception (Ctrl-C without the signal
+                # handlers, an OSError mid-wait): the ranks must not
+                # outlive their supervisor — same contract as the
+                # single-process path's finally.
+                world.send_signal(signal.SIGKILL)
+        return {
+            "rcs": {r.rank: r.returncode for r in results},
+            "log_tails": {
+                f"rank{r.rank}": r.stderr[-LOG_TAIL_BYTES:]
+                + r.stdout[-LOG_TAIL_BYTES:]
+                for r in results
+            },
+        }
+
+    # ------------------------------------------------------ classification
+
+    @staticmethod
+    def classify(rcs: Dict[int, Optional[int]], log_tails: dict) -> str:
+        """One word per death, for the ledger and the breaker."""
+        if all(rc == 0 for rc in rcs.values()):
+            return "complete"
+        tails = " ".join(log_tails.values())
+        if any(m in tails for m in ENOSPC_MARKERS):
+            return "enospc"
+        codes = set(rcs.values())
+        # Injected deaths first: in a mixed world (rank 0 SIGKILLed,
+        # peers exit 124 through the coordinated abort) the CAUSE is the
+        # kill, the 124s are its sympathetic shadow. Grace (75) likewise
+        # beats 124: a wedged rank force-exited, but the world was
+        # preempted.
+        if KILL_EXIT_CODE in codes:
+            return "killed"
+        if TORN_EXIT_CODE in codes:
+            return "torn_kill"
+        if GRACE_EXIT_CODE in codes:
+            return "preempted"
+        if WATCHDOG_EXIT_CODE in codes:
+            return "deadline_abort"
+        if None in codes:
+            return "timeout"
+        if any(rc is not None and rc < 0 for rc in codes):
+            return "signal"
+        return "crash"
+
+    # ------------------------------------------------------------- disk
+
+    def _free_mb(self) -> float:
+        return shutil.disk_usage(self.cfg.checkpoint_dir).free / (1 << 20)
+
+    def _gc(self, reason: str) -> dict:
+        """Retention GC on the (quiescent — no attempt is live) tree.
+        The jax-importing checkpointer loads HERE, not at module import:
+        a campaign that never needs GC never pays it."""
+        free_before = self._free_mb()
+        from gamesmanmpi_tpu.utils.checkpoint import LevelCheckpointer
+
+        ck = LevelCheckpointer(self.cfg.checkpoint_dir)
+        quarantined = ck.quarantine_inventory()
+        freed = ck.gc_superseded()
+        rec = {
+            "phase": "campaign_gc",
+            "reason": reason,
+            "freed_files": freed["files"],
+            "freed_bytes": freed["bytes"],
+            "kinds": freed["kinds"],
+            "quarantined": quarantined,
+            "free_mb_before": round(free_before, 1),
+            "free_mb_after": round(self._free_mb(), 1),
+        }
+        self.ledger.log(rec)
+        self.echo(
+            f"[campaign] gc ({reason}): freed {freed['files']} files / "
+            f"{freed['bytes']} bytes"
+        )
+        return freed
+
+    def _check_disk(self, had_enospc: bool) -> None:
+        """ENOSPC death, soft threshold, or hard floor -> retention GC
+        first; still under the floor after GC -> abort. The floor is
+        always evaluated AFTER a GC ran (the documented contract — an
+        operator setting only the floor still gets the reclaim pass
+        before the campaign gives up). Raises CampaignAborted."""
+        free = self._free_mb()
+        soft, floor = self.cfg.disk_soft_mb, self.cfg.disk_floor_mb
+        if had_enospc or (soft > 0 and free < soft) \
+                or (floor > 0 and free < floor):
+            reason = ("enospc" if had_enospc
+                      else "soft_threshold" if soft > 0 and free < soft
+                      else "hard_floor")
+            self._gc(reason)
+            free = self._free_mb()
+        if floor > 0 and free < floor:
+            raise CampaignAborted(
+                f"free disk {free:.1f} MiB under the hard floor "
+                f"{floor:.1f} MiB after retention GC",
+                DISK_FLOOR_EXIT_CODE,
+            )
+
+    # ---------------------------------------------------------- diagnosis
+
+    def _write_diagnosis(self, reason: str, attempt: int,
+                         last: Optional[dict]) -> str:
+        """The abort bundle: everything an operator needs to decide
+        what is wrong WITHOUT re-running — last checkpoint progress,
+        quarantine inventory, the final attempt's per-rank log tails."""
+        bundle = {
+            "reason": reason,
+            "attempts": attempt,
+            "checkpoint_dir": str(self.cfg.checkpoint_dir),
+            "progress": checkpoint_progress(self.cfg.checkpoint_dir),
+            "quarantine": [
+                {"file": p.name, "bytes": p.stat().st_size}
+                for p in sorted(
+                    pathlib.Path(self.cfg.checkpoint_dir).glob("*.corrupt")
+                )
+            ],
+            "log_tails": (last or {}).get("log_tails", {}),
+            "rcs": {str(k): v for k, v in (last or {}).get(
+                "rcs", {}).items()},
+        }
+        path = pathlib.Path(self.cfg.ledger_path).with_name(
+            "campaign_diagnosis.json"
+        )
+        path.write_text(json.dumps(bundle, indent=1, default=str))
+        return str(path)
+
+    # ---------------------------------------------------------------- run
+
+    def _backoff(self, consecutive_failures: int) -> float:
+        return min(
+            self.cfg.backoff_max_secs,
+            self.cfg.backoff_base_secs * (2 ** max(
+                0, consecutive_failures - 1
+            )),
+        )
+
+    def _sleep_backoff(self, secs: float) -> None:
+        deadline = time.monotonic() + secs
+        while not self._preempted and time.monotonic() < deadline:
+            time.sleep(min(0.2, max(0.0, deadline - time.monotonic())))
+
+    def run(self) -> int:
+        cfg = self.cfg
+        t0 = time.monotonic()
+        self.ledger.log({
+            "phase": "campaign_start",
+            "solver_args": self._solver_args(),
+            "processes": cfg.processes,
+            "max_attempts": cfg.max_attempts,
+            "no_progress_limit": cfg.no_progress_limit,
+            "chaos": cfg.chaos,
+        })
+        # One counter serves both the breaker (vs no_progress_limit)
+        # and the backoff curve: a failure that made progress resets
+        # both by definition.
+        no_progress = 0
+        last = None
+        attempt = 0
+        try:
+            while True:
+                if self._preempted:
+                    self.ledger.log({"phase": "campaign_preempted",
+                                     "attempts": attempt})
+                    self.echo("[campaign] preempted; rerun to continue")
+                    return GRACE_EXIT_CODE
+                self._check_disk(had_enospc=False)
+                attempt += 1
+                before = checkpoint_progress(cfg.checkpoint_dir)
+                self.echo(
+                    f"[campaign] attempt {attempt}/{cfg.max_attempts} "
+                    f"(resume level "
+                    f"{before['deepest_solved']}, "
+                    f"forward {before['forward_levels']})"
+                )
+                last = self._run_attempt(attempt)
+                cause = self.classify(last["rcs"], last["log_tails"])
+                after = checkpoint_progress(cfg.checkpoint_dir)
+                progressed = progress_score(after) > progress_score(before)
+                self.ledger.log({
+                    "phase": "campaign_attempt",
+                    "attempt": attempt,
+                    "rcs": {str(k): v for k, v in last["rcs"].items()},
+                    "cause": cause,
+                    "wall_secs": round(last["wall_secs"], 3),
+                    "resume_level": before["deepest_solved"],
+                    "progressed": progressed,
+                    "solved_before": len(before["solved_levels"]),
+                    "solved_after": len(after["solved_levels"]),
+                    "forward_after": after["forward_levels"],
+                })
+                if cause == "complete":
+                    self.ledger.log({
+                        "phase": "campaign_done",
+                        "attempts": attempt,
+                        "wall_secs": round(time.monotonic() - t0, 3),
+                    })
+                    self.echo(
+                        f"[campaign] solved after {attempt} attempt(s)"
+                    )
+                    return 0
+                self.echo(
+                    f"[campaign] attempt {attempt} died: {cause} "
+                    f"rcs={last['rcs']} progressed={progressed}"
+                )
+                if self._preempted:
+                    # The SIGTERM was ours, forwarded: the attempt
+                    # drained (exit 75) — this is a campaign preemption,
+                    # not a failure the breaker should count.
+                    self.ledger.log({"phase": "campaign_preempted",
+                                     "attempts": attempt})
+                    self.echo("[campaign] preempted; rerun to continue")
+                    return GRACE_EXIT_CODE
+                if cause == "enospc":
+                    self._check_disk(had_enospc=True)
+                if progressed:
+                    no_progress = 0
+                else:
+                    no_progress += 1
+                if no_progress >= cfg.no_progress_limit:
+                    raise CampaignAborted(
+                        f"{no_progress} consecutive attempts died "
+                        f"(last cause: {cause}) without sealing "
+                        "anything new",
+                        NO_PROGRESS_EXIT_CODE,
+                    )
+                if attempt >= cfg.max_attempts and not progressed:
+                    # The budget bounds FLAPPING, not work: an attempt
+                    # that sealed something new is the campaign doing
+                    # its job (a multi-day 7x6 run may legitimately eat
+                    # dozens of preemptions), so only a budget-exhausted
+                    # NON-progressing attempt aborts here — the breaker
+                    # above already catches sustained no-progress sooner.
+                    raise CampaignAborted(
+                        f"attempt budget exhausted "
+                        f"({cfg.max_attempts}; last cause: {cause})",
+                        NO_PROGRESS_EXIT_CODE,
+                    )
+                backoff = self._backoff(max(no_progress, 1))
+                self.ledger.log({"phase": "campaign_backoff",
+                                 "secs": round(backoff, 3)})
+                self._sleep_backoff(backoff)
+        except CampaignAborted as e:
+            bundle = self._write_diagnosis(str(e), attempt, last)
+            self.ledger.log({
+                "phase": "campaign_abort",
+                "reason": str(e),
+                "code": e.code,
+                "attempts": attempt,
+                "diagnosis": bundle,
+                "wall_secs": round(time.monotonic() - t0, 3),
+            })
+            self.echo(f"[campaign] ABORT: {e} (diagnosis: {bundle})")
+            return e.code
+
+
+def _tail(path, nbytes: int = LOG_TAIL_BYTES) -> str:
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            size = fh.tell()
+            fh.seek(max(0, size - nbytes))
+            return fh.read().decode(errors="replace")
+    except OSError:
+        return ""

@@ -27,7 +27,12 @@ Grammar (``GAMESMAN_FAULTS``, comma-separated directives)::
   - ``torn`` — truncate the file the call site is writing (the
     ``path=`` context) to half its bytes, then ``os._exit(86)``: a torn
     write followed by death, the silent-bit-rot shape the checkpoint
-    crc catches.
+    crc catches;
+  - ``enospc`` — raise ``OSError(ENOSPC)``, the disk-full shape: never
+    transient (retrying a full disk fills it again), so the solve fails
+    fast with the checkpoint prefix intact — exactly a torn write's
+    degrade path — and the campaign supervisor answers with
+    GC-and-retry (resilience/campaign.py).
 
 * ``when`` — which visit fires (the schedule, always replayable):
 
@@ -156,7 +161,8 @@ def _parse_directive(text: str) -> _Directive:
             + ", ".join(sorted(KNOWN_POINTS))
         )
     kind, _, argtxt = parts[1].strip().partition("=")
-    if kind not in ("transient", "fatal", "delay", "kill", "torn"):
+    if kind not in ("transient", "fatal", "delay", "kill", "torn",
+                    "enospc"):
         raise ValueError(f"unknown fault kind {kind!r} in {text!r}")
     arg = float(argtxt) if argtxt else None
     when = _parse_when(parts[2].strip()) if len(parts) == 3 else 1
@@ -206,6 +212,14 @@ def _inject(d: _Directive, point: str, path, ctx: dict) -> None:
     if d.kind == "delay":
         time.sleep(d.arg if d.arg is not None else 0.05)
         return
+    if d.kind == "enospc":
+        import errno
+
+        raise OSError(
+            errno.ENOSPC,
+            f"No space left on device (injected at {where})",
+            str(path) if path is not None else None,
+        )
     if d.kind == "torn":
         if path is not None and os.path.exists(path):
             size = os.path.getsize(path)

@@ -1,0 +1,168 @@
+"""Preemption grace: SIGTERM/SIGUSR1 -> seal-what's-complete -> exit 75.
+
+The campaign regime (resilience/campaign.py, ROADMAP item 1) runs on
+preemptible capacity: the scheduler's SIGTERM arrives mid-level with a
+short eviction window, and the difference between "resume from level k"
+and "re-discover three hours of frontier" is whether the solver spends
+that window sealing what is already complete. This module is the
+solver-side half of that contract:
+
+* the CLI installs :func:`install_grace_handler` around a solve —
+  SIGTERM/SIGUSR1 set a flag (a plain attribute store: CPython runs
+  handlers on the main thread, so a handler that took a lock could
+  deadlock against the very code it interrupted — the GM205 rule) and
+  arm a one-shot grace deadline;
+* the engines call :func:`check` at every level boundary (and the
+  sharded solver folds the check into a rank-coordinated epoch round,
+  so every rank raises at the SAME program point);
+* :class:`PreemptionRequested` unwinds through the solve's ``finally``
+  blocks — pending pipelined seals flush, the write-behind queue
+  drains — and the CLI exits :data:`GRACE_EXIT_CODE` (75, EX_TEMPFAIL:
+  "resumable, try again"), which the campaign supervisor classifies as
+  a clean preemption;
+* if the solve thread is wedged (inside a collective, a compile) and
+  never reaches a boundary, the grace deadline
+  (``GAMESMAN_PREEMPT_GRACE_SECS``, default 30) force-exits 124 — the
+  watchdog's resumable-abort code. Either way the tree is never torn:
+  every payload write is tmp+os.replace and every seal is an atomic
+  manifest replace, so the worst case is an unsealed stray that resume
+  already ignores.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Optional
+
+from gamesmanmpi_tpu.utils.env import env_float
+
+#: EX_TEMPFAIL: the solve exited resumable under preemption grace. The
+#: campaign supervisor (and any process manager) reads this as "restart
+#: me against the same checkpoint directory".
+GRACE_EXIT_CODE = 75
+
+#: Signals that request graceful preemption (SIGUSR1 is the spelling for
+#: schedulers that reserve SIGTERM for the hard kill).
+GRACE_SIGNALS = (signal.SIGTERM, signal.SIGUSR1)
+
+
+class PreemptionRequested(Exception):
+    """Raised at a level boundary after a grace signal: the solve must
+    stop here, with everything complete-so-far sealed. Deliberately NOT
+    transient (resilience.retry) — retrying a preemption defeats it."""
+
+
+#: Module state, written only by the signal handler (main thread) and
+#: read by the level-boundary checks. Plain attribute stores — atomic
+#: under the GIL, and the handler must stay lock-free (GM205).
+_requested = False
+_signum: Optional[int] = None
+_deadline_timer: Optional[threading.Timer] = None
+
+
+def requested() -> bool:
+    """Has a grace signal arrived? (One falsy check per level boundary.)"""
+    return _requested
+
+
+def reset() -> None:
+    """Clear the flag and disarm the deadline (tests; and the CLI's
+    handler-restore path, so a later programmatic solve in the same
+    process does not inherit a stale preemption)."""
+    global _requested, _signum, _deadline_timer
+    _requested = False
+    _signum = None
+    t = _deadline_timer
+    _deadline_timer = None
+    if t is not None:
+        t.cancel()
+
+
+def _force_exit(grace_secs: float) -> None:  # pragma: no cover - kills
+    # The solve thread never reached a boundary inside the grace window
+    # — wedged in a collective or a compile. Exit 124 (the watchdog's
+    # resumable-abort code): atomic writes mean the tree is still
+    # consistent, just without this level's seal.
+    sys.stderr.write(
+        f"[preempt] grace deadline ({grace_secs:.0f}s) expired before a "
+        "level boundary; forcing resumable abort\n"
+    )
+    sys.stderr.flush()
+    from gamesmanmpi_tpu.resilience.supervisor import WATCHDOG_EXIT_CODE
+
+    os._exit(WATCHDOG_EXIT_CODE)
+
+
+def _on_grace_signal(signum, frame) -> None:
+    # Lock-free by contract (GM205): attribute stores and a daemon-timer
+    # spawn only. Re-delivery while already draining is a no-op (the
+    # first deadline stands — a scheduler often re-signals).
+    global _requested, _signum, _deadline_timer
+    if _requested:
+        return
+    _requested = True
+    _signum = signum
+    grace = env_float("GAMESMAN_PREEMPT_GRACE_SECS", 30.0)
+    sys.stderr.write(
+        f"[preempt] signal {signum}: draining to the next level boundary "
+        f"(grace {grace:.0f}s)\n"
+    )
+    sys.stderr.flush()
+    if grace > 0:
+        t = threading.Timer(grace, _force_exit, args=(grace,))
+        t.daemon = True
+        t.start()
+        _deadline_timer = t
+
+
+def install_grace_handler():
+    """Install the grace handlers for a solve; returns a zero-arg
+    restore callable (also disarms any pending deadline). No-op (restore
+    still returned) when not on the main thread — programmatic solves in
+    worker threads keep their host application's signal setup."""
+    previous = {}
+    for sig in GRACE_SIGNALS:
+        try:
+            previous[sig] = signal.signal(sig, _on_grace_signal)
+        except ValueError:  # not the main thread
+            pass
+
+    def restore():
+        for sig, handler in previous.items():
+            signal.signal(sig, handler)
+        reset()
+
+    return restore
+
+
+def check(phase: str, level=None, logger=None) -> None:
+    """Level-boundary preemption point: raise :class:`PreemptionRequested`
+    when a grace signal has arrived. Called by the engines wherever
+    ``progress`` is replaced — the one program point where everything
+    before it is sealed or sealable by the solve's ``finally``."""
+    if not _requested:
+        return
+    from gamesmanmpi_tpu.obs import default_registry
+
+    default_registry().counter(
+        "gamesman_preempts_total",
+        "solves stopped at a level boundary by preemption grace",
+        phase=phase,
+    ).inc()
+    rec = {"phase": "preempt", "in_phase": phase,
+           "signum": _signum, "wall_time": time.time()}
+    if level is not None:
+        rec["level"] = int(level)
+    if logger is not None:
+        try:
+            logger.log(rec)
+        except Exception:  # noqa: BLE001 - the preemption must win
+            pass
+    raise PreemptionRequested(
+        f"grace signal {_signum} at {phase} boundary"
+        + (f" (level {level})" if level is not None else "")
+    )

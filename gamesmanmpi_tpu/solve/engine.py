@@ -81,6 +81,7 @@ from gamesmanmpi_tpu.ops.provenance import dedup_provenance, gather_cells
 from gamesmanmpi_tpu.ops.padding import MIN_BUCKET, bucket_size, pad_to, pad_to_bucket
 from gamesmanmpi_tpu.obs import Heartbeat, Span, default_registry, trace_span
 from gamesmanmpi_tpu.resilience import faults
+from gamesmanmpi_tpu.resilience import preempt
 from gamesmanmpi_tpu.resilience.retry import retry_call
 from gamesmanmpi_tpu.resilience.supervisor import maybe_watchdog
 from gamesmanmpi_tpu.solve.precompile import global_precompiler, sds
@@ -891,6 +892,10 @@ class Solver:
             self.progress = {
                 "phase": "forward", "level": k, "frontier": levels[k].n,
             }
+            # Level boundary: everything before this level is saved
+            # (save_frontier_level is eager), so a grace signal stops
+            # HERE and the next run resumes expansion from level k.
+            preempt.check("forward", level=k, logger=self.logger)
             cap = frontier.shape[0]
             spec = spec_input = None
             if speculate:
@@ -1074,6 +1079,7 @@ class Solver:
             rec = levels[k]
             n = rec.n
             self.progress = {"phase": "backward", "level": k, "n": n}
+            preempt.check("backward", level=k, logger=self.logger)
             C = common[k]
             if rec.dev is not None:
                 states_dev = rec.dev
@@ -1237,6 +1243,7 @@ class Solver:
                 "phase": "forward", "level": k,
                 "frontier": int(frontier.shape[0]),
             }
+            preempt.check("forward", level=k, logger=self.logger)
             padded = pad_to_bucket(frontier, self.min_bucket)
             uniq, levels, count = self._fwd_generic(padded.shape[0])(
                 jnp.asarray(padded)
@@ -1315,6 +1322,7 @@ class Solver:
             padded = pad_to_bucket(states, self.min_bucket)
             n = states.shape[0]
             self.progress = {"phase": "backward", "level": k, "n": int(n)}
+            preempt.check("backward", level=k, logger=self.logger)
             from_checkpoint = k in completed
             lvl_sort_bytes = lvl_gather_bytes = 0
             table = None

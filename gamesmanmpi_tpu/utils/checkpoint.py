@@ -857,6 +857,192 @@ class LevelCheckpointer:
                 out.setdefault(k, [None] * num_shards)[s] = arr
         return out
 
+    # ------------------------------------------- disk budget (ISSUE 12)
+    # The campaign regime's third failure class is disk exhaustion: at
+    # 7x6 scale the checkpoint tree is the largest thing on the volume,
+    # and a multi-day run accretes superseded artifacts — quarantined
+    # .corrupt files, per-writer .tmp strays from deaths, unsealed shard
+    # files resume ignores, and edge shards whose level has already been
+    # resolved AND sealed (the backward's structural per-level fallback
+    # to the lookup join makes deleting them safe). disk_usage() feeds
+    # the gamesman_ckpt_bytes{kind} gauges; gc_superseded() reclaims the
+    # superseded classes so ENOSPC becomes pause -> GC -> retry
+    # (resilience/campaign.py) instead of a dead campaign.
+
+    #: filename-prefix -> kind for the disk gauges and the GC scan.
+    _KIND_PREFIXES = (
+        ("level_", "level"),
+        ("frontier", "frontier"),  # frontier_*, frontiers.npz, shards
+        ("edges_", "edges"),
+        ("dense_", "dense"),
+    )
+
+    @classmethod
+    def artifact_kind(cls, name: str) -> str:
+        """Classify one checkpoint-tree filename for the disk gauges:
+        ``corrupt`` and ``tmp`` beat the payload prefixes (a quarantined
+        level is reclaimable, a sealed one is not)."""
+        if name == "manifest.json":
+            return "manifest"
+        if name.endswith(".corrupt"):
+            return "corrupt"
+        if ".tmp" in name:
+            return "tmp"
+        for prefix, kind in cls._KIND_PREFIXES:
+            if name.startswith(prefix):
+                return kind
+        return "other"
+
+    def disk_usage(self, registry=None) -> dict:
+        """Bytes on disk per artifact kind, published as the
+        ``gamesman_ckpt_bytes{kind=...}`` gauges (every kind is always
+        set, so a GC'd kind reads 0 instead of a stale gauge)."""
+        usage = {kind: 0 for _, kind in self._KIND_PREFIXES}
+        usage.update({"manifest": 0, "corrupt": 0, "tmp": 0, "other": 0})
+        try:
+            entries = list(os.scandir(self.dir))
+        except OSError:
+            entries = []
+        for entry in entries:
+            try:
+                if not entry.is_file():
+                    continue
+                usage[self.artifact_kind(entry.name)] += (
+                    entry.stat().st_size
+                )
+            except OSError:
+                continue  # racing unlink (another rank's quarantine)
+        if registry is None:
+            from gamesmanmpi_tpu.obs import default_registry
+
+            registry = default_registry()
+        for kind, nbytes in usage.items():
+            registry.gauge(
+                "gamesman_ckpt_bytes",
+                "checkpoint-tree bytes on disk by artifact kind",
+                kind=kind,
+            ).set(float(nbytes))
+        return usage
+
+    def quarantine_inventory(self) -> list:
+        """[{"file", "bytes"}] of quarantined ``.corrupt`` artifacts —
+        the campaign's diagnosis bundle snapshots this BEFORE a GC
+        deletes the evidence."""
+        out = []
+        for p in sorted(self.dir.glob("*.corrupt")):
+            try:
+                out.append({"file": p.name, "bytes": p.stat().st_size})
+            except OSError:
+                continue
+        return out
+
+    def referenced_files(self, manifest=None) -> set:
+        """Filenames the manifest currently seals (the NOT-superseded
+        set). Anything else matching an artifact prefix is a stray a
+        death left behind — resume already ignores it on disk, GC may
+        reclaim it."""
+        if manifest is None:
+            manifest = self.load_manifest()
+        ref = {"manifest.json"}
+        for k in manifest.get("levels", []):
+            ref.add(f"level_{int(k):04d}.npz")
+        for k, num in manifest.get("sharded_levels", {}).items():
+            for s in range(int(num)):
+                ref.add(f"level_{int(k):04d}.shard_{s:04d}.npz")
+        for k in manifest.get("forward_levels", []):
+            ref.add(f"frontier_{int(k):04d}.npz")
+        for k, num in manifest.get("forward_level_shards", {}).items():
+            for s in range(int(num)):
+                ref.add(f"frontier_{int(k):04d}.shard_{s:04d}.npz")
+        if manifest.get("frontiers"):
+            ref.add("frontiers.npz")
+        for s in range(int(manifest.get("frontier_shards") or 0)):
+            ref.add(f"frontiers.shard_{s:04d}.npz")
+        for k, info in manifest.get("edge_levels", {}).items():
+            for s in range(int(info.get("shards", 0))):
+                ref.add(f"edges_{int(k):04d}.shard_{s:04d}.npz")
+        for k in manifest.get("dense_levels", []):
+            ref.add(f"dense_{int(k):04d}.npz")
+        return ref
+
+    def gc_superseded(self, logger=None, registry=None) -> dict:
+        """Reclaim superseded checkpoint artifacts; -> {"files",
+        "bytes", "kinds": {kind: bytes}}.
+
+        Reclaimed classes, in order:
+
+        * **consumed edges** — edge shards of levels sealed solved: the
+          backward that needed them already ran, and a future resume of
+          a re-quarantined level falls back to the lookup join (the
+          structural per-level fallback), so these are pure cache. The
+          manifest unseals them FIRST, files unlink second — a death in
+          between leaves orphans the next GC collects, never sealed
+          entries pointing at deleted files;
+        * **quarantine** — ``.corrupt`` files (superseded the moment the
+          level re-sealed over them; snapshot quarantine_inventory()
+          first if the forensics matter);
+        * **tmp strays** — dead writers' per-pid temp files;
+        * **unreferenced artifacts** — level/frontier/edge/dense files
+          the manifest does not seal (unsealed write-behind strays,
+          post-consolidation orphans).
+
+        Contract: a QUIESCENT tree — call between attempts (the
+        campaign supervisor's use) or from the solve thread of the only
+        live solver. The write-behind queue is drained first so an
+        in-flight payload whose seal has not run yet is never read as a
+        stray mid-write (the store-ticket/seal-ordering invariant).
+        """
+        self.flush_writes()
+        manifest = self.load_manifest()
+        solved = set(int(k) for k in manifest.get("levels", []))
+        solved |= {int(k) for k in manifest.get("sharded_levels", {})}
+        consumed = {
+            k: int(info.get("shards", 0))
+            for k, info in manifest.get("edge_levels", {}).items()
+            if int(k) in solved
+        }
+        if consumed:
+            for k in consumed:
+                manifest.get("edge_levels", {}).pop(k, None)
+                manifest.get("edge_seals", {}).pop(k, None)
+            self._write_manifest(manifest)
+        freed = {"files": 0, "bytes": 0, "kinds": {}}
+
+        def reclaim(path: pathlib.Path, kind: str) -> None:
+            try:
+                nbytes = path.stat().st_size
+                path.unlink()
+            except OSError:
+                return  # racing unlink / already gone
+            freed["files"] += 1
+            freed["bytes"] += nbytes
+            freed["kinds"][kind] = freed["kinds"].get(kind, 0) + nbytes
+
+        for k, shards in consumed.items():
+            for s in range(shards):
+                reclaim(self._edges_path(int(k), s), "edges")
+        referenced = self.referenced_files(manifest)
+        for p in sorted(self.dir.iterdir()):
+            if not p.is_file() or p.name in referenced:
+                continue
+            kind = self.artifact_kind(p.name)
+            if kind != "other":  # unknown files are never GC fodder
+                reclaim(p, kind)
+        if registry is None:
+            from gamesmanmpi_tpu.obs import default_registry
+
+            registry = default_registry()
+        registry.counter(
+            "gamesman_ckpt_gc_reclaimed_bytes_total",
+            "checkpoint bytes reclaimed by retention GC",
+        ).inc(float(freed["bytes"]))
+        if logger is not None:
+            logger.log({"phase": "ckpt_gc", **{
+                k: v for k, v in freed.items() if k != "kinds"
+            }, "kinds": dict(freed["kinds"])})
+        self.disk_usage(registry=registry)  # refresh the gauges post-GC
+        return freed
+
     # Forward-phase snapshot: all per-level frontiers after discovery, so a
     # restarted solve skips the whole forward sweep (restart-from-level,
     # SURVEY.md §5.4 — the backward phase then loads completed levels).

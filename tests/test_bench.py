@@ -160,6 +160,36 @@ def test_bench_serve_slo_artifact(tmp_path):
 
 
 @pytest.mark.slow
+def test_bench_campaign_artifact(tmp_path):
+    """BENCH_CAMPAIGN=1 (ISSUE 12): the bench additionally drives a
+    chaos campaign — injected kills at forward/backward/write-behind,
+    auto-resumed to completion by tools/run_campaign.py — and gates on
+    byte-parity vs an uninterrupted solve. Single-process tiny config
+    here; the committed artifacts/CAMPAIGN_r12.json is the 2-process
+    5x4 acceptance run of the same code path."""
+    out = tmp_path / "BENCH_campaign.json"
+    record, _ = _run_bench({
+        "BENCH_ENGINE": "classic",
+        "BENCH_CAMPAIGN": "1",
+        "BENCH_CAMPAIGN_GAME": "connect4:w=3,h=3,connect=3",
+        "BENCH_CAMPAIGN_PROCESSES": "1",
+        "BENCH_CAMPAIGN_SHARDS": "2",
+        "BENCH_CAMPAIGN_OUT": str(out),
+    }, timeout=900)
+    cb = record["campaign"]
+    artifact = json.loads(out.read_text())
+    assert cb["ok"] is True, artifact.get("error")
+    assert cb["chaos_ok"] is True
+    assert cb["parity_ok"] is True
+    assert cb["attempts"] == 4
+    assert cb["causes"] == ["killed"] * 3 + ["complete"]
+    # The artifact carries the whole ledger: every attempt auditable.
+    phases = [r.get("phase") for r in artifact["ledger"]]
+    assert phases.count("campaign_attempt") == 4
+    assert phases[-1] == "campaign_done"
+
+
+@pytest.mark.slow
 def test_bench_db_compress_artifact(tmp_path):
     """BENCH_DB_COMPRESS=1 (ISSUE 9): the bench additionally solves a
     board once, exports it v1 AND block-compressed v2, proves the two

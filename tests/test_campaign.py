@@ -1,0 +1,467 @@
+"""Self-healing solve campaigns (ISSUE 12): auto-resume supervision,
+no-progress breaker, disk-budget degradation, append-only ledger.
+
+Acceptance axes:
+
+* entrypoint smoke (tier-1) — ``tools/run_campaign.py --help`` exits 0
+  and a 1-attempt trivial campaign (ttt, no faults) completes with a
+  well-formed ledger, so the campaign CLI can never silently rot;
+* chaos campaign — a sharded solve killed at distinct points (forward,
+  backward, mid-write-behind) is driven to byte-parity completion with
+  zero operator input, every attempt on the ledger;
+* breaker — attempts that seal nothing trip the no-progress breaker
+  into a clean abort with a diagnosis bundle;
+* disk budget — an ``enospc``-classified death triggers retention GC
+  and a retry; the hard floor aborts cleanly, prefix intact.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from gamesmanmpi_tpu.games import get_game
+from gamesmanmpi_tpu.resilience import faults
+from gamesmanmpi_tpu.resilience.campaign import (
+    DISK_FLOOR_EXIT_CODE,
+    NO_PROGRESS_EXIT_CODE,
+    Campaign,
+    checkpoint_progress,
+    progress_score,
+)
+from gamesmanmpi_tpu.resilience.faults import KILL_EXIT_CODE
+from gamesmanmpi_tpu.utils.checkpoint import (
+    LevelCheckpointer,
+    _loadz,
+    save_result_npz,
+)
+
+from helpers import REPO, full_table
+
+_CAMPAIGN = [sys.executable, os.path.join(REPO, "tools", "run_campaign.py")]
+_C3 = "connect4:w=3,h=3,connect=3"
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _run_campaign(args, extra_env=None, timeout=900):
+    env = dict(os.environ)
+    env["GAMESMAN_PLATFORM"] = "cpu"
+    env.pop("GAMESMAN_FAULTS", None)
+    # Fast inter-attempt backoff: the tests assert policy, not patience.
+    env.setdefault("GAMESMAN_CAMPAIGN_BACKOFF_BASE_SECS", "0.05")
+    env.update(extra_env or {})
+    return subprocess.run(
+        _CAMPAIGN + list(args), capture_output=True, text=True,
+        timeout=timeout, env=env, cwd=str(REPO),
+    )
+
+
+def _ledger(path):
+    records = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return records
+
+
+def _phases(records):
+    return [r.get("phase") for r in records]
+
+
+def _assert_tables_equal(a, b):
+    with _loadz(a) as za, _loadz(b) as zb:
+        assert sorted(za.files) == sorted(zb.files)
+        for f in za.files:
+            assert np.array_equal(za[f], zb[f]), f
+
+
+# ----------------------------------------------------------- tier-1 smoke
+
+
+def test_run_campaign_help_exits_zero():
+    """The entrypoint can never silently rot: --help must exit 0 (and
+    without importing jax — the supervisor stays instant)."""
+    out = subprocess.run(
+        _CAMPAIGN + ["--help"], capture_output=True, text=True,
+        timeout=60, cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr
+    assert "--checkpoint-dir" in out.stdout
+    assert "--chaos" in out.stdout
+
+
+def test_run_campaign_usage_errors():
+    out = subprocess.run(
+        _CAMPAIGN + ["tictactoe"], capture_output=True, text=True,
+        timeout=60, cwd=str(REPO),
+    )
+    assert out.returncode == 2  # --checkpoint-dir is required
+    out = _run_campaign(
+        ["tictactoe", "--checkpoint-dir", "/tmp/x", "--processes", "0"]
+    )
+    assert out.returncode == 2
+    out = _run_campaign(
+        ["tictactoe", "--checkpoint-dir", "/tmp/x", "--",
+         "--checkpoint-dir", "/tmp/y"]
+    )
+    assert out.returncode == 2  # the campaign owns the checkpoint flag
+
+
+def test_trivial_ttt_campaign_completes_with_well_formed_ledger(tmp_path):
+    """The tier-1 acceptance smoke: one clean attempt, rc 0, every
+    ledger record shaped as documented."""
+    ck = tmp_path / "ck"
+    out = _run_campaign(
+        ["tictactoe", "--checkpoint-dir", str(ck), "--max-attempts", "1"]
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    records = _ledger(ck / "campaign.jsonl")
+    assert _phases(records) == [
+        "campaign_start", "campaign_attempt", "campaign_done"
+    ]
+    start, attempt, done = records
+    assert start["solver_args"][0] == "tictactoe"
+    assert attempt["attempt"] == 1
+    assert attempt["cause"] == "complete"
+    assert attempt["rcs"] == {"0": 0}
+    assert attempt["progressed"] is True
+    assert attempt["wall_secs"] > 0
+    assert done["attempts"] == 1
+    assert all("wall_time" in r for r in records)
+    # The checkpoint really solved: the manifest seals levels.
+    progress = checkpoint_progress(ck)
+    assert progress["solved_levels"] and progress["frontiers_complete"]
+
+
+def test_disk_floor_aborts_cleanly_before_burning_attempts(tmp_path):
+    """Hard floor: free space below an absurd floor (and nothing to GC)
+    aborts with exit 4 + diagnosis bundle, without launching a solve."""
+    ck = tmp_path / "ck"
+    out = _run_campaign(
+        ["tictactoe", "--checkpoint-dir", str(ck),
+         "--disk-floor-mb", str(10 ** 9)],
+    )
+    assert out.returncode == DISK_FLOOR_EXIT_CODE, out.stderr[-2000:]
+    records = _ledger(ck / "campaign.jsonl")
+    assert "campaign_attempt" not in _phases(records)
+    abort = records[-1]
+    assert abort["phase"] == "campaign_abort"
+    assert abort["code"] == DISK_FLOOR_EXIT_CODE
+    assert (ck / "campaign_diagnosis.json").exists()
+
+
+# ---------------------------------------------------- progress + classify
+
+
+def test_progress_score_monotone_across_consolidation():
+    """The forward->backward seam: consolidating the frontier snapshot
+    DELETES the per-level forward seals it supersedes — the score must
+    still strictly increase (lexicographic by phase)."""
+    forward_mid = {"solved_levels": [], "forward_levels": 5,
+                   "frontiers_complete": False, "dense_levels": 0}
+    forward_more = dict(forward_mid, forward_levels=7)
+    consolidated = {"solved_levels": [], "forward_levels": 0,
+                    "frontiers_complete": True, "dense_levels": 0}
+    backward_mid = dict(consolidated, solved_levels=[9, 8])
+    assert progress_score(forward_more) > progress_score(forward_mid)
+    assert progress_score(consolidated) > progress_score(forward_more)
+    assert progress_score(backward_mid) > progress_score(consolidated)
+    # Quarantine (a solved level unsealed) reads as regression.
+    quarantined = dict(backward_mid, solved_levels=[9])
+    assert progress_score(quarantined) < progress_score(backward_mid)
+
+
+def test_checkpoint_progress_tolerates_missing_and_torn_manifest(tmp_path):
+    p = checkpoint_progress(tmp_path / "nope")
+    assert p["solved_levels"] == [] and p["deepest_solved"] is None
+    d = tmp_path / "torn"
+    d.mkdir()
+    (d / "manifest.json").write_text('{"levels": [1, 2')
+    assert checkpoint_progress(d)["solved_levels"] == []
+
+
+def test_classify_causes():
+    c = Campaign.classify
+    assert c({0: 0, 1: 0}, {}) == "complete"
+    assert c({0: 77, 1: 124}, {}) == "killed"
+    assert c({0: 86}, {}) == "torn_kill"
+    assert c({0: 75, 1: 124}, {}) == "preempted"
+    assert c({0: 124, 1: 124}, {}) == "deadline_abort"
+    assert c({0: 1}, {"a": "OSError: [Errno 28] No space left on device"}) \
+        == "enospc"
+    assert c({0: None}, {}) == "timeout"
+    assert c({0: -9}, {}) == "signal"
+    assert c({0: 1}, {"a": "traceback"}) == "crash"
+
+
+# ------------------------------------------------- retention GC (tier-1)
+
+
+def test_gc_superseded_consumed_edges_and_strays_resume_parity(tmp_path):
+    """A partially-backward sharded checkpoint: GC reclaims the solved
+    levels' consumed edge shards (+ planted corrupt/tmp/stray files),
+    keeps unsolved levels' edges, and the resumed solve still reaches
+    parity (the per-level lookup fallback covers GC'd edges even if a
+    level re-quarantines)."""
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+
+    clean = ShardedSolver(get_game(_C3), num_shards=2).solve()
+    ck_dir = tmp_path / "ck"
+    ck = LevelCheckpointer(ck_dir)
+    faults.configure("sharded.backward:fatal:3")
+    with pytest.raises(faults.FatalFault):
+        ShardedSolver(get_game(_C3), num_shards=2, checkpointer=ck).solve()
+    faults.clear()
+    manifest = ck.load_manifest()
+    solved = {int(k) for k in manifest.get("sharded_levels", {})}
+    edges = {int(k) for k in manifest.get("edge_levels", {})}
+    consumed = solved & edges
+    assert consumed, "fixture: no solved level still holds edges"
+    assert edges - solved, "fixture: no unsolved level holds edges"
+    # Plant every superseded class the GC claims to reclaim.
+    (ck_dir / "level_0099.npz.corrupt").write_bytes(b"x" * 64)
+    (ck_dir / "frontier_0042.shard_0000.npz").write_bytes(b"y" * 64)
+    (ck_dir / f"level_0001.{os.getpid()}.tmp.npz").write_bytes(b"z")
+    freed = ck.gc_superseded()
+    assert freed["files"] >= len(consumed) * 2 + 3
+    assert freed["bytes"] > 0
+    assert set(freed["kinds"]) >= {"edges", "corrupt", "frontier", "tmp"}
+    after = ck.load_manifest()
+    assert not (solved & {int(k) for k in after.get("edge_levels", {})})
+    # Unsolved levels keep their sealed edges (still needed).
+    assert {int(k) for k in after.get("edge_levels", {})} == edges - solved
+    assert not list(ck_dir.glob("*.corrupt"))
+    assert not list(ck_dir.glob("*.tmp.npz"))
+    for k in consumed:
+        assert not list(ck_dir.glob(f"edges_{k:04d}.*"))
+    resumed = ShardedSolver(
+        get_game(_C3), num_shards=2, checkpointer=LevelCheckpointer(ck_dir)
+    ).solve()
+    assert full_table(resumed) == full_table(clean)
+
+
+def test_disk_usage_kinds_and_gauges(tmp_path):
+    from gamesmanmpi_tpu.obs import MetricsRegistry
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+
+    ck_dir = tmp_path / "ck"
+    ck = LevelCheckpointer(ck_dir)
+    ShardedSolver(get_game(_C3), num_shards=2, checkpointer=ck).solve()
+    reg = MetricsRegistry()
+    usage = ck.disk_usage(registry=reg)
+    assert usage["level"] > 0
+    assert usage["manifest"] > 0
+    assert usage["corrupt"] == 0
+    snap = reg.snapshot()
+    kinds = {
+        row["labels"]["kind"]: row["value"]
+        for row in snap["gamesman_ckpt_bytes"]["values"]
+    }
+    assert kinds["level"] == usage["level"]
+    assert kinds["tmp"] == 0.0  # every kind always set, GC'd kinds read 0
+
+
+def test_artifact_kind_classification():
+    k = LevelCheckpointer.artifact_kind
+    assert k("manifest.json") == "manifest"
+    assert k("level_0004.npz") == "level"
+    assert k("level_0004.shard_0001.npz") == "level"
+    assert k("frontier_0003.npz") == "frontier"
+    assert k("frontiers.shard_0000.npz") == "frontier"
+    assert k("edges_0002.shard_0000.npz") == "edges"
+    assert k("dense_0001.npz") == "dense"
+    assert k("level_0004.npz.corrupt") == "corrupt"
+    assert k("level_0004.12345.tmp.npz") == "tmp"
+    assert k("campaign.jsonl") == "other"
+
+
+# ----------------------------------------------------- chaos (slow, subproc)
+
+
+@pytest.mark.slow
+def test_campaign_kill_chaos_driven_to_byte_parity(tmp_path):
+    """The acceptance core, at test scale: a sharded solve SIGKILLed at
+    three distinct points — forward, backward, mid-write-behind — is
+    driven to byte-parity completion with zero operator input, the
+    ledger recording every attempt."""
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+
+    golden = tmp_path / "golden.npz"
+    save_result_npz(
+        golden, ShardedSolver(get_game(_C3), num_shards=2).solve()
+    )
+    ck = tmp_path / "ck"
+    out_table = tmp_path / "resumed.npz"
+    out = _run_campaign([
+        _C3, "--checkpoint-dir", str(ck),
+        "--chaos", "sharded.forward:kill:3",
+        "--chaos", "sharded.backward:kill:2",
+        "--chaos", "store.writebehind:kill:1",
+        "--", "--devices", "2", "--table-out", str(out_table),
+    ])
+    assert out.returncode == 0, out.stderr[-3000:]
+    records = _ledger(ck / "campaign.jsonl")
+    attempts = [r for r in records if r["phase"] == "campaign_attempt"]
+    assert len(attempts) == 4  # 3 injected deaths + the clean finisher
+    assert [a["cause"] for a in attempts[:3]] == ["killed"] * 3
+    assert attempts[3]["cause"] == "complete"
+    assert all(a["rcs"] == {"0": KILL_EXIT_CODE} for a in attempts[:3])
+    assert records[-1]["phase"] == "campaign_done"
+    _assert_tables_equal(out_table, golden)
+
+
+@pytest.mark.slow
+def test_campaign_no_progress_breaker_writes_diagnosis(tmp_path):
+    """K consecutive attempts dying without sealing anything new abort
+    the campaign (exit 3) with the diagnosis bundle: last progress,
+    quarantine inventory, log tails."""
+    ck = tmp_path / "ck"
+    out = _run_campaign([
+        "tictactoe", "--checkpoint-dir", str(ck),
+        "--no-progress", "2", "--max-attempts", "8",
+        "--chaos", "engine.forward:kill:1",
+        "--chaos", "engine.forward:kill:1",
+        "--chaos", "engine.forward:kill:1",
+        "--chaos", "engine.forward:kill:1",
+    ])
+    assert out.returncode == NO_PROGRESS_EXIT_CODE, out.stderr[-2000:]
+    records = _ledger(ck / "campaign.jsonl")
+    attempts = [r for r in records if r["phase"] == "campaign_attempt"]
+    # Attempt 1 seals the seed frontier level (progress); 2 and 3 die at
+    # the same point with nothing new -> breaker at K=2.
+    assert len(attempts) <= 3
+    assert records[-1]["phase"] == "campaign_abort"
+    assert records[-1]["code"] == NO_PROGRESS_EXIT_CODE
+    bundle = json.loads((ck / "campaign_diagnosis.json").read_text())
+    assert bundle["attempts"] == len(attempts)
+    assert "progress" in bundle and "quarantine" in bundle
+    assert any(bundle["log_tails"].values())
+
+
+@pytest.mark.slow
+def test_campaign_enospc_triggers_gc_and_retry(tmp_path):
+    """An ENOSPC-classified death (injected `enospc` fault) pauses into
+    retention GC — which reclaims the planted superseded artifacts —
+    and the retry completes. The acceptance shape: pause -> GC -> retry,
+    never a torn write."""
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    # Superseded artifacts for the GC to find: a quarantined level and
+    # an unreferenced stray shard.
+    (ck / "level_0099.npz.corrupt").write_bytes(b"x" * 1024)
+    (ck / "edges_0042.shard_0000.npz").write_bytes(b"y" * 1024)
+    out = _run_campaign([
+        "tictactoe", "--checkpoint-dir", str(ck),
+        "--chaos", "ckpt.save_frontier:enospc:3",
+    ])
+    assert out.returncode == 0, out.stderr[-3000:]
+    records = _ledger(ck / "campaign.jsonl")
+    attempts = [r for r in records if r["phase"] == "campaign_attempt"]
+    assert attempts[0]["cause"] == "enospc"
+    assert attempts[-1]["cause"] == "complete"
+    gcs = [r for r in records if r["phase"] == "campaign_gc"]
+    assert gcs and gcs[0]["reason"] == "enospc"
+    assert gcs[0]["freed_files"] >= 2
+    assert gcs[0]["freed_bytes"] >= 2048
+    # The GC's quarantine snapshot preserved the evidence on the ledger.
+    assert any(q["file"] == "level_0099.npz.corrupt"
+               for q in gcs[0]["quarantined"])
+    assert not (ck / "level_0099.npz.corrupt").exists()
+    assert not (ck / "edges_0042.shard_0000.npz").exists()
+
+
+_NO_BACKEND = "Multiprocess computations aren't implemented"
+
+
+@pytest.mark.slow
+def test_campaign_multiprocess_kill_resumes_to_completion(tmp_path):
+    """A 2-process world per attempt: rank 0 SIGKILLed mid-forward on
+    attempt 1 (rank 1 exits through the coordinated abort), attempt 2
+    resumes the world to completion — zero operator input."""
+    ck = tmp_path / "ck"
+    out = _run_campaign(
+        [_C3, "--checkpoint-dir", str(ck), "--processes", "2",
+         "--chaos", "sharded.forward:kill:3",
+         "--", "--devices", "4"],
+        extra_env={"GAMESMAN_BARRIER_SECS": "10",
+                   "GAMESMAN_COLLECTIVE_TIMEOUT": "60"},
+    )
+    logs = " ".join(
+        p.read_text(errors="replace")
+        for p in (ck / "logs").rglob("rank*.err")
+    )
+    if _NO_BACKEND in logs:
+        pytest.skip("backend cannot run multiprocess collectives")
+    assert out.returncode == 0, out.stderr[-3000:]
+    records = _ledger(ck / "campaign.jsonl")
+    attempts = [r for r in records if r["phase"] == "campaign_attempt"]
+    assert len(attempts) == 2
+    assert attempts[0]["cause"] == "killed"
+    assert attempts[0]["rcs"]["0"] == KILL_EXIT_CODE
+    assert attempts[0]["rcs"]["1"] == 124  # coordinated abort, in time
+    assert attempts[1]["cause"] == "complete"
+    assert attempts[1]["rcs"] == {"0": 0, "1": 0}
+
+
+@pytest.mark.slow
+def test_campaign_sigterm_preempts_and_is_resumable(tmp_path):
+    """SIGTERM to the CAMPAIGN forwards to the attempt (which drains to
+    exit 75) and the campaign exits 75; rerunning the same command
+    continues from the sealed prefix to byte-parity."""
+    from gamesmanmpi_tpu.resilience.preempt import GRACE_EXIT_CODE
+    from gamesmanmpi_tpu.solve import Solver
+
+    golden = tmp_path / "golden.npz"
+    save_result_npz(golden, Solver(get_game("tictactoe")).solve())
+    ck = tmp_path / "ck"
+    env = dict(os.environ)
+    env["GAMESMAN_PLATFORM"] = "cpu"
+    env.pop("GAMESMAN_FAULTS", None)  # the campaign arms chaos itself
+    proc = subprocess.Popen(
+        # --chaos stretches attempt 1's backward so the SIGTERM lands
+        # mid-solve deterministically (the campaign pops a plain
+        # GAMESMAN_FAULTS from attempt envs by design).
+        _CAMPAIGN + ["tictactoe", "--checkpoint-dir", str(ck),
+                     "--chaos", "engine.backward:delay=0.7:always"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=str(REPO),
+    )
+    try:
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if list(ck.glob("level_*.npz")):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("attempt never sealed a level")
+        proc.send_signal(subprocess.signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    assert rc == GRACE_EXIT_CODE, proc.stderr.read()[-2000:]
+    records = _ledger(ck / "campaign.jsonl")
+    assert records[-1]["phase"] == "campaign_preempted"
+    attempts = [r for r in records if r["phase"] == "campaign_attempt"]
+    assert attempts and attempts[-1]["cause"] == "preempted"
+    # Rerun the same command: resumes to parity.
+    out_table = tmp_path / "resumed.npz"
+    out = _run_campaign([
+        "tictactoe", "--checkpoint-dir", str(ck),
+        "--", "--table-out", str(out_table),
+    ])
+    assert out.returncode == 0, out.stderr[-2000:]
+    _assert_tables_equal(out_table, golden)

@@ -351,6 +351,97 @@ def test_gm204_requires_lock_called_without(tmp_path):
     assert got == [("GM204", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
 
 
+def test_gm205_signal_handler_reaches_lock(tmp_path):
+    """A handler registered via signal.signal that (transitively)
+    acquires a lock is the PR 7 self-deadlock class — flagged at the
+    registration site, naming the lock."""
+    build_project(tmp_path, {"mod.py": """
+        import signal
+        import threading
+
+        class Sup:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stop = False
+
+            def request_stop(self):
+                with self._lock:
+                    self._stop = True
+
+            def _on_term(self, signum, frame):
+                self.request_stop()
+
+            def install(self):
+                signal.signal(signal.SIGTERM, self._on_term)  # MARK
+    """})
+    _, got = findings(tmp_path)
+    assert got == [("GM205", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
+    res, _ = findings(tmp_path)
+    (d,) = res.new
+    assert "_lock" in d.message and "_on_term" in d.message
+
+
+def test_gm205_cross_module_reach(tmp_path):
+    """Reach is whole-program: the lock acquisition may live in another
+    module entirely (the handler calls an imported helper)."""
+    build_project(tmp_path, {
+        "locks.py": """
+            import threading
+
+            _lock = threading.Lock()
+
+            def note_stop():
+                with _lock:
+                    pass
+        """,
+        "mod.py": """
+            import signal
+
+            from pkg.locks import note_stop
+
+            def _on_term(signum, frame):
+                note_stop()
+
+            def install():
+                signal.signal(signal.SIGTERM, _on_term)  # MARK
+        """,
+    })
+    _, got = findings(tmp_path)
+    assert got == [("GM205", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
+
+
+def test_gm205_lock_free_handler_and_thread_target_pass(tmp_path):
+    """The clean twins: a flag-setting handler, and a handler that only
+    SPAWNS a locking function on a Thread/Timer (another thread's
+    program order — cannot deadlock the interrupted main thread)."""
+    build_project(tmp_path, {"mod.py": """
+        import signal
+        import threading
+
+        class Sup:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stop = False
+
+            def _locked_teardown(self):
+                with self._lock:
+                    pass
+
+            def _on_term(self, signum, frame):
+                self._stop = True  # lock-free: a plain flag store
+                threading.Thread(
+                    target=self._locked_teardown, daemon=True
+                ).start()
+                self._timer = threading.Timer(1.0, self._locked_teardown)
+                self._timer.start()
+
+            def install(self):
+                signal.signal(signal.SIGTERM, self._on_term)
+    """})
+    _, got = findings(tmp_path)
+    assert got == []
+
+
 def test_condition_aliases_its_lock(tmp_path):
     """Holding a Condition built over the lock counts as holding it."""
     build_project(tmp_path, {"mod.py": """

@@ -490,6 +490,51 @@ def test_obs_report_compression_and_cache_columns(tmp_path, capsys):
     assert "db_cache_hit_rate[cold]=0.100" in w2_line
 
 
+def test_obs_report_campaign_summary(tmp_path, capsys):
+    """ISSUE 12 satellite: a campaign.jsonl ledger folds into one
+    campaign line — attempts, causes, resume levels, wall-clock lost to
+    restarts (failed attempts only) + backoff, GC reclamation — and the
+    ledger records stay out of the aux 'other records' noise."""
+    jsonl = tmp_path / "campaign.jsonl"
+    jsonl.write_text("\n".join(json.dumps(r) for r in [
+        {"phase": "campaign_start", "solver_args": ["ttt"],
+         "processes": 1, "max_attempts": 8},
+        {"phase": "campaign_attempt", "attempt": 1, "cause": "killed",
+         "rcs": {"0": 77}, "wall_secs": 4.0, "resume_level": None,
+         "progressed": True},
+        {"phase": "campaign_backoff", "secs": 0.5},
+        {"phase": "campaign_gc", "reason": "enospc", "freed_files": 3,
+         "freed_bytes": 2_000_000, "kinds": {"edges": 2_000_000}},
+        {"phase": "campaign_attempt", "attempt": 2, "cause": "enospc",
+         "rcs": {"0": 1}, "wall_secs": 2.0, "resume_level": 7,
+         "progressed": False},
+        {"phase": "campaign_backoff", "secs": 1.0},
+        {"phase": "campaign_attempt", "attempt": 3, "cause": "complete",
+         "rcs": {"0": 0}, "wall_secs": 9.0, "resume_level": 5,
+         "progressed": True},
+        {"phase": "campaign_done", "attempts": 3, "wall_secs": 17.5},
+    ]) + "\n")
+    obs_report = load_module(REPO / "tools" / "obs_report.py")
+    assert obs_report.main([str(jsonl)]) == 0
+    out = capsys.readouterr().out
+    line = next(l for l in out.splitlines() if l.startswith("campaign:"))
+    assert "attempts=3" in line
+    assert "solved in 17.5s" in line
+    assert "complete:1" in line and "enospc:1" in line \
+        and "killed:1" in line
+    assert "resume_levels=[None, 7, 5]" in line
+    assert "time_lost_restarts=6.0s" in line  # failed attempts only
+    assert "backoff=1.5s" in line
+    assert "gc_reclaimed_MB=2.0" in line
+    assert "campaign_attempt" not in out.replace(line, "")
+    # An aborted ledger reports the abort, not 'in flight'.
+    records = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    records = records[:-2] + [{"phase": "campaign_abort",
+                               "reason": "breaker", "code": 3}]
+    lines = obs_report.summarize_campaign(records)
+    assert lines and "ABORTED (breaker)" in lines[0]
+
+
 @pytest.mark.smoke
 def test_obs_report_merges_rank_streams_without_double_counting(
         tmp_path, capsys):

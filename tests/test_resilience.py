@@ -960,6 +960,292 @@ def test_serve_sigterm_drains_gracefully(tmp_path):
             proc.wait()
 
 
+# ------------------------------------ preemption grace + enospc (ISSUE 12)
+#
+# Two new failure classes above the kill/torn matrix: SIGTERM/SIGUSR1
+# must drain the solve to a level boundary and exit 75 with everything
+# complete sealed (grace), and an injected OSError(ENOSPC) —
+# GAMESMAN_FAULTS kind `enospc`, incl. at store.writebehind — must fail
+# fast exactly like a torn write: prefix intact, resume to byte-parity,
+# never a wrong answer. The campaign layer above both lives in
+# tests/test_campaign.py.
+
+
+def _arm_preempt_on_fire(point, visit):
+    """Deliver SIGUSR1 to ourselves at the `visit`th fire of `point`:
+    a deterministic mid-solve preemption (the handler runs on the main
+    thread before the next bytecode, so the flag is set before the next
+    level boundary)."""
+    import signal as _signal
+
+    state = {"n": 0}
+    real_fire = faults.fire
+
+    def firing(p, **kw):
+        if p == point:
+            state["n"] += 1
+            if state["n"] == visit:
+                _signal.raise_signal(_signal.SIGUSR1)
+        return real_fire(p, **kw)
+
+    faults.fire = firing
+    return lambda: setattr(faults, "fire", real_fire)
+
+
+def test_preempt_drains_at_boundary_and_resumes_parity(tmp_path, c3_clean):
+    """In-process grace: SIGUSR1 mid-backward raises
+    PreemptionRequested at the next level boundary; sealed levels load
+    clean and the resumed solve reaches parity."""
+    from gamesmanmpi_tpu.resilience import preempt
+
+    ck = LevelCheckpointer(tmp_path / "ck")
+    restore = preempt.install_grace_handler()
+    unfire = _arm_preempt_on_fire("engine.backward", 2)
+    try:
+        with pytest.raises(preempt.PreemptionRequested):
+            Solver(get_game(_C3), checkpointer=ck).solve()
+    finally:
+        unfire()
+        restore()  # also resets the flag + disarms the deadline
+    assert not preempt.requested()
+    sealed = ck.completed_levels()
+    assert sealed  # backward visit 2 resolved+sealed at least one level
+    for k in sealed:
+        ck.load_level(k)
+    resumed = Solver(get_game(_C3),
+                     checkpointer=LevelCheckpointer(tmp_path / "ck")).solve()
+    assert full_table(resumed) == full_table(c3_clean)
+
+
+def test_preempt_sharded_coordinated_round(tmp_path, c3_clean):
+    """The sharded boundary check is a consensus round (world-1 here):
+    a preempted solve unwinds through PreemptionRequested with pending
+    seals flushed, and resumes to parity."""
+    from gamesmanmpi_tpu.resilience import preempt
+
+    ck = LevelCheckpointer(tmp_path / "ck")
+    solver = _coordinated_world1_solver(_C3)
+    solver.checkpointer = ck
+    restore = preempt.install_grace_handler()
+    unfire = _arm_preempt_on_fire("sharded.backward", 2)
+    try:
+        with pytest.raises(preempt.PreemptionRequested):
+            solver.solve()
+    finally:
+        unfire()
+        restore()
+    for k in ck.completed_levels():
+        ck.load_level(k)
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+
+    resumed = ShardedSolver(
+        get_game(_C3), num_shards=2,
+        checkpointer=LevelCheckpointer(tmp_path / "ck"),
+    ).solve()
+    assert full_table(resumed) == full_table(c3_clean)
+
+
+def test_preempt_not_transient_and_resets():
+    from gamesmanmpi_tpu.resilience import preempt
+
+    assert not is_transient(preempt.PreemptionRequested("x"))
+    preempt.reset()
+    assert not preempt.requested()
+    preempt.check("forward", level=0)  # disarmed: no raise
+
+
+def test_enospc_fault_kind_fails_fast_prefix_intact(tmp_path, c3_clean):
+    """`enospc` at a sealed-level write point: OSError(ENOSPC), never
+    retried (a full disk refills), prefix intact, resume to parity —
+    the torn-write degrade contract."""
+    import errno
+
+    ck = LevelCheckpointer(tmp_path / "ck")
+    faults.configure("ckpt.save_level:enospc:2")
+    with pytest.raises(OSError) as ei:
+        Solver(get_game(_C3), checkpointer=ck).solve()
+    assert ei.value.errno == errno.ENOSPC
+    assert not is_transient(ei.value)  # retrying ENOSPC is wrong
+    sealed = ck.completed_levels()
+    for k in sealed:
+        ck.load_level(k)  # whatever sealed before the death loads clean
+    faults.clear()
+    resumed = Solver(get_game(_C3),
+                     checkpointer=LevelCheckpointer(tmp_path / "ck")).solve()
+    assert full_table(resumed) == full_table(c3_clean)
+
+
+@pytest.mark.slow
+def test_chaos_enospc_mid_writebehind_resumes_parity(tmp_path,
+                                                     c4_clean_table):
+    """enospc injected on the write-behind worker (store.writebehind):
+    the ticket failure surfaces at the seal on the solve thread, the
+    process dies with the prefix intact — an unsealed stray at worst —
+    and resume reaches byte-parity. The enospc chaos-matrix entry for
+    the sharded engine."""
+    ck = tmp_path / "ck"
+    died = _run_cli(
+        [_C4, "--devices", "2", "--checkpoint-dir", str(ck)],
+        {"GAMESMAN_FAULTS": "store.writebehind:enospc:3"},
+    )
+    assert died.returncode != 0
+    assert "No space left on device" in died.stderr
+    out = tmp_path / "resumed.npz"
+    resumed = _run_cli(
+        [_C4, "--devices", "2", "--checkpoint-dir", str(ck),
+         "--table-out", str(out)]
+    )
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    _assert_tables_equal(out, c4_clean_table)
+
+
+@pytest.mark.slow
+def test_chaos_sigterm_preempts_single_process(tmp_path, ttt_clean_table):
+    """Whole-process grace: SIGTERM mid-backward -> exit 75 within the
+    grace deadline, 'preempted' diagnostics on stderr, resume to
+    byte-parity."""
+    env = dict(os.environ)
+    env["GAMESMAN_PLATFORM"] = "cpu"
+    env["GAMESMAN_FAULTS"] = "engine.backward:delay=0.7:always"
+    env["GAMESMAN_PREEMPT_GRACE_SECS"] = "60"
+    ck = tmp_path / "ck"
+    proc = subprocess.Popen(
+        _CLI + ["tictactoe", "--checkpoint-dir", str(ck)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=str(REPO),
+    )
+    try:
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if list(ck.glob("level_*.npz")):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("solve never sealed a level")
+        t0 = time.monotonic()
+        proc.send_signal(subprocess.signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        graced = time.monotonic() - t0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    _, err = proc.communicate()
+    from gamesmanmpi_tpu.resilience.preempt import GRACE_EXIT_CODE
+
+    assert rc == GRACE_EXIT_CODE, err[-2000:]
+    assert graced < 60, "drain blew the grace deadline"
+    assert "preempted" in err
+    out = tmp_path / "resumed.npz"
+    resumed = _run_cli(
+        ["tictactoe", "--checkpoint-dir", str(ck),
+         "--table-out", str(out)]
+    )
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    _assert_tables_equal(out, ttt_clean_table)
+
+
+@pytest.mark.slow
+def test_chaos_sigterm_multiprocess_grace_both_ranks(tmp_path):
+    """SIGTERM to BOTH ranks mid-level: the rank-coordinated boundary
+    round makes every rank drain at the same program point — each exits
+    75 (or 124 if wedged past the deadline), never a hang, never a torn
+    tree — and a restart resumes to parity."""
+    from tools.launch_multihost import start_world
+
+    from gamesmanmpi_tpu.resilience.preempt import GRACE_EXIT_CODE
+
+    ck = tmp_path / "ck"
+    delay = "sharded.backward:delay=0.7:always"
+    env = dict(os.environ)
+    env.update({
+        "GAMESMAN_PLATFORM": "cpu",
+        "GAMESMAN_BARRIER_SECS": "20",
+        "GAMESMAN_PREEMPT_GRACE_SECS": "90",
+        "GAMESMAN_FAULTS_RANK_0": delay,
+        "GAMESMAN_FAULTS_RANK_1": delay,
+    })
+    env.pop("GAMESMAN_FAULTS", None)
+    world = start_world(
+        [_C3, "--devices", "4", "--checkpoint-dir", str(ck)],
+        processes=2, log_dir=str(tmp_path), env=env,
+    )
+    deadline = time.monotonic() + 240
+    while time.monotonic() < deadline:
+        if list(ck.glob("level_*.shard_*.npz")):
+            break
+        time.sleep(0.1)
+    world.send_signal(subprocess.signal.SIGTERM)
+    ranks = world.wait(120)
+    _skip_unless_world_spawned(ranks)
+    for r in ranks:
+        assert r.returncode in (GRACE_EXIT_CODE, 124), (
+            r.rank, r.returncode, r.stderr[-2000:]
+        )
+    # At least one rank drained through the grace path proper.
+    assert any(r.returncode == GRACE_EXIT_CODE for r in ranks), [
+        r.returncode for r in ranks
+    ]
+    ck_obj = LevelCheckpointer(ck)
+    for k in ck_obj.completed_levels():
+        ck_obj.load_level(k)
+    ranks2 = _launch_world(
+        [_C3, "--devices", "4", "--checkpoint-dir", str(ck)], tmp_path
+    )
+    for r in ranks2:
+        assert r.returncode == 0, (r.rank, r.stderr[-2000:])
+        assert "value: TIE" in r.stdout
+
+
+@pytest.mark.slow
+def test_chaos_kill_sweep_every_level_boundary(tmp_path):
+    """ISSUE 12 satellite: resume-under-kill at EVERY level boundary of
+    a small sharded solve — not sampled points. A clean checkpointed
+    run counts the level-seal visits; then each visit index in turn is
+    a kill schedule, and every resume depth must reach byte-parity."""
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+
+    ck0 = tmp_path / "count_ck"
+    seq = []
+    real_fire = faults.fire
+
+    def recording_fire(point, **kw):
+        seq.append(point)
+        return real_fire(point, **kw)
+
+    faults.fire = recording_fire
+    try:
+        clean = ShardedSolver(
+            get_game(_C3), num_shards=2,
+            checkpointer=LevelCheckpointer(ck0),
+        ).solve()
+    finally:
+        faults.fire = real_fire
+    golden = tmp_path / "golden.npz"
+    save_result_npz(golden, clean)
+    boundaries = seq.count("ckpt.save_level")
+    assert boundaries >= 5, seq  # every solved level seals once
+    for visit in range(1, boundaries + 1):
+        ck = tmp_path / f"ck_{visit:02d}"
+        killed = _run_cli(
+            [_C3, "--devices", "2", "--checkpoint-dir", str(ck)],
+            {"GAMESMAN_FAULTS": f"ckpt.save_level:kill:{visit}"},
+        )
+        assert killed.returncode == faults.KILL_EXIT_CODE, (
+            f"visit {visit}: rc={killed.returncode}\n"
+            + killed.stderr[-2000:]
+        )
+        out = tmp_path / f"resumed_{visit:02d}.npz"
+        resumed = _run_cli(
+            [_C3, "--devices", "2", "--checkpoint-dir", str(ck),
+             "--table-out", str(out)]
+        )
+        assert resumed.returncode == 0, (
+            f"visit {visit}:\n" + resumed.stderr[-2000:]
+        )
+        _assert_tables_equal(out, golden)
+
+
 # ------------------------------------------------- serving fleet chaos
 
 

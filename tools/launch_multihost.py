@@ -93,19 +93,83 @@ def _child_env(base: dict, rank: int, processes: int, coordinator: str,
     return env
 
 
-def launch(solver_args: Sequence[str], *, processes: int = 2,
-           timeout: float = 240.0, log_dir: Optional[str] = None,
-           local_devices: int = DEFAULT_LOCAL_DEVICES,
-           coordinator: Optional[str] = None,
-           env: Optional[dict] = None,
-           per_rank_env: Optional[Dict[int, dict]] = None,
-           ) -> List[RankResult]:
-    """Run ``solve_launcher.py solver_args...`` as `processes` ranks.
+class World:
+    """A launched N-rank world the caller can signal and wait on.
 
-    Blocks until every rank exits or `timeout` seconds pass, then kills
-    stragglers (their returncode reports None — the caller decides
-    whether a straggler is a failure or the scenario under test).
+    The campaign supervisor (resilience/campaign.py) needs more than
+    ``launch()``'s run-to-completion contract: it forwards preemption
+    signals to every rank mid-run and waits with its own policy. One
+    ``World`` owns the rank processes and their log files; ``wait()``
+    collects every rank (killing stragglers past the deadline) exactly
+    like ``launch()`` always did.
     """
+
+    def __init__(self, procs, files):
+        self._procs = procs
+        self._files = files
+        self._results: Optional[List[RankResult]] = None
+
+    def pids(self) -> List[int]:
+        return [p.pid for p in self._procs]
+
+    def send_signal(self, sig) -> None:
+        """Deliver ``sig`` to every still-running rank (preemption
+        grace forwards SIGTERM this way)."""
+        for p in self._procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(sig)
+                except OSError:
+                    pass
+
+    def wait(self, timeout: Optional[float]) -> List[RankResult]:
+        """Block until every rank exits or `timeout` seconds pass, then
+        kill stragglers (their returncode reports None — the caller
+        decides whether a straggler is a failure or the scenario under
+        test). ``None`` waits forever — the campaign's attempt-timeout-
+        off contract; a silent cap here would SIGKILL exactly the
+        multi-day world runs the campaign exists for. Idempotent: a
+        second call returns the same results."""
+        if self._results is not None:
+            return self._results
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
+        results: List[RankResult] = []
+        for rank, (p, (out_f, err_f)) in enumerate(
+            zip(self._procs, self._files)
+        ):
+            rc: Optional[int] = None
+            try:
+                rc = p.wait(
+                    timeout=None if deadline is None
+                    else max(0.1, deadline - time.monotonic())
+                )
+            except subprocess.TimeoutExpired:
+                p.kill()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+            out_f.seek(0)
+            err_f.seek(0)
+            results.append(RankResult(rank, rc, out_f.read(), err_f.read()))
+            out_f.close()
+            err_f.close()
+        self._results = results
+        return results
+
+
+def start_world(solver_args: Sequence[str], *, processes: int = 2,
+                log_dir: Optional[str] = None,
+                local_devices: Optional[int] = None,
+                coordinator: Optional[str] = None,
+                env: Optional[dict] = None,
+                per_rank_env: Optional[Dict[int, dict]] = None,
+                ) -> World:
+    """Spawn ``solve_launcher.py solver_args...`` as `processes` ranks
+    and return immediately (see :class:`World`)."""
+    if local_devices is None:
+        local_devices = DEFAULT_LOCAL_DEVICES
     base = dict(os.environ)
     if env:
         base.update({k: str(v) for k, v in env.items()})
@@ -132,24 +196,23 @@ def launch(solver_args: Sequence[str], *, processes: int = 2,
                            (per_rank_env or {}).get(rank)),
             stdout=out_f, stderr=err_f,
         ))
-    deadline = time.monotonic() + timeout
-    results: List[RankResult] = []
-    for rank, (p, (out_f, err_f)) in enumerate(zip(procs, files)):
-        rc: Optional[int] = None
-        try:
-            rc = p.wait(timeout=max(0.1, deadline - time.monotonic()))
-        except subprocess.TimeoutExpired:
-            p.kill()
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                pass
-        out_f.seek(0)
-        err_f.seek(0)
-        results.append(RankResult(rank, rc, out_f.read(), err_f.read()))
-        out_f.close()
-        err_f.close()
-    return results
+    return World(procs, files)
+
+
+def launch(solver_args: Sequence[str], *, processes: int = 2,
+           timeout: float = 240.0, log_dir: Optional[str] = None,
+           local_devices: int = DEFAULT_LOCAL_DEVICES,
+           coordinator: Optional[str] = None,
+           env: Optional[dict] = None,
+           per_rank_env: Optional[Dict[int, dict]] = None,
+           ) -> List[RankResult]:
+    """Run ``solve_launcher.py solver_args...`` as `processes` ranks and
+    block for the results (start_world + World.wait)."""
+    return start_world(
+        solver_args, processes=processes, log_dir=log_dir,
+        local_devices=local_devices, coordinator=coordinator, env=env,
+        per_rank_env=per_rank_env,
+    ).wait(timeout)
 
 
 def main(argv=None) -> int:

@@ -260,12 +260,84 @@ def summarize_export(records: list[dict]) -> list[str]:
     ]
 
 
+#: Ledger phases the campaign summary consumes (excluded from the aux
+#: record counts — they have their own lines).
+_CAMPAIGN_PHASES = (
+    "campaign_start", "campaign_attempt", "campaign_backoff",
+    "campaign_gc", "campaign_done", "campaign_abort",
+    "campaign_preempted",
+)
+
+
+def summarize_campaign(records: list[dict]) -> list[str]:
+    """Campaign summary lines from a ``campaign.jsonl`` ledger
+    (resilience/campaign.py): attempts with causes and resume levels,
+    wall-clock lost to failed attempts + backoff, GC reclamation, and
+    how the campaign ended. Pass the ledger alongside (or instead of)
+    the solve streams — records interleave safely."""
+    attempts = [r for r in records if r.get("phase") == "campaign_attempt"]
+    if not attempts:
+        return []
+    causes: dict = {}
+    lost = 0.0
+    resume_levels = []
+    for rec in attempts:
+        cause = rec.get("cause", "?")
+        causes[cause] = causes.get(cause, 0) + 1
+        resume_levels.append(rec.get("resume_level"))
+        if cause != "complete":
+            # A failed attempt's whole wall clock is restart loss: its
+            # sealed progress survives, but the compute re-runs on
+            # resume up to the level the seal reached.
+            lost += float(rec.get("wall_secs", 0.0))
+    backoff = sum(
+        float(r.get("secs", 0.0)) for r in records
+        if r.get("phase") == "campaign_backoff"
+    )
+    gc_bytes = sum(
+        int(r.get("freed_bytes", 0)) for r in records
+        if r.get("phase") == "campaign_gc"
+    )
+    # The ledger is append-only ACROSS reruns (preempt -> exit 75 ->
+    # rerun appends a new campaign_start segment), so the ending comes
+    # from the LAST terminal record — attempts/time-lost/backoff stay
+    # whole-ledger totals, which is what "lost to restarts" means for
+    # the endeavor — and multi-run ledgers say so.
+    runs = sum(1 for r in records if r.get("phase") == "campaign_start")
+    terminal = next(
+        (r for r in reversed(records) if r.get("phase") in
+         ("campaign_done", "campaign_abort", "campaign_preempted")),
+        None,
+    )
+    if terminal is None:
+        ending = "in flight"
+    elif terminal["phase"] == "campaign_done":
+        ending = f"solved in {float(terminal.get('wall_secs', 0.0)):.1f}s"
+    elif terminal["phase"] == "campaign_abort":
+        ending = f"ABORTED ({terminal.get('reason', '?')})"
+    else:
+        ending = "preempted (resumable)"
+    lines = [
+        f"campaign: attempts={len(attempts)}"
+        + (f" runs={runs}" if runs > 1 else "")
+        + f" {ending} "
+        f"causes=" + ",".join(
+            f"{k}:{v}" for k, v in sorted(causes.items())
+        )
+        + f" resume_levels={resume_levels}"
+        + f" time_lost_restarts={lost:.1f}s backoff={backoff:.1f}s"
+        + (f" gc_reclaimed_MB={gc_bytes / 1e6:.1f}" if gc_bytes else "")
+    ]
+    return lines
+
+
 def report(records: list[dict]) -> str:
     """The full report: level table + done summary + serving summary +
-    aux record counts."""
+    campaign summary + aux record counts."""
     out = [format_table(summarize_levels(records))]
     out.extend(summarize_serving(records))
     out.extend(summarize_export(records))
+    out.extend(summarize_campaign(records))
     for rec in records:
         if rec.get("phase") == "done":
             keys = ("game", "positions", "levels", "secs_forward",
@@ -287,6 +359,7 @@ def report(records: list[dict]) -> str:
         # here. serve_batch has its own per-worker summary lines.
         if phase not in ("forward", "backward", "backward_edges", "done",
                          "serve_batch") \
+                and phase not in _CAMPAIGN_PHASES \
                 and not (phase in ("retry", "ckpt_degraded")
                          and "level" in rec):
             aux[phase] = aux.get(phase, 0) + 1

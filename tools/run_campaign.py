@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Self-healing solve campaign driver (docs/DISTRIBUTED.md "Campaigns").
+
+Wraps one solve — single-process, or a whole launch_multihost world —
+in the auto-resume supervisor (resilience/campaign.py): every
+crash/preemption/watchdog abort resumes from the latest consistent
+checkpoint with bounded backoff, a no-progress breaker aborts with a
+diagnosis bundle, ENOSPC degrades to GC-and-retry, and every attempt is
+a fsync'd line in the append-only campaign ledger.
+
+Examples::
+
+    # the ROADMAP item 1 staging ladder, one rung:
+    python tools/run_campaign.py connect4:w=5,h=4 \
+        --checkpoint-dir /data/c4_5x4 --processes 2 -- --devices 4
+
+    # chaos proof: three injected kills, then driven to completion
+    python tools/run_campaign.py connect4:w=5,h=4 \
+        --checkpoint-dir /tmp/ck --processes 2 \
+        --chaos sharded.forward:kill:3 \
+        --chaos sharded.backward:kill:2 \
+        --chaos store.writebehind:kill:1 -- --devices 4
+
+Everything after ``--`` goes to the solve CLI verbatim (the campaign
+adds ``--checkpoint-dir`` itself). Exit codes: 0 solved, 2 usage,
+3 no-progress breaker / attempts exhausted, 4 disk hard floor,
+75 campaign preempted (rerun the same command to continue).
+
+This process never imports jax (startup is instant; the solve happens
+in the attempt subprocesses), so it survives anything the attempt does
+to its own runtime.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # tools/ scripts get sys.path[0]=tools/
+    sys.path.insert(0, REPO)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="run_campaign",
+        description="Drive one solve to completion across failures: "
+        "auto-resume, preemption grace, disk-budget GC, append-only "
+        "ledger (docs/DISTRIBUTED.md).",
+    )
+    p.add_argument("game", help="built-in game spec, e.g. "
+                   "connect4:w=5,h=4 (passed to the solve CLI)")
+    p.add_argument("--checkpoint-dir", required=True,
+                   help="the campaign's one source of truth: attempts "
+                   "resume from it, the ledger lives next to it")
+    p.add_argument("--processes", type=int, default=1,
+                   help="1 = single solve process; N>1 = a real "
+                   "tools/launch_multihost.py jax.distributed world "
+                   "per attempt")
+    p.add_argument("--max-attempts", type=int, default=None,
+                   help="attempt budget: past it, the next attempt "
+                   "that seals nothing new aborts (progressing "
+                   "attempts never die on the budget alone; env "
+                   "GAMESMAN_CAMPAIGN_MAX_ATTEMPTS, default 8)")
+    p.add_argument("--no-progress", type=int, default=None, metavar="K",
+                   help="breaker: abort after K consecutive attempts "
+                   "that seal nothing new (env "
+                   "GAMESMAN_CAMPAIGN_NO_PROGRESS, default 3)")
+    p.add_argument("--backoff-base-secs", type=float, default=None,
+                   help="first inter-attempt backoff, doubling per "
+                   "consecutive failure (env "
+                   "GAMESMAN_CAMPAIGN_BACKOFF_BASE_SECS, default 1)")
+    p.add_argument("--backoff-max-secs", type=float, default=None,
+                   help="backoff ceiling (env "
+                   "GAMESMAN_CAMPAIGN_BACKOFF_MAX_SECS, default 60)")
+    p.add_argument("--attempt-timeout", type=float, default=None,
+                   metavar="S",
+                   help="kill an attempt running longer than S seconds "
+                   "(env GAMESMAN_CAMPAIGN_ATTEMPT_SECS; 0 = none)")
+    p.add_argument("--disk-soft-mb", type=float, default=None,
+                   help="run retention GC when free space drops below "
+                   "this (env GAMESMAN_CKPT_DISK_SOFT_MB; 0 = off)")
+    p.add_argument("--disk-floor-mb", type=float, default=None,
+                   help="abort cleanly (exit 4, prefix intact) when "
+                   "free space is below this after GC (env "
+                   "GAMESMAN_CKPT_DISK_FLOOR_MB; 0 = off)")
+    p.add_argument("--ledger", default=None, metavar="FILE",
+                   help="campaign ledger path (default "
+                   "<checkpoint-dir>/campaign.jsonl)")
+    p.add_argument("--log-dir", default=None,
+                   help="per-attempt solve logs (default "
+                   "<checkpoint-dir>/logs)")
+    p.add_argument("--chaos", action="append", default=None,
+                   metavar="SPEC",
+                   help="GAMESMAN_FAULTS spec armed for attempt i "
+                   "(repeat per attempt; later attempts run clean; "
+                   "multi-process worlds arm rank 0). The chaos-"
+                   "campaign acceptance knob — not for production")
+    p.add_argument("--local-devices", type=int, default=None,
+                   help="multi-process: fake CPU devices per rank "
+                   "(launch_multihost's knob)")
+    return p
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # Split on the first bare "--" OURSELVES: argparse.REMAINDER after a
+    # positional would swallow the campaign's own flags too.
+    extra: list = []
+    if "--" in argv:
+        split = argv.index("--")
+        argv, extra = argv[:split], argv[split + 1:]
+    args = build_parser().parse_args(argv)
+    from gamesmanmpi_tpu.resilience.campaign import (
+        Campaign,
+        CampaignConfig,
+    )
+
+    for banned in ("--checkpoint-dir",):
+        if banned in extra:
+            print(f"error: {banned} is the campaign's to manage — set "
+                  "it with the campaign flag", file=sys.stderr)
+            return 2
+    if args.processes < 1:
+        print("error: --processes must be >= 1", file=sys.stderr)
+        return 2
+    cfg = CampaignConfig(
+        solver_args=[args.game, *extra],
+        checkpoint_dir=args.checkpoint_dir,
+        processes=args.processes,
+        max_attempts=args.max_attempts,
+        no_progress_limit=args.no_progress,
+        backoff_base_secs=args.backoff_base_secs,
+        backoff_max_secs=args.backoff_max_secs,
+        attempt_timeout_secs=args.attempt_timeout,
+        disk_soft_mb=args.disk_soft_mb,
+        disk_floor_mb=args.disk_floor_mb,
+        ledger_path=args.ledger,
+        log_dir=args.log_dir,
+        chaos=list(args.chaos or []),
+        local_devices=args.local_devices,
+    )
+    campaign = Campaign(cfg)
+    restore = campaign.install_signal_handlers()
+    try:
+        return campaign.run()
+    finally:
+        restore()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
