@@ -776,6 +776,212 @@ def _campaign_bench() -> dict | None:
     return record
 
 
+def _campaign_elastic_bench() -> dict | None:
+    """BENCH_CAMPAIGN_ELASTIC=1: the elastic-resume proof (ISSUE 13).
+
+    Two scenarios against one golden uninterrupted solve:
+
+    * **reshard** — a sharded solve at BENCH_CAMPAIGN_ELASTIC_SEAL_SHARDS
+      is SIGKILLed mid-backward, then a campaign resumes it at
+      BENCH_CAMPAIGN_ELASTIC_SHARDS (a different shard count): the tree
+      is adopted by reshard-on-resume (the ledger's first attempt shows
+      sealed_shards != shards) and driven to completion;
+    * **oom** — a campaign started at BENCH_CAMPAIGN_ELASTIC_OOM_SHARDS
+      takes an injected `oom` death, auto-escalates geometry (shards
+      doubled, store cache halved — the campaign_reshard ledger record)
+      and completes at the escalated count.
+
+    Gates: both campaigns rc 0 with zero operator input, every
+    geometry change on the ledger, and BOTH `--table-out` tables
+    byte-identical to the golden solve (shard-count invariance across
+    resume). Runs in the PARENT (subprocess-only); failures land in
+    the artifact, never raise. Full record → BENCH_CAMPAIGN_ELASTIC_OUT.
+    """
+    if os.environ.get("BENCH_CAMPAIGN_ELASTIC", "0") in ("0", "", "off"):
+        return None
+    import tempfile
+
+    import numpy as np
+
+    spec = os.environ.get("BENCH_CAMPAIGN_ELASTIC_GAME",
+                          "connect4:w=5,h=4")
+    shards = int(_env_float("BENCH_CAMPAIGN_ELASTIC_SHARDS", 4))
+    seal_shards = int(_env_float("BENCH_CAMPAIGN_ELASTIC_SEAL_SHARDS", 8))
+    oom_shards = int(_env_float("BENCH_CAMPAIGN_ELASTIC_OOM_SHARDS", 2))
+    out_path = os.environ.get("BENCH_CAMPAIGN_ELASTIC_OUT",
+                              "BENCH_campaign_elastic.json")
+    deadline = _env_float("GAMESMAN_BENCH_DEADLINE", 3000.0)
+    record: dict = {
+        "bench": "elastic_campaign",
+        "spec": spec,
+        "shards": shards,
+        "seal_shards": seal_shards,
+        "oom_shards": oom_shards,
+    }
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def _ledger_of(ck: str) -> list:
+        out = []
+        try:
+            with open(os.path.join(ck, "campaign.jsonl")) as fh:
+                for line in fh:
+                    try:
+                        out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue
+        except OSError:
+            pass
+        return out
+
+    def _parity(a: str, b: str) -> bool:
+        with np.load(a) as za, np.load(b) as zb:
+            return sorted(za.files) == sorted(zb.files) and all(
+                np.array_equal(za[f], zb[f]) for f in za.files
+            )
+
+    try:
+        with tempfile.TemporaryDirectory(prefix="bench_elastic_") as wd:
+            base_env = dict(os.environ)
+            base_env.pop("GAMESMAN_FAULTS", None)
+            base_env.pop("XLA_FLAGS", None)
+            base_env.update({
+                "GAMESMAN_PLATFORM": "cpu",
+                "GAMESMAN_CAMPAIGN_BACKOFF_BASE_SECS": "0.2",
+            })
+            t0 = time.time()
+            golden = os.path.join(wd, "golden.npz")
+            golden_env = dict(base_env)
+            golden_env["GAMESMAN_FAKE_DEVICES"] = str(shards)
+            g = subprocess.run(
+                [sys.executable, "-m", "gamesmanmpi_tpu.cli", spec,
+                 "--devices", str(shards), "--table-out", golden],
+                capture_output=True, text=True, timeout=deadline,
+                env=golden_env, cwd=repo,
+            )
+            record["golden_secs"] = round(time.time() - t0, 3)
+            if g.returncode != 0:
+                record["ok"] = False
+                record["error"] = "golden: " + g.stderr[-1000:]
+                raise StopIteration
+
+            # --- scenario 1: SIGKILL at S=seal, campaign resumes at S
+            ck = os.path.join(wd, "ck_reshard")
+            kill_env = dict(base_env)
+            kill_env["GAMESMAN_FAKE_DEVICES"] = str(seal_shards)
+            kill_env["GAMESMAN_FAULTS"] = "sharded.backward:kill:2"
+            t0 = time.time()
+            killed = subprocess.run(
+                [sys.executable, "-m", "gamesmanmpi_tpu.cli", spec,
+                 "--devices", str(seal_shards),
+                 "--checkpoint-dir", ck],
+                capture_output=True, text=True, timeout=deadline,
+                env=kill_env, cwd=repo,
+            )
+            resumed = os.path.join(wd, "resumed.npz")
+            camp_env = dict(base_env)
+            camp_env["GAMESMAN_FAKE_DEVICES"] = str(shards)
+            camp = subprocess.run(
+                [sys.executable,
+                 os.path.join(repo, "tools", "run_campaign.py"), spec,
+                 "--checkpoint-dir", ck, "--",
+                 "--devices", str(shards), "--table-out", resumed],
+                capture_output=True, text=True, timeout=deadline,
+                env=camp_env, cwd=repo,
+            )
+            ledger = _ledger_of(ck)
+            attempts = [r for r in ledger
+                        if r.get("phase") == "campaign_attempt"]
+            record["reshard"] = {
+                "kill_rc": killed.returncode,
+                "campaign_rc": camp.returncode,
+                "secs": round(time.time() - t0, 3),
+                "attempts": len(attempts),
+                "causes": [a.get("cause") for a in attempts],
+                "sealed_shards": (attempts[0].get("sealed_shards")
+                                  if attempts else None),
+                "attempt_shards": (attempts[0].get("shards")
+                                   if attempts else None),
+                "parity_ok": (camp.returncode == 0
+                              and _parity(golden, resumed)),
+                "ledger": ledger,
+            }
+            record["reshard"]["ok"] = bool(
+                killed.returncode != 0
+                and camp.returncode == 0
+                and record["reshard"]["sealed_shards"] == seal_shards
+                and record["reshard"]["attempt_shards"] == shards
+                and record["reshard"]["parity_ok"]
+            )
+            if camp.returncode != 0:
+                record["reshard"]["error"] = camp.stderr[-2000:]
+
+            # --- scenario 2: injected oom, campaign auto-escalates
+            ck2 = os.path.join(wd, "ck_oom")
+            resumed2 = os.path.join(wd, "resumed_oom.npz")
+            oom_env = dict(base_env)
+            oom_env["GAMESMAN_FAKE_DEVICES"] = str(oom_shards)
+            t0 = time.time()
+            camp2 = subprocess.run(
+                [sys.executable,
+                 os.path.join(repo, "tools", "run_campaign.py"), spec,
+                 "--checkpoint-dir", ck2,
+                 "--chaos", "sharded.backward:oom:2", "--",
+                 "--devices", str(oom_shards),
+                 "--table-out", resumed2],
+                capture_output=True, text=True, timeout=deadline,
+                env=oom_env, cwd=repo,
+            )
+            ledger2 = _ledger_of(ck2)
+            attempts2 = [r for r in ledger2
+                         if r.get("phase") == "campaign_attempt"]
+            reshards2 = [r for r in ledger2
+                         if r.get("phase") == "campaign_reshard"]
+            record["oom"] = {
+                "campaign_rc": camp2.returncode,
+                "secs": round(time.time() - t0, 3),
+                "attempts": len(attempts2),
+                "causes": [a.get("cause") for a in attempts2],
+                "escalations": [
+                    {k: r.get(k) for k in
+                     ("from_shards", "to_shards", "from_cache_mb",
+                      "to_cache_mb")}
+                    for r in reshards2
+                ],
+                "parity_ok": (camp2.returncode == 0
+                              and _parity(golden, resumed2)),
+                "ledger": ledger2,
+            }
+            record["oom"]["ok"] = bool(
+                camp2.returncode == 0
+                and record["oom"]["causes"][:1] == ["oom"]
+                and record["oom"]["causes"][-1:] == ["complete"]
+                and reshards2
+                and reshards2[0].get("from_shards") == oom_shards
+                and reshards2[0].get("to_shards") == oom_shards * 2
+                and record["oom"]["parity_ok"]
+            )
+            if camp2.returncode != 0:
+                record["oom"]["error"] = camp2.stderr[-2000:]
+            record["ok"] = bool(
+                record["reshard"]["ok"] and record["oom"]["ok"]
+            )
+    except StopIteration:
+        pass
+    except Exception as e:  # noqa: BLE001 - must never kill the bench
+        record["ok"] = False
+        record["error"] = f"{type(e).__name__}: {e}"
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(record, fh, indent=1)
+            fh.write("\n")
+        print(f"elastic campaign bench: wrote {out_path} "
+              f"(ok={record.get('ok')})", file=sys.stderr)
+    except OSError as e:
+        print(f"elastic campaign bench: cannot write {out_path}: {e}",
+              file=sys.stderr)
+    return record
+
+
 def _db_compress_bench() -> dict | None:
     """BENCH_DB_COMPRESS=1: the compressed-DB ratio + latency benchmark
     (ROADMAP item 2 / ISSUE 9).
@@ -1103,6 +1309,20 @@ def main() -> int:
              "campaign_rc", "campaign_secs", "error")
             if k in cb
         }
+    eb = _campaign_elastic_bench()
+    if eb is not None:
+        # Summary only — the ledgers live in the artifact file
+        # (BENCH_CAMPAIGN_ELASTIC_OUT); the one-line record stays one
+        # line.
+        record["campaign_elastic"] = {"ok": eb.get("ok")}
+        for scenario in ("reshard", "oom"):
+            if scenario in eb:
+                record["campaign_elastic"][scenario] = {
+                    k: v for k, v in eb[scenario].items()
+                    if k != "ledger"
+                }
+        if "error" in eb:
+            record["campaign_elastic"]["error"] = eb["error"]
     sv = _serve_bench()
     if sv is not None:
         # Summary only — the full load/chaos record lives in the

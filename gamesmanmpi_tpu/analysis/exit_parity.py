@@ -1,0 +1,181 @@
+"""GM5xx (continued) — campaign exit-code parity.
+
+``resilience/campaign.py``'s death-cause classifier, its
+``CAMPAIGN_EXIT_CODES`` registry, and ``tools/run_campaign.py``'s
+documented "Exit codes:" list are three views of ONE contract: which
+process exit codes the campaign stack knows about. They drift the
+classic way — someone adds a new ``*_EXIT_CODE`` constant (a new death
+shape) and the classifier never learns it, so the death silently
+classifies as ``crash`` and the campaign retries a failure it should
+have degraded around; or the CLI docstring promises an exit code the
+registry no longer produces.
+
+| id | finding |
+|---|---|
+| GM506 | ``*_EXIT_CODE`` constant neither referenced by the campaign ``classify`` function nor registered in ``CAMPAIGN_EXIT_CODES`` — a death that silently classifies as ``crash`` |
+| GM507 | a script's documented "Exit codes:" list disagrees with ``CAMPAIGN_EXIT_CODES`` (either direction) |
+
+Anchors are structural, not path-based: the registry is the
+module-level ``CAMPAIGN_EXIT_CODES`` dict literal (its module also
+holds ``classify``); the documented list is any *script* module (one
+with an ``if __name__ == "__main__"`` guard) whose docstring contains
+an "Exit codes:" section. A project without the registry skips the
+family entirely.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from gamesmanmpi_tpu.analysis.diagnostics import Diagnostic
+from gamesmanmpi_tpu.analysis.project import Project, SourceFile
+
+#: Numbers in an "Exit codes:" sentence look like "0 solved, 2 usage,
+#: 75 campaign preempted": an integer followed by its one-word-or-more
+#: meaning. The section runs to the docstring's next blank line.
+_DOC_SECTION = re.compile(r"[Ee]xit codes?:(?P<body>.*?)(?:\n\s*\n|$)",
+                          re.DOTALL)
+_DOC_CODE = re.compile(r"(?<![\w.])(\d{1,3})\s+(?=[A-Za-z])")
+
+
+def _exit_constants(
+    project: Project,
+) -> Dict[str, Tuple[int, str, int]]:
+    """Every module-level ``NAME_EXIT_CODE = <int>`` in the project:
+    ``{name: (value, rel_path, line)}`` (first definition wins)."""
+    out: Dict[str, Tuple[int, str, int]] = {}
+    for src in project.files:
+        if src.tree is None:
+            continue
+        for node in src.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id.endswith("_EXIT_CODE")
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)
+                and not isinstance(node.value.value, bool)
+            ):
+                out.setdefault(
+                    node.targets[0].id,
+                    (node.value.value, src.rel, node.lineno),
+                )
+    return out
+
+
+def _find_registry(project: Project):
+    """The module-level ``CAMPAIGN_EXIT_CODES = {...}`` dict literal:
+    -> (file, dict_node) or (None, None)."""
+    for src in project.files:
+        if src.tree is None:
+            continue
+        for node in src.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "CAMPAIGN_EXIT_CODES"
+                and isinstance(node.value, ast.Dict)
+            ):
+                return src, node.value
+    return None, None
+
+
+def _classify_refs(src: SourceFile) -> set:
+    """``*_EXIT_CODE`` names referenced anywhere inside the registry
+    module's ``classify`` function (method or plain def)."""
+    refs: set = set()
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "classify":
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.Name) and inner.id.endswith(
+                    "_EXIT_CODE"
+                ):
+                    refs.add(inner.id)
+    return refs
+
+
+def _is_script(src: SourceFile) -> bool:
+    """Does the module run as a process (``if __name__ == "__main__"``
+    at module level)? Process exit codes are a script contract; library
+    docstrings describing return values must not trip GM507."""
+    for node in src.tree.body:
+        if isinstance(node, ast.If):
+            test = ast.dump(node.test)
+            if "__name__" in test and "__main__" in test:
+                return True
+    return False
+
+
+def _documented_codes(src: SourceFile) -> Optional[List[int]]:
+    doc = ast.get_docstring(src.tree, clean=False)
+    if not doc:
+        return None
+    codes: List[int] = []
+    found = False
+    for m in _DOC_SECTION.finditer(doc):
+        found = True
+        for c in _DOC_CODE.findall(m.group("body")):
+            codes.append(int(c))
+    return sorted(set(codes)) if found else None
+
+
+def check(project: Project) -> List[Diagnostic]:
+    reg_src, reg_dict = _find_registry(project)
+    if reg_src is None:
+        return []  # project without a campaign exit-code registry
+    diags: List[Diagnostic] = []
+    constants = _exit_constants(project)
+    classify_refs = _classify_refs(reg_src)
+    reg_names: set = set()
+    reg_values: set = set()
+    for key in reg_dict.keys:
+        if isinstance(key, ast.Name):
+            reg_names.add(key.id)
+            if key.id in constants:
+                reg_values.add(constants[key.id][0])
+        elif isinstance(key, ast.Constant) and isinstance(
+            key.value, int
+        ):
+            reg_values.add(int(key.value))
+    # GM506: a defined exit-code constant no campaign layer knows.
+    for name, (value, rel, line) in sorted(constants.items()):
+        if name in classify_refs or name in reg_names:
+            continue
+        if value in reg_values:
+            continue  # registered by literal value
+        diags.append(Diagnostic(
+            rel, line, "GM506",
+            f"{name} (= {value}) is neither handled by the campaign "
+            "death classifier nor registered in CAMPAIGN_EXIT_CODES — "
+            "an attempt exiting with it silently classifies as "
+            "'crash'",
+        ))
+    # GM507: documented "Exit codes:" lists vs the registry, two-way.
+    for src in project.files:
+        if src.tree is None or not _is_script(src):
+            continue
+        documented = _documented_codes(src)
+        if documented is None:
+            continue
+        for code in documented:
+            if code not in reg_values:
+                diags.append(Diagnostic(
+                    src.rel, 1, "GM507",
+                    f"documented exit code {code} is not in "
+                    "CAMPAIGN_EXIT_CODES — the doc promises a code "
+                    "the campaign never produces (or the registry "
+                    "forgot it)",
+                ))
+        for value in sorted(reg_values):
+            if value not in documented:
+                diags.append(Diagnostic(
+                    reg_src.rel, reg_dict.lineno, "GM507",
+                    f"CAMPAIGN_EXIT_CODES value {value} is missing "
+                    f"from {src.rel}'s documented \"Exit codes:\" "
+                    "list",
+                ))
+    return diags

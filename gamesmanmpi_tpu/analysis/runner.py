@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Tuple
 from gamesmanmpi_tpu.analysis import (
     atomic_write,
     env_parity,
+    exit_parity,
     faults_parity,
     jax_tracing,
     lifecycle,
@@ -41,6 +42,7 @@ CHECKERS = (
     env_parity.check,
     metrics_parity.check,
     faults_parity.check,
+    exit_parity.check,
     spmd.check,
     lifecycle.check,
     atomic_write.check,

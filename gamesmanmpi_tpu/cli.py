@@ -770,6 +770,31 @@ def _solve_main(args, t0: float, logger) -> int:
             # peer is already gone.
             os._exit(GRACE_EXIT_CODE)
         return GRACE_EXIT_CODE
+    except MemoryError as e:
+        # Host allocator exhaustion — the guard's HostMemoryExceeded at
+        # a level boundary, or a real MemoryError mid-level. Either way
+        # the sealed prefix is intact (atomic payload writes, atomic
+        # seals) and the death must CLASSIFY: the "out of memory" /
+        # RESOURCE_EXHAUSTED diagnostics below are what the campaign's
+        # log-tail classifier reads as `oom` before answering with
+        # geometry escalation (docs/DISTRIBUTED.md "Elastic resume").
+        progress = getattr(solver, "progress", {})
+        print(f"out of memory: {e}\nprogress: {progress}",
+              file=sys.stderr)
+        sys.stderr.flush()
+        if logger is not None:
+            logger.log({"phase": "oom", "error": str(e)[:200],
+                        **{("in_phase" if k == "phase" else k): v
+                           for k, v in progress.items()
+                           if isinstance(v, (int, str, float))}})
+            logger.close()
+        import jax
+
+        if jax.process_count() > 1:
+            # Clean exit would block in jax's shutdown barrier while
+            # peers are unwinding through the collective deadline.
+            os._exit(1)
+        return 1
     except CoordinatedAbort as e:
         # The fleet agreed to stop (a peer died, diverged, or timed out):
         # same resumable-abort contract as the watchdog — diagnostics to

@@ -112,10 +112,16 @@ from gamesmanmpi_tpu.resilience.coordination import (
     CoordinationError,
     coordination_from_env,
 )
+from gamesmanmpi_tpu.resilience import memguard
 from gamesmanmpi_tpu.resilience.retry import is_transient, retry_call
 from gamesmanmpi_tpu.resilience.supervisor import maybe_watchdog
 from gamesmanmpi_tpu.store import WriteTicket, default_store
-from gamesmanmpi_tpu.utils.checkpoint import TORN_NPZ_ERRORS
+from gamesmanmpi_tpu.utils.checkpoint import (
+    TORN_NPZ_ERRORS,
+    CheckpointGeometryError,
+    reshard_enabled,
+    reshard_shard_stream,
+)
 from gamesmanmpi_tpu.utils.env import (
     env_float as _env_float,
     env_opt,
@@ -700,6 +706,14 @@ class ShardedSolver:
         self.bytes_gathered = 0
         #: transient level-step failures absorbed by retry (stats field).
         self.retries = 0
+        #: elastic resume (ISSUE 13): shard count the adopted checkpoint
+        #: tree was sealed at when it differs from this run's (None = no
+        #: reshard happened), and how many levels fell back from the
+        #: edge-cached backward because their sealed edge shards carry a
+        #: foreign geometry (edge slot maps cannot re-map — the per-level
+        #: lookup join is the structural fallback).
+        self.resharded_from = None
+        self.edges_geometry_fallback_levels = 0
         #: this process's rank in the multi-process run (0 single-process).
         self.rank = jax.process_index()
         self.num_processes = jax.process_count()
@@ -742,6 +756,12 @@ class ShardedSolver:
         sealed prefix on disk. A CoordinationError here converts to
         CoordinatedAbort via _propose_step — exit 124, still resumable.
         """
+        # Host-memory guard first (ISSUE 13): past the limit this rank
+        # raises HostMemoryExceeded — a clean, classifiable, resumable
+        # death at the boundary instead of a kernel OOM-kill mid-level
+        # (rank-local by design: peers unwind via the collective
+        # deadline, and the campaign's oom policy escalates geometry).
+        memguard.check(phase, level=level, logger=self.logger)
         flagged = preempt.requested()
         if self.coord is not None:
             decision = self._propose_step(
@@ -2168,12 +2188,40 @@ class ShardedSolver:
         pr = np.zeros((S, cap), dtype=np.int32)
         table = None
         manifest = self.checkpointer.load_manifest()
-        if manifest.get("sharded_levels", {}).get(str(k)) == S:
+        sealed_count = manifest.get("sharded_levels", {}).get(str(k))
+        if sealed_count == S or (
+            sealed_count is not None and reshard_enabled()
+        ):
             shards = rec.host_shards()
+            if sealed_count == S:
+                per_shard = [
+                    self.checkpointer.load_level_shard(k, s, manifest)
+                    for s in range(S)
+                ]
+            else:
+                # Reshard-on-resume (ISSUE 13): stream the level sealed
+                # at S_old shards into THIS run's S shards — one sealed
+                # file decoded at a time through the block store, rows
+                # re-partitioned by the owner hash, packed cells riding
+                # along row-aligned. No global table ever assembles
+                # (the pre-elastic path paid load_level's full sort).
+                if hasattr(self.checkpointer, "prefetch_level_shards"):
+                    # Stubbed checkpointers in tests may not expose
+                    # readahead; hints are advisory anyway.
+                    self.checkpointer.prefetch_level_shards(
+                        k, sealed_count, manifest
+                    )
+
+                def _one(s):
+                    st, cells = self.checkpointer.load_level_shard(
+                        k, s, manifest
+                    )
+                    return st.astype(g.state_dtype), cells
+
+                per_shard = reshard_shard_stream(_one, sealed_count, S)
             loaded = []
             for s in range(S):
-                st, cells = self.checkpointer.load_level_shard(k, s,
-                                                               manifest)
+                st, cells = per_shard[s]
                 if st.shape[0] != shards[s].shape[0] or not (
                     st.astype(g.state_dtype) == shards[s]
                 ).all():
@@ -2272,7 +2320,15 @@ class ShardedSolver:
         if self.checkpointer is None:
             return False
         info = self.checkpointer.edge_level_info(k)
-        return bool(info) and info.get("shards") == self.S
+        if info and info.get("shards") != self.S:
+            # Sealed at a foreign shard count: the eidx/slot maps index
+            # into per-owner prefixes that no longer exist at this
+            # geometry and CANNOT re-map — this level degrades to the
+            # lookup backward (the per-level structural fallback), and
+            # the count is the elastic-resume observable.
+            self.edges_geometry_fallback_levels += 1
+            return False
+        return bool(info)
 
     def _load_edges(self, k: int, rec, cap: int):
         """Device-resident (eidx, slot, ecap) of level k's edges, or None.
@@ -2654,6 +2710,36 @@ class ShardedSolver:
         init, start_level = canonical_scalar(g, g.initial_state())
         if self.checkpointer is not None:
             self.checkpointer.bind_game(g.name)
+            # Elastic-resume gate (ISSUE 13): compare the sealed
+            # geometry against this run's ONCE, up front — a mismatch
+            # either becomes an explicit reshard adoption (logged, the
+            # loaders re-partition on read) or, with GAMESMAN_RESHARD=0,
+            # a loud error naming both geometries (never an opaque
+            # abort, never a silent forward re-run). Stubbed
+            # checkpointers in tests may not expose the check.
+            check_geom = getattr(
+                self.checkpointer, "check_resume_geometry", None
+            )
+            if check_geom is not None:
+                try:
+                    geom = check_geom(self.S, self.num_processes)
+                except CheckpointGeometryError as e:
+                    raise SolverError(str(e)) from e
+                if geom["status"] == "reshard":
+                    sealed = geom["sealed"]
+                    self.resharded_from = (
+                        sealed.get("num_shards")
+                        or (sealed["shard_counts"] or [None])[-1]
+                    )
+                    if self.logger is not None:
+                        self.logger.log({
+                            "phase": "reshard",
+                            "from_shards": sealed["shard_counts"],
+                            "from_world": sealed.get("num_processes"),
+                            "to_shards": self.S,
+                            "to_world": self.num_processes,
+                            "epoch": sealed.get("epoch"),
+                        })
             if self.coord is not None:
                 # Rank-consistent resume: every rank independently reads
                 # the manifest and digests its resume state (deepest
@@ -2753,6 +2839,9 @@ class ShardedSolver:
             "spill_retries": self.spill_retries,
             "backward": self.backward_mode,
             "backward_edges_levels": self.backward_edges_levels,
+            "resharded_from": self.resharded_from,
+            "edges_geometry_fallback_levels":
+                self.edges_geometry_fallback_levels,
             "edges_bytes_spilled": self.edges_bytes_spilled,
             "edges_bytes_disk": self.edges_bytes_disk,
             "ckpt_bytes_raw": self.ckpt_bytes_raw,

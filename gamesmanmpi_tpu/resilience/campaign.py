@@ -57,21 +57,66 @@ import sys
 import time
 from typing import Dict, List, Optional
 
+from gamesmanmpi_tpu.obs import default_registry
 from gamesmanmpi_tpu.resilience.preempt import GRACE_EXIT_CODE
 from gamesmanmpi_tpu.resilience.faults import (
     KILL_EXIT_CODE,
     TORN_EXIT_CODE,
 )
 from gamesmanmpi_tpu.resilience.supervisor import WATCHDOG_EXIT_CODE
-from gamesmanmpi_tpu.utils.env import env_float, env_int
+from gamesmanmpi_tpu.utils.env import env_bool, env_float, env_int
 
 #: Campaign exit codes (documented in docs/DISTRIBUTED.md "Campaigns").
+SOLVED_EXIT_CODE = 0
+USAGE_EXIT_CODE = 2
 NO_PROGRESS_EXIT_CODE = 3
 DISK_FLOOR_EXIT_CODE = 4
+
+#: The campaign CLI's COMPLETE exit-code contract. gamesman-lint's
+#: GM506/GM507 exit-code-parity rules hold this registry, the
+#: ``classify`` method below, and ``tools/run_campaign.py``'s
+#: documented "Exit codes:" list in two-way lockstep: an exit code
+#: that none of them knows is a death that silently classifies as
+#: ``crash`` (docs/ANALYSIS.md).
+CAMPAIGN_EXIT_CODES = {
+    SOLVED_EXIT_CODE: "solved",
+    USAGE_EXIT_CODE: "usage",
+    NO_PROGRESS_EXIT_CODE: "no-progress breaker / attempts exhausted",
+    DISK_FLOOR_EXIT_CODE: "disk hard floor",
+    GRACE_EXIT_CODE: "campaign preempted",
+}
 
 #: Log-tail markers that classify a death as disk exhaustion (the
 #: injected ``enospc`` fault kind and the real OSError both match).
 ENOSPC_MARKERS = ("ENOSPC", "No space left on device", "[Errno 28]")
+
+#: Log-tail markers that classify a death as memory exhaustion: the
+#: injected ``oom`` fault kind, the host-memory guard
+#: (resilience/memguard.py), XLA's allocator (RESOURCE_EXHAUSTED), a
+#: bare Python MemoryError, and the glibc/errno spellings. The kernel
+#: OOM-killer's SIGKILL stays ``signal`` — it leaves no tail to read,
+#: which is exactly why the guard exists.
+OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED", "MemoryError", "HostMemoryExceeded",
+    "out of memory", "Out of memory", "Cannot allocate memory",
+    "ENOMEM", "[Errno 12]", "oom-kill",
+)
+
+#: Log-tail marker of parallel/mesh.make_mesh's infeasible-geometry
+#: ValueError ("requested N shards but only M devices"): an oom
+#: escalation that overshot the PHYSICAL device count (on real
+#: hardware the fake-device pin is inert) dies with this in its tail —
+#: the policy reverts the shard escalation instead of crash-looping the
+#: same impossible mesh into the no-progress breaker.
+MESH_INFEASIBLE_MARKER = "shards but only"
+
+#: Death causes that read as "a rank/host was lost" rather than a
+#: deterministic failure: with ``elastic_ranks`` the next attempt
+#: retries the world at W-1 ranks (floor 1) — the checkpoint tree is
+#: world-size-elastic (reshard-on-resume, docs/DISTRIBUTED.md
+#: "Elastic resume"), so shrinking the world beats waiting for a host
+#: that may never come back.
+LOST_RANK_CAUSES = ("killed", "signal", "deadline_abort", "timeout")
 
 #: Bytes of each attempt log kept in the diagnosis bundle.
 LOG_TAIL_BYTES = 4000
@@ -93,6 +138,20 @@ def checkpoint_progress(directory) -> dict:
     forward = set(int(k) for k in manifest.get("forward_levels", []))
     forward |= {int(k) for k in manifest.get("forward_level_shards", {})}
     dense = [int(k) for k in manifest.get("dense_levels", [])]
+    # Sealed geometry (elastic resume): the shard counts the tree's
+    # shard artifacts carry and the last stamped world size — the
+    # campaign's ledger records them per attempt so every geometry
+    # change (reshard adoption, escalation) is auditable. Jax-free
+    # manifest reads, mirroring LevelCheckpointer.sealed_geometry.
+    counts = set()
+    if manifest.get("frontier_shards"):
+        counts.add(int(manifest["frontier_shards"]))
+    for v in manifest.get("forward_level_shards", {}).values():
+        counts.add(int(v))
+    for v in manifest.get("sharded_levels", {}).values():
+        counts.add(int(v))
+    counts.discard(0)
+    run = manifest.get("run", {})
     return {
         "solved_levels": sorted(solved),
         "deepest_solved": max(solved) if solved else None,
@@ -102,7 +161,12 @@ def checkpoint_progress(directory) -> dict:
             or manifest.get("frontier_shards")
         ),
         "dense_levels": len(dense),
-        "epoch": int(manifest.get("run", {}).get("epoch", 0)),
+        "epoch": int(run.get("epoch", 0)),
+        "shard_counts": sorted(counts),
+        "shards": next(iter(counts)) if len(counts) == 1 else None,
+        "num_processes": (
+            int(run["num_processes"]) if "num_processes" in run else None
+        ),
     }
 
 
@@ -159,6 +223,17 @@ class CampaignConfig:
     attempt_timeout_secs: float = None  # type: ignore[assignment]
     disk_soft_mb: float = None  # type: ignore[assignment]
     disk_floor_mb: float = None  # type: ignore[assignment]
+    #: oom death -> escalate geometry for the next attempt: shards
+    #: double (under max_shards) and the store cache halves (to
+    #: cache_floor_mb). The reshard-on-resume loaders make the changed
+    #: geometry a plain resume (docs/DISTRIBUTED.md "Elastic resume").
+    oom_escalate: bool = None  # type: ignore[assignment]
+    max_shards: int = None  # type: ignore[assignment]
+    cache_floor_mb: int = None  # type: ignore[assignment]
+    #: lost-rank death (killed/signal/deadline_abort/timeout) -> retry
+    #: the world at W-1 ranks (floor 1). Opt-in: shrinking a world is a
+    #: policy decision, not a default.
+    elastic_ranks: bool = None  # type: ignore[assignment]
     ledger_path: Optional[str] = None  # default <ckpt>/campaign.jsonl
     log_dir: Optional[str] = None  # default <ckpt>/logs
     #: per-attempt chaos: attempt i (1-based) runs with GAMESMAN_FAULTS
@@ -193,6 +268,20 @@ class CampaignConfig:
             self.disk_floor_mb = env_float(
                 "GAMESMAN_CKPT_DISK_FLOOR_MB", 0.0
             )
+        if self.oom_escalate is None:
+            self.oom_escalate = env_bool(
+                "GAMESMAN_CAMPAIGN_OOM_ESCALATE", True
+            )
+        if self.max_shards is None:
+            self.max_shards = env_int("GAMESMAN_CAMPAIGN_MAX_SHARDS", 64)
+        if self.cache_floor_mb is None:
+            self.cache_floor_mb = env_int(
+                "GAMESMAN_CAMPAIGN_CACHE_FLOOR_MB", 16
+            )
+        if self.elastic_ranks is None:
+            self.elastic_ranks = env_bool(
+                "GAMESMAN_CAMPAIGN_ELASTIC_RANKS", False
+            )
         if self.ledger_path is None:
             self.ledger_path = str(
                 pathlib.Path(self.checkpoint_dir) / "campaign.jsonl"
@@ -226,6 +315,55 @@ class Campaign:
         #: os.kill of the recorded child pids — GM205's contract).
         self._preempted = False
         self._child_pids: List[int] = []
+        #: live attempt geometry (the adaptive-degradation state): the
+        #: policy mutates these between attempts; every change lands on
+        #: the ledger before the next attempt runs with it.
+        self._processes = config.processes
+        self._local_devices = config.local_devices
+        self._shards = self._parse_shards(config.solver_args)
+        self._shards0 = self._shards
+        self._cache_mb: Optional[int] = None  # None = inherit env
+        self._geometry_dirty = False
+
+    # ----------------------------------------------------- geometry args
+
+    @staticmethod
+    def _parse_shards(args) -> Optional[int]:
+        """The solve CLI's ``--devices N`` from the solver args (the
+        sharded engine's shard count), or None — the policy only
+        escalates shard counts it can actually rewrite."""
+        for i, a in enumerate(args):
+            if a == "--devices" and i + 1 < len(args):
+                try:
+                    return int(args[i + 1])
+                except ValueError:
+                    return None
+            if a.startswith("--devices="):
+                try:
+                    return int(a.split("=", 1)[1])
+                except ValueError:
+                    return None
+        return None
+
+    @staticmethod
+    def _rewrite_devices(args: List[str], shards: int) -> List[str]:
+        out = list(args)
+        for i, a in enumerate(out):
+            if a == "--devices" and i + 1 < len(out):
+                out[i + 1] = str(shards)
+                return out
+            if a.startswith("--devices="):
+                out[i] = f"--devices={shards}"
+                return out
+        return out
+
+    def _effective_cache_mb(self) -> int:
+        """The store cache budget the NEXT attempt will run with: the
+        policy's override, else the inherited env/default (mirrors
+        store/blockstore's GAMESMAN_STORE_CACHE_MB default of 256)."""
+        if self._cache_mb is not None:
+            return self._cache_mb
+        return env_int("GAMESMAN_STORE_CACHE_MB", 256)
 
     # ------------------------------------------------------------ signals
 
@@ -274,14 +412,36 @@ class Campaign:
         if attempt <= len(self.cfg.chaos):
             spec = self.cfg.chaos[attempt - 1]
         if spec:
-            if self.cfg.processes > 1:
+            if self._processes > 1:
                 env["GAMESMAN_FAULTS_RANK_0"] = spec
             else:
                 env["GAMESMAN_FAULTS"] = spec
+        if self._cache_mb is not None:
+            # The oom policy's shrunken store-cache budget.
+            env["GAMESMAN_STORE_CACHE_MB"] = str(self._cache_mb)
+        if self._processes == 1 and self.cfg.processes > 1:
+            # Degraded from a world to a single process: a stale
+            # distributed wiring in the inherited env would make the
+            # lone attempt dial a coordinator that no longer exists.
+            for k in ("GAMESMAN_COORDINATOR", "GAMESMAN_NUM_PROCESSES",
+                      "GAMESMAN_PROCESS_ID", "GAMESMAN_COORD_ADDR"):
+                env.pop(k, None)
+        if (self._geometry_dirty and self._processes == 1
+                and self._shards):
+            # Escalated single-process attempts must actually HAVE the
+            # new shard count's devices: pin the fake-device count and
+            # drop an inherited XLA_FLAGS whose stale
+            # host_platform_device_count would win over it (same
+            # leak-prevention as launch_multihost's child env).
+            env.pop("XLA_FLAGS", None)
+            env["GAMESMAN_FAKE_DEVICES"] = str(self._shards)
         return env
 
     def _solver_args(self) -> List[str]:
-        return list(self.cfg.solver_args) + [
+        args = list(self.cfg.solver_args)
+        if self._shards is not None and self._shards != self._shards0:
+            args = self._rewrite_devices(args, self._shards)
+        return args + [
             "--checkpoint-dir", str(self.cfg.checkpoint_dir),
         ]
 
@@ -291,7 +451,7 @@ class Campaign:
         means the attempt timeout killed a straggler."""
         t0 = time.monotonic()
         timeout = self.cfg.attempt_timeout_secs or None
-        if self.cfg.processes > 1:
+        if self._processes > 1:
             out = self._run_attempt_world(attempt, timeout)
         else:
             out = self._run_attempt_single(attempt, timeout)
@@ -341,11 +501,11 @@ class Campaign:
         env = self._attempt_env(attempt)
         world = start_world(
             self._solver_args(),
-            processes=self.cfg.processes,
+            processes=self._processes,
             log_dir=str(pathlib.Path(self.cfg.log_dir)
                         / f"attempt_{attempt:03d}"),
             env=env,
-            local_devices=self.cfg.local_devices,
+            local_devices=self._local_devices,
         )
         self._child_pids.extend(world.pids())
         results = None
@@ -383,6 +543,12 @@ class Campaign:
         tails = " ".join(log_tails.values())
         if any(m in tails for m in ENOSPC_MARKERS):
             return "enospc"
+        if any(m in tails for m in OOM_MARKERS):
+            # Memory exhaustion — the injected `oom` kind, the
+            # host-memory guard, XLA's RESOURCE_EXHAUSTED, or a bare
+            # MemoryError. A degradable death: the oom policy escalates
+            # geometry (S->2S, smaller cache) for the next attempt.
+            return "oom"
         codes = set(rcs.values())
         # Injected deaths first: in a mixed world (rank 0 SIGKILLed,
         # peers exit 124 through the coordinated abort) the CAUSE is the
@@ -402,6 +568,136 @@ class Campaign:
         if any(rc is not None and rc < 0 for rc in codes):
             return "signal"
         return "crash"
+
+    # ------------------------------------------------- adaptive geometry
+
+    def _maybe_revert_shards(self, cause: str, tails: str,
+                             attempt: int) -> None:
+        """An ESCALATED attempt that died at mesh construction
+        (``requested N shards but only M devices``) asked for a
+        geometry this host cannot provide — e.g. real hardware, where
+        GAMESMAN_FAKE_DEVICES cannot conjure devices. Step the shard
+        escalation back down (never below the original request) so the
+        campaign retries a feasible geometry; the shrunken cache is
+        kept — it is the half of the oom answer that is always
+        legal."""
+        if cause != "crash" or not self._shards or not self._shards0:
+            return
+        if self._shards <= self._shards0:
+            return
+        if MESH_INFEASIBLE_MARKER not in tails:
+            return
+        prev = self._shards
+        self._shards = max(self._shards0, self._shards // 2)
+        self.ledger.log({
+            "phase": "campaign_reshard",
+            "attempt": attempt,
+            "cause": "infeasible",
+            "from_shards": prev,
+            "to_shards": self._shards,
+            "from_cache_mb": self._effective_cache_mb(),
+            "to_cache_mb": self._effective_cache_mb(),
+            "processes": self._processes,
+        })
+        default_registry().counter(
+            "gamesman_campaign_reshards_total",
+            "attempt-geometry escalations (shards/cache) between "
+            "campaign attempts",
+        ).inc()
+        default_registry().counter(
+            "gamesman_campaign_degrade_total",
+            "graceful campaign degradations by kind",
+            kind="infeasible",
+        ).inc()
+        self.echo(
+            f"[campaign] escalated geometry is infeasible on this "
+            f"host: reverting shards {prev}->{self._shards}"
+        )
+
+    def _apply_policy(self, cause: str, attempt: int) -> None:
+        """Graceful degradation between attempts (ISSUE 13): an ``oom``
+        death escalates geometry — shards double (under
+        ``max_shards``), the store cache halves (to ``cache_floor_mb``)
+        — and a lost-rank death (opt-in ``elastic_ranks``) retries the
+        world at W-1 ranks. The reshard-on-resume loaders make every
+        change a plain resume; every change is a ledger record and a
+        ``gamesman_campaign_*`` counter BEFORE the next attempt runs
+        with it."""
+        if cause == "oom" and self.cfg.oom_escalate:
+            from_shards = self._shards
+            from_cache = self._effective_cache_mb()
+            changed = False
+            if self._shards and self._shards * 2 <= self.cfg.max_shards:
+                self._shards *= 2
+                if self._processes > 1:
+                    # The world must still be able to host the mesh:
+                    # ceil(S / W) fake devices per rank.
+                    self._local_devices = max(
+                        int(self._local_devices or 1),
+                        -(-self._shards // self._processes),
+                    )
+                changed = True
+            new_cache = max(self.cfg.cache_floor_mb, from_cache // 2)
+            if new_cache < from_cache:
+                self._cache_mb = new_cache
+                changed = True
+            if not changed:
+                return  # already at the ceiling/floor: plain retry
+            self._geometry_dirty = True
+            self.ledger.log({
+                "phase": "campaign_reshard",
+                "attempt": attempt,
+                "cause": cause,
+                "from_shards": from_shards,
+                "to_shards": self._shards,
+                "from_cache_mb": from_cache,
+                "to_cache_mb": self._effective_cache_mb(),
+                "processes": self._processes,
+            })
+            default_registry().counter(
+                "gamesman_campaign_reshards_total",
+                "attempt-geometry escalations (shards/cache) between "
+                "campaign attempts",
+            ).inc()
+            default_registry().counter(
+                "gamesman_campaign_degrade_total",
+                "graceful campaign degradations by kind",
+                kind="oom",
+            ).inc()
+            self.echo(
+                f"[campaign] oom: escalating geometry for the next "
+                f"attempt (shards {from_shards}->{self._shards}, "
+                f"store cache {from_cache}->"
+                f"{self._effective_cache_mb()} MB)"
+            )
+        elif (cause in LOST_RANK_CAUSES and self.cfg.elastic_ranks
+                and self._processes > 1):
+            from_processes = self._processes
+            self._processes -= 1
+            if self._shards:
+                self._local_devices = max(
+                    int(self._local_devices or 1),
+                    -(-self._shards // self._processes),
+                )
+            self._geometry_dirty = True
+            self.ledger.log({
+                "phase": "campaign_degrade",
+                "attempt": attempt,
+                "kind": "lost_rank",
+                "cause": cause,
+                "from_processes": from_processes,
+                "to_processes": self._processes,
+                "shards": self._shards,
+            })
+            default_registry().counter(
+                "gamesman_campaign_degrade_total",
+                "graceful campaign degradations by kind",
+                kind="lost_rank",
+            ).inc()
+            self.echo(
+                f"[campaign] lost rank ({cause}): retrying at "
+                f"{self._processes} rank(s)"
+            )
 
     # ------------------------------------------------------------- disk
 
@@ -469,6 +765,14 @@ class Campaign:
             "attempts": attempt,
             "checkpoint_dir": str(self.cfg.checkpoint_dir),
             "progress": checkpoint_progress(self.cfg.checkpoint_dir),
+            # Geometry at abort time: the sealed tree's shape vs what
+            # the final attempt ran with — a mismatch the operator can
+            # read directly instead of reverse-engineering from logs.
+            "geometry": {
+                "attempt_shards": self._shards,
+                "attempt_processes": self._processes,
+                "cache_mb": self._cache_mb,
+            },
             "quarantine": [
                 {"file": p.name, "bytes": p.stat().st_size}
                 for p in sorted(
@@ -548,6 +852,14 @@ class Campaign:
                     "solved_before": len(before["solved_levels"]),
                     "solved_after": len(after["solved_levels"]),
                     "forward_after": after["forward_levels"],
+                    # Attempt geometry (elastic resume): what this
+                    # attempt ran with vs what the tree was sealed at
+                    # going in — a sealed_shards != shards row IS a
+                    # reshard adoption, auditable from the ledger alone.
+                    "shards": self._shards,
+                    "processes": self._processes,
+                    "cache_mb": self._cache_mb,
+                    "sealed_shards": before.get("shards"),
                 })
                 if cause == "complete":
                     self.ledger.log({
@@ -573,6 +885,10 @@ class Campaign:
                     return GRACE_EXIT_CODE
                 if cause == "enospc":
                     self._check_disk(had_enospc=True)
+                self._maybe_revert_shards(
+                    cause, " ".join(last["log_tails"].values()), attempt
+                )
+                self._apply_policy(cause, attempt)
                 if progressed:
                     no_progress = 0
                 else:

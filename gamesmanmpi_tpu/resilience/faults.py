@@ -32,7 +32,13 @@ Grammar (``GAMESMAN_FAULTS``, comma-separated directives)::
     transient (retrying a full disk fills it again), so the solve fails
     fast with the checkpoint prefix intact — exactly a torn write's
     degrade path — and the campaign supervisor answers with
-    GC-and-retry (resilience/campaign.py).
+    GC-and-retry (resilience/campaign.py);
+  - ``oom`` — raise ``MemoryError`` (host allocator exhaustion; the
+    message carries ``RESOURCE_EXHAUSTED`` so the campaign's log-tail
+    death classifier lands on ``oom``): never transient — an OOM at a
+    fixed shape OOMs again — so the solve fails fast, prefix intact,
+    and the campaign answers with geometry escalation (more shards,
+    smaller store cache; resilience/campaign.py).
 
 * ``when`` — which visit fires (the schedule, always replayable):
 
@@ -162,7 +168,7 @@ def _parse_directive(text: str) -> _Directive:
         )
     kind, _, argtxt = parts[1].strip().partition("=")
     if kind not in ("transient", "fatal", "delay", "kill", "torn",
-                    "enospc"):
+                    "enospc", "oom"):
         raise ValueError(f"unknown fault kind {kind!r} in {text!r}")
     arg = float(argtxt) if argtxt else None
     when = _parse_when(parts[2].strip()) if len(parts) == 3 else 1
@@ -219,6 +225,10 @@ def _inject(d: _Directive, point: str, path, ctx: dict) -> None:
             errno.ENOSPC,
             f"No space left on device (injected at {where})",
             str(path) if path is not None else None,
+        )
+    if d.kind == "oom":
+        raise MemoryError(
+            f"injected oom (RESOURCE_EXHAUSTED: out of memory) at {where}"
         )
     if d.kind == "torn":
         if path is not None and os.path.exists(path):

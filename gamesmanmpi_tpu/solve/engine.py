@@ -81,7 +81,7 @@ from gamesmanmpi_tpu.ops.provenance import dedup_provenance, gather_cells
 from gamesmanmpi_tpu.ops.padding import MIN_BUCKET, bucket_size, pad_to, pad_to_bucket
 from gamesmanmpi_tpu.obs import Heartbeat, Span, default_registry, trace_span
 from gamesmanmpi_tpu.resilience import faults
-from gamesmanmpi_tpu.resilience import preempt
+from gamesmanmpi_tpu.resilience import memguard, preempt
 from gamesmanmpi_tpu.resilience.retry import retry_call
 from gamesmanmpi_tpu.resilience.supervisor import maybe_watchdog
 from gamesmanmpi_tpu.solve.precompile import global_precompiler, sds
@@ -896,6 +896,7 @@ class Solver:
             # (save_frontier_level is eager), so a grace signal stops
             # HERE and the next run resumes expansion from level k.
             preempt.check("forward", level=k, logger=self.logger)
+            memguard.check("forward", level=k, logger=self.logger)
             cap = frontier.shape[0]
             spec = spec_input = None
             if speculate:
@@ -1080,6 +1081,7 @@ class Solver:
             n = rec.n
             self.progress = {"phase": "backward", "level": k, "n": n}
             preempt.check("backward", level=k, logger=self.logger)
+            memguard.check("backward", level=k, logger=self.logger)
             C = common[k]
             if rec.dev is not None:
                 states_dev = rec.dev
@@ -1244,6 +1246,7 @@ class Solver:
                 "frontier": int(frontier.shape[0]),
             }
             preempt.check("forward", level=k, logger=self.logger)
+            memguard.check("forward", level=k, logger=self.logger)
             padded = pad_to_bucket(frontier, self.min_bucket)
             uniq, levels, count = self._fwd_generic(padded.shape[0])(
                 jnp.asarray(padded)
@@ -1323,6 +1326,7 @@ class Solver:
             n = states.shape[0]
             self.progress = {"phase": "backward", "level": k, "n": int(n)}
             preempt.check("backward", level=k, logger=self.logger)
+            memguard.check("backward", level=k, logger=self.logger)
             from_checkpoint = k in completed
             lvl_sort_bytes = lvl_gather_bytes = 0
             table = None

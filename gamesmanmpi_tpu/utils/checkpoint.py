@@ -55,6 +55,81 @@ def _verify_enabled() -> bool:
     )
 
 
+def reshard_enabled() -> bool:
+    """GAMESMAN_RESHARD (default on): may a resume adopt a checkpoint
+    tree sealed at a DIFFERENT geometry (shard count, world size) by
+    re-partitioning rows through the owner hash on load? Off pins
+    resume to the sealed geometry — any mismatch raises
+    :class:`CheckpointGeometryError` naming both geometries instead of
+    silently adapting (or silently re-running forward from the root,
+    the pre-elastic behavior)."""
+    return env_str("GAMESMAN_RESHARD", "1") not in ("0", "off", "false")
+
+
+class CheckpointGeometryError(ValueError):
+    """A checkpoint tree's sealed geometry cannot (or — with
+    GAMESMAN_RESHARD=0 — may not) serve the requested solve geometry.
+    The message names the sealed vs requested (shards, world, epoch)
+    so an operator never diagnoses an opaque resume abort."""
+
+
+def repartition_rows(states, num_shards: int, *payloads):
+    """Bucket one shard's rows by the owner hash at ``num_shards``.
+
+    The elastic-resume primitive: ``states`` (any sorted or unsorted
+    slice of the hash-partitioned space) splits into ``num_shards``
+    buckets by the SAME splitmix64 owner hash the live solve routes
+    with, and every ``payloads`` column stays row-aligned through the
+    split. Returns ``[(states_t, *payloads_t) for t in range(S')]``
+    with input row order preserved inside each bucket.
+    """
+    from gamesmanmpi_tpu.core.hashing import owner_shard_np
+
+    states = np.asarray(states)
+    payloads = tuple(np.asarray(p) for p in payloads)
+    owners = owner_shard_np(states, num_shards)
+    out = []
+    for t in range(num_shards):
+        sel = owners == t
+        out.append((states[sel],) + tuple(p[sel] for p in payloads))
+    return out
+
+
+def reshard_shard_stream(load_shard, old_count: int, new_count: int):
+    """Streamed shard-set re-partitioner: one sealed artifact set at S
+    shards becomes per-shard arrays at S' shards.
+
+    ``load_shard(s) -> (states, *payloads)`` pulls ONE old shard at a
+    time (the callers pass the block-store-served sealed readers, so
+    decoded-file residency is one old shard; the output — one level at
+    the new geometry — is the caller's to hold, exactly what it was
+    about to keep resident anyway). Rows bucket by the owner hash at
+    ``new_count`` and each new shard's columns are sorted by state —
+    the per-shard sorted invariant every consumer relies on. Payload
+    columns stay row-aligned through both the partition and the sort.
+    """
+    frags: list = [[] for _ in range(new_count)]
+    width = None
+    for s in range(old_count):
+        arrs = load_shard(s)
+        if not isinstance(arrs, tuple):
+            arrs = (arrs,)
+        width = len(arrs)
+        for t, part in enumerate(
+            repartition_rows(arrs[0], new_count, *arrs[1:])
+        ):
+            frags[t].append(part)
+    out = []
+    for t in range(new_count):
+        cols = [
+            np.concatenate([f[i] for f in frags[t]])
+            for i in range(width or 1)
+        ]
+        order = np.argsort(cols[0], kind="stable")
+        out.append(tuple(c[order] for c in cols))
+    return out
+
+
 def _block_candidates(name: str, arr: np.ndarray):
     """Codec candidates by member shape (compress/codecs): sorted state
     arrays delta-code, packed uint32 cells split value/remoteness, and
@@ -363,7 +438,15 @@ class LevelCheckpointer:
         the frontier snapshots, and the run epoch. Every rank computes
         it independently and barriers on it — agreement means the ranks
         share one view of the checkpoint directory; divergence aborts
-        the fleet before any rank loads a different prefix."""
+        the fleet before any rank loads a different prefix.
+
+        Geometry normalization (elastic resume): with GAMESMAN_RESHARD
+        on (the default) the digest covers the DIRECTORY's sealed state
+        only — the requested shard count drops out — so a W'-rank /
+        S'-shard world can adopt a W-rank tree after the consistency
+        barrier and reshard on load. With resharding pinned off the
+        requested geometry stays in the digest (the legacy strict
+        view)."""
         import hashlib
 
         manifest = self.load_manifest()
@@ -377,10 +460,85 @@ class LevelCheckpointer:
             "frontiers": bool(manifest.get("frontiers")),
             "edges": sorted(manifest.get("edge_levels", {})),
             "epoch": manifest.get("run", {}).get("epoch", 0),
-            "num_shards": num_shards,
+            "num_shards": None if reshard_enabled() else num_shards,
         }
         blob = json.dumps(view, sort_keys=True).encode()
         return hashlib.sha1(blob).hexdigest()
+
+    def sealed_geometry(self, manifest=None) -> dict:
+        """The geometry this tree's sealed shard artifacts were written
+        at: ``{"shard_counts": sorted list of every sealed shard count
+        (mixed trees happen mid-reshard), "num_shards": the single
+        count or None when mixed/none, "num_processes": world size of
+        the last stamped run (None pre-distributed), "epoch": run
+        epoch}``. Global (non-shard) artifacts are geometry-free and do
+        not participate; neither do sealed EDGE shards — their slot
+        geometry never reshards (a foreign-count edge level takes the
+        per-level lookup fallback structurally, pre-dating elasticity),
+        so a stale consumed edge set must not hold the whole tree's
+        geometry status hostage. This keeps the view in lockstep with
+        the campaign's jax-free twin (``checkpoint_progress``)."""
+        if manifest is None:
+            manifest = self.load_manifest()
+        counts = set()
+        if manifest.get("frontier_shards"):
+            counts.add(int(manifest["frontier_shards"]))
+        for v in manifest.get("forward_level_shards", {}).values():
+            counts.add(int(v))
+        for v in manifest.get("sharded_levels", {}).values():
+            counts.add(int(v))
+        counts.discard(0)
+        run = manifest.get("run", {})
+        return {
+            "shard_counts": sorted(counts),
+            "num_shards": (
+                next(iter(counts)) if len(counts) == 1 else None
+            ),
+            "num_processes": (
+                int(run["num_processes"]) if "num_processes" in run
+                else None
+            ),
+            "epoch": int(run.get("epoch", 0)),
+        }
+
+    def check_resume_geometry(self, num_shards: int,
+                              num_processes: int = 1) -> dict:
+        """The elastic-resume gate, called once at solve start: compare
+        the sealed geometry against the requested one. Returns
+        ``{"status": "fresh" | "match" | "reshard", "sealed": {...},
+        "requested": {...}}`` — ``reshard`` means the loaders will
+        re-partition rows on load (and sealed edge shards fall back to
+        the per-level lookup backward). With GAMESMAN_RESHARD=0 any
+        mismatch raises :class:`CheckpointGeometryError` NAMING both
+        geometries — never an opaque abort, never a silent forward
+        re-run."""
+        sealed = self.sealed_geometry()
+        requested = {
+            "num_shards": int(num_shards),
+            "num_processes": int(num_processes),
+        }
+        if not sealed["shard_counts"]:
+            return {"status": "fresh", "sealed": sealed,
+                    "requested": requested}
+        shards_match = sealed["shard_counts"] == [int(num_shards)]
+        world_match = sealed["num_processes"] in (None,
+                                                 int(num_processes))
+        if shards_match and world_match:
+            return {"status": "match", "sealed": sealed,
+                    "requested": requested}
+        if not reshard_enabled():
+            raise CheckpointGeometryError(
+                f"checkpoint {self.dir} is sealed at "
+                f"shards={sealed['shard_counts']} "
+                f"world={sealed['num_processes']} "
+                f"epoch={sealed['epoch']} but this solve requested "
+                f"shards={num_shards} world={num_processes}, and "
+                "GAMESMAN_RESHARD=0 pins resume to the sealed "
+                "geometry — rerun with the sealed geometry, or unset "
+                "GAMESMAN_RESHARD to reshard on load"
+            )
+        return {"status": "reshard", "sealed": sealed,
+                "requested": requested}
 
     def bind_game(self, name: str) -> None:
         """Record/validate which game this directory belongs to.
@@ -735,6 +893,11 @@ class LevelCheckpointer:
         is contiguous-from-root): the run degrades to the longest
         rank-consistent prefix and re-expands from its deepest level.
 
+        Each dropped level's files are enumerated at ITS OWN sealed
+        shard count (``num_shards`` is only the fallback for records
+        missing one) — a mid-reshard tree legitimately seals adjacent
+        levels at different counts.
+
         Idempotent and concurrency-tolerant: under multi-process resume
         EVERY rank walks the same torn directory (the resume-digest
         barrier runs before loads, but the tear itself is discovered
@@ -747,9 +910,10 @@ class LevelCheckpointer:
         rec = manifest.get("forward_level_shards", {})
         dropped = [k for k in rec if int(k) >= level]
         for k in dropped:
+            sealed_count = int(rec.get(k) or num_shards)
             rec.pop(k, None)
             manifest.get("forward_seals", {}).pop(k, None)
-            for s in range(num_shards):
+            for s in range(sealed_count):
                 p = self.dir / f"frontier_{int(k):04d}.shard_{s:04d}.npz"
                 if int(k) == level and p.exists():
                     try:
@@ -760,21 +924,38 @@ class LevelCheckpointer:
         self._write_manifest(manifest)
 
     def load_forward_level_shards(self, num_shards: int) -> dict:
-        """-> {level: [per-shard arrays]} of every sealed forward level, a
-        (possibly partial) discovery prefix; {} when none exist or any
-        level was sealed at a different shard count (shard-to-shard resume
-        only — a changed mesh re-runs forward)."""
+        """-> {level: [per-shard arrays at ``num_shards``]} of every
+        sealed forward level, a (possibly partial) discovery prefix; {}
+        when none exist.
+
+        Elastic resume (ISSUE 13): a level sealed at a DIFFERENT shard
+        count re-partitions through the owner hash on load (streamed —
+        one sealed shard file decoded at a time through the block
+        store), per level, so a mid-reshard tree with mixed counts
+        resumes too. With GAMESMAN_RESHARD=0 a mismatched level raises
+        :class:`CheckpointGeometryError` naming the sealed vs requested
+        geometry (the pre-elastic behavior silently re-ran forward from
+        the root — an opaque loss of hours at big-run scale)."""
         manifest = self.load_manifest()
         rec = manifest.get("forward_level_shards", {})
         out: dict = {}
-        if any(rec[k] != num_shards for k in rec):
-            return {}
+        mismatched = sorted(
+            {int(rec[k]) for k in rec if int(rec[k]) != num_shards}
+        )
+        if mismatched and not reshard_enabled():
+            geom = self.sealed_geometry(manifest)
+            raise CheckpointGeometryError(
+                f"forward checkpoint levels in {self.dir} are sealed at "
+                f"shards={mismatched} (epoch {geom['epoch']}) but this "
+                f"solve requested shards={num_shards}, and "
+                "GAMESMAN_RESHARD=0 pins resume to the sealed geometry"
+            )
         # Batched readahead over the WHOLE prefix before the first read:
         # resume loads are the serial head of a solve, and the prefetch
         # pool decodes level j+1's shards while level j's arrays are
-        # consumed.
+        # consumed. Hints follow each level's OWN sealed count.
         for k in sorted(rec, key=int):
-            for s in range(num_shards):
+            for s in range(int(rec[k])):
                 self._hint_npz(
                     self.dir / f"frontier_{int(k):04d}.shard_{s:04d}.npz",
                     ("states",), manifest,
@@ -783,14 +964,24 @@ class LevelCheckpointer:
         # only a contiguous-from-root prefix, so a torn level truncates
         # there — everything below it is still a valid (shorter) resume.
         for k in sorted(rec, key=int):
-            arrs = []
+            sealed_count = int(rec[k])
+
+            def _one(s, k=k):
+                path = self.dir / (
+                    f"frontier_{int(k):04d}.shard_{s:04d}.npz"
+                )
+                (states,) = self._read_npz(path, ("states",), manifest)
+                return states
+
             try:
-                for s in range(num_shards):
-                    path = self.dir / (
-                        f"frontier_{int(k):04d}.shard_{s:04d}.npz"
-                    )
-                    (states,) = self._read_npz(path, ("states",), manifest)
-                    arrs.append(states)
+                if sealed_count == num_shards:
+                    arrs = [_one(s) for s in range(num_shards)]
+                else:
+                    arrs = [
+                        part[0] for part in reshard_shard_stream(
+                            _one, sealed_count, num_shards
+                        )
+                    ]
             except TORN_NPZ_ERRORS:
                 # Torn or crc-mismatching per-rank file (a death between
                 # unlink and manifest write in an older layout, a
@@ -800,7 +991,7 @@ class LevelCheckpointer:
                 # quarantine this level and keep the intact prefix below
                 # it — at big-run scale the prefix is hours of
                 # re-discovery — and re-run forward from its deepest.
-                self._quarantine_forward_shard_level(int(k), num_shards)
+                self._quarantine_forward_shard_level(int(k), sealed_count)
                 break
             out[int(k)] = arrs
         return out
@@ -840,22 +1031,60 @@ class LevelCheckpointer:
         self._write_manifest(manifest)
 
     def load_frontier_shards(self, num_shards: int):
-        """-> {level: [per-shard arrays]} when saved with num_shards, else
-        None (caller falls back to load_frontiers + repartition)."""
+        """-> {level: [per-shard arrays at ``num_shards``]} from the
+        consolidated per-shard snapshot, or None when no snapshot
+        exists (caller falls back to load_frontiers).
+
+        Elastic resume: a snapshot sealed at a different shard count
+        re-partitions on load — STREAMED, one sealed shard file (all
+        its levels) decoded at a time through the block store, never a
+        global frontier assembly (the single-host-TB bottleneck the
+        per-shard layout exists to avoid). With GAMESMAN_RESHARD=0 a
+        mismatch raises :class:`CheckpointGeometryError` naming both
+        geometries."""
         manifest = self.load_manifest()
-        if manifest.get("frontier_shards") != num_shards:
+        sealed_count = manifest.get("frontier_shards")
+        if sealed_count is None:
             return None
+        sealed_count = int(sealed_count)
+        if sealed_count != num_shards and not reshard_enabled():
+            geom = self.sealed_geometry(manifest)
+            raise CheckpointGeometryError(
+                f"frontier snapshot in {self.dir} is sealed at "
+                f"shards={sealed_count} (epoch {geom['epoch']}) but "
+                f"this solve requested shards={num_shards}, and "
+                "GAMESMAN_RESHARD=0 pins resume to the sealed geometry"
+            )
         paths = [self.dir / f"frontiers.shard_{s:04d}.npz"
-                 for s in range(num_shards)]
+                 for s in range(sealed_count)]
         for path in paths:  # batched readahead before the first read
             self._hint_npz(path, None, manifest)
-        out: dict = {}
-        for s, path in enumerate(paths):
+        if sealed_count == num_shards:
+            out: dict = {}
+            for s, path in enumerate(paths):
+                members = self._read_npz(path, None, manifest)
+                for name, arr in members.items():
+                    k = int(name.split("_")[1])
+                    out.setdefault(k, [None] * num_shards)[s] = arr
+            return out
+        # Reshard-on-resume: bucket each old shard's per-level rows by
+        # the owner hash at the new count, then sort each new shard's
+        # concatenated fragments (per-shard sorted is the engine
+        # invariant; fragments are disjoint, so the sort is a merge).
+        frags: dict = {}
+        for path in paths:
             members = self._read_npz(path, None, manifest)
             for name, arr in members.items():
                 k = int(name.split("_")[1])
-                out.setdefault(k, [None] * num_shards)[s] = arr
-        return out
+                tgt = frags.setdefault(k, [[] for _ in range(num_shards)])
+                for t, (part,) in enumerate(
+                    repartition_rows(arr, num_shards)
+                ):
+                    tgt[t].append(part)
+        return {
+            k: [np.sort(np.concatenate(f)) for f in per_new]
+            for k, per_new in frags.items()
+        }
 
     # ------------------------------------------- disk budget (ISSUE 12)
     # The campaign regime's third failure class is disk exhaustion: at

@@ -190,6 +190,40 @@ def test_bench_campaign_artifact(tmp_path):
 
 
 @pytest.mark.slow
+def test_bench_campaign_elastic_artifact(tmp_path):
+    """BENCH_CAMPAIGN_ELASTIC=1 (ISSUE 13): a solve SIGKILLed at 4
+    shards is resumed by a campaign at 2 shards (reshard adoption on
+    the ledger), and an injected-oom campaign auto-escalates 2->4
+    shards to completion — both byte-parity vs an uninterrupted solve.
+    Tiny config here; the committed artifacts/CAMPAIGN_r13.json is the
+    5x4 acceptance run of the same code path."""
+    out = tmp_path / "BENCH_campaign_elastic.json"
+    record, _ = _run_bench({
+        "BENCH_ENGINE": "classic",
+        "BENCH_CAMPAIGN_ELASTIC": "1",
+        "BENCH_CAMPAIGN_ELASTIC_GAME": "connect4:w=3,h=3,connect=3",
+        "BENCH_CAMPAIGN_ELASTIC_SHARDS": "2",
+        "BENCH_CAMPAIGN_ELASTIC_SEAL_SHARDS": "4",
+        "BENCH_CAMPAIGN_ELASTIC_OOM_SHARDS": "2",
+        "BENCH_CAMPAIGN_ELASTIC_OUT": str(out),
+    }, timeout=900)
+    eb = record["campaign_elastic"]
+    artifact = json.loads(out.read_text())
+    assert eb["ok"] is True, json.dumps(artifact)[:2000]
+    assert eb["reshard"]["sealed_shards"] == 4
+    assert eb["reshard"]["attempt_shards"] == 2
+    assert eb["reshard"]["parity_ok"] is True
+    assert eb["oom"]["causes"][0] == "oom"
+    assert eb["oom"]["causes"][-1] == "complete"
+    assert eb["oom"]["escalations"][0]["from_shards"] == 2
+    assert eb["oom"]["escalations"][0]["to_shards"] == 4
+    assert eb["oom"]["parity_ok"] is True
+    # Both scenario ledgers ride the artifact, auditable end to end.
+    assert any(r.get("phase") == "campaign_reshard"
+               for r in artifact["oom"]["ledger"])
+
+
+@pytest.mark.slow
 def test_bench_db_compress_artifact(tmp_path):
     """BENCH_DB_COMPRESS=1 (ISSUE 9): the bench additionally solves a
     board once, exports it v1 AND block-compressed v2, proves the two

@@ -206,6 +206,160 @@ def test_classify_causes():
     assert c({0: 1}, {"a": "traceback"}) == "crash"
 
 
+def test_classify_oom_markers():
+    """Every oom shape the stack can die with classifies as `oom`: the
+    injected fault kind, the host-memory guard, the CLI's clean
+    diagnostics, XLA's allocator, a bare MemoryError, and glibc/errno
+    spellings. A SIGKILL with an empty tail stays `signal` (the kernel
+    OOM-killer leaves nothing to read — the guard exists for that)."""
+    c = Campaign.classify
+    for tail in (
+        "MemoryError: injected oom (RESOURCE_EXHAUSTED: out of memory)",
+        "HostMemoryExceeded: host RSS 900 MiB exceeds",
+        "out of memory: host RSS 900 MiB exceeds ... progress: {}",
+        "jaxlib.xla_extension.XlaRuntimeError: RESOURCE_EXHAUSTED: "
+        "Out of memory allocating 1073741824 bytes",
+        "MemoryError",
+        "OSError: [Errno 12] Cannot allocate memory",
+    ):
+        assert c({0: 1}, {"a": tail}) == "oom", tail
+    assert c({0: -9}, {}) == "signal"
+
+
+# ------------------------------------------ adaptive geometry (tier-1)
+
+
+def _policy_campaign(tmp_path, solver_args, **cfg_kw):
+    from gamesmanmpi_tpu.resilience.campaign import CampaignConfig
+
+    cfg = CampaignConfig(
+        solver_args=solver_args,
+        checkpoint_dir=str(tmp_path / "ck"),
+        **cfg_kw,
+    )
+    return Campaign(cfg, echo=lambda m: None), cfg
+
+
+def test_oom_policy_escalates_shards_and_shrinks_cache(tmp_path):
+    """oom -> S doubles (under the cap) and the store cache halves (to
+    the floor); the rewritten --devices, the env override, and the
+    ledger records all agree."""
+    camp, cfg = _policy_campaign(
+        tmp_path, [_C3, "--devices", "2"],
+        max_shards=8, cache_floor_mb=32,
+    )
+    assert camp._parse_shards(["x", "--devices=4"]) == 4
+    assert camp._parse_shards(["x"]) is None
+    camp._apply_policy("oom", 1)
+    assert camp._shards == 4
+    args = camp._solver_args()
+    assert args[args.index("--devices") + 1] == "4"
+    env = camp._attempt_env(2)
+    assert env["GAMESMAN_FAKE_DEVICES"] == "4"
+    assert int(env["GAMESMAN_STORE_CACHE_MB"]) < 256
+    camp._apply_policy("oom", 2)
+    assert camp._shards == 8
+    camp._apply_policy("oom", 3)  # at the cap: only the cache can move
+    assert camp._shards == 8
+    assert camp._cache_mb == 32  # floored
+    records = _ledger(cfg.ledger_path)
+    assert all(r["phase"] == "campaign_reshard" for r in records)
+    assert records[0]["from_shards"] == 2
+    assert records[0]["to_shards"] == 4
+    assert records[0]["to_cache_mb"] < records[0]["from_cache_mb"]
+
+
+def test_oom_policy_respects_opt_out_and_missing_devices(tmp_path):
+    camp, cfg = _policy_campaign(
+        tmp_path, [_C3, "--devices", "2"], oom_escalate=False,
+    )
+    camp._apply_policy("oom", 1)
+    assert camp._shards == 2 and camp._cache_mb is None
+    assert not os.path.exists(cfg.ledger_path)
+    # No --devices: only the cache shrinks (a single-device engine
+    # cannot be resharded into existence).
+    camp2, cfg2 = _policy_campaign(tmp_path, ["tictactoe"])
+    camp2._apply_policy("oom", 1)
+    assert camp2._shards is None
+    assert camp2._cache_mb is not None
+    records = _ledger(cfg2.ledger_path)
+    assert records[0]["from_shards"] is None
+
+
+def test_lost_rank_policy_is_opt_in_and_steps_world_down(tmp_path):
+    camp, cfg = _policy_campaign(
+        tmp_path, [_C3, "--devices", "4"],
+        processes=3, local_devices=2, elastic_ranks=True,
+    )
+    camp._apply_policy("killed", 1)
+    assert camp._processes == 2
+    assert camp._local_devices == 2  # ceil(4/2)
+    camp._apply_policy("deadline_abort", 2)
+    assert camp._processes == 1
+    assert camp._local_devices == 4  # ceil(4/1)
+    camp._apply_policy("signal", 3)
+    assert camp._processes == 1  # floor
+    env = camp._attempt_env(4)
+    assert "GAMESMAN_NUM_PROCESSES" not in env  # stale wiring dropped
+    records = _ledger(cfg.ledger_path)
+    assert [r["kind"] for r in records] == ["lost_rank", "lost_rank"]
+    assert records[0]["from_processes"] == 3
+    # default: off
+    camp2, _ = _policy_campaign(tmp_path, [_C3], processes=2)
+    camp2._apply_policy("killed", 1)
+    assert camp2._processes == 2
+
+
+def test_infeasible_escalation_reverts_shards(tmp_path):
+    """An escalated attempt dying at mesh construction ('requested N
+    shards but only M devices' — real hardware, where fake devices
+    cannot be conjured) steps the shard count back down instead of
+    crash-looping the impossible mesh into the breaker; the shrunken
+    cache stays (always legal), and the original request is the
+    floor."""
+    camp, cfg = _policy_campaign(tmp_path, [_C3, "--devices", "2"])
+    camp._apply_policy("oom", 1)
+    camp._apply_policy("oom", 2)
+    assert camp._shards == 8
+    tail = ("ValueError: requested 8 shards but only 4 devices")
+    camp._maybe_revert_shards("crash", tail, 3)
+    assert camp._shards == 4
+    assert camp._cache_mb is not None  # the cache shrink is kept
+    camp._maybe_revert_shards("crash", tail, 4)
+    assert camp._shards == 2  # floor: the original request
+    camp._maybe_revert_shards("crash", tail, 5)
+    assert camp._shards == 2
+    # Unrelated crashes / unescalated campaigns never revert.
+    camp2, _ = _policy_campaign(tmp_path, [_C3, "--devices", "2"])
+    camp2._maybe_revert_shards("crash", tail, 1)
+    assert camp2._shards == 2
+    camp._apply_policy("oom", 6)
+    before = camp._shards
+    camp._maybe_revert_shards("crash", "unrelated traceback", 7)
+    assert camp._shards == before
+    records = _ledger(cfg.ledger_path)
+    reverts = [r for r in records if r.get("cause") == "infeasible"]
+    assert [r["from_shards"] for r in reverts] == [8, 4]
+    assert [r["to_shards"] for r in reverts] == [4, 2]
+
+
+def test_checkpoint_progress_reports_sealed_geometry(tmp_path):
+    """checkpoint_progress carries the sealed geometry the ledger's
+    per-attempt sealed_shards field reads (jax-free manifest walk)."""
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+
+    assert checkpoint_progress(tmp_path / "nope")["shards"] is None
+    ck = LevelCheckpointer(tmp_path / "ck")
+    faults.configure("sharded.backward:fatal:2")
+    with pytest.raises(faults.FatalFault):
+        ShardedSolver(get_game(_C3), num_shards=2,
+                      checkpointer=ck).solve()
+    faults.clear()
+    p = checkpoint_progress(tmp_path / "ck")
+    assert p["shards"] == 2 and p["shard_counts"] == [2]
+    assert p["num_processes"] == 1
+
+
 # ------------------------------------------------- retention GC (tier-1)
 
 
@@ -465,3 +619,109 @@ def test_campaign_sigterm_preempts_and_is_resumable(tmp_path):
     ])
     assert out.returncode == 0, out.stderr[-2000:]
     _assert_tables_equal(out_table, golden)
+
+
+# ------------------------------------------- elastic campaigns (slow)
+
+
+@pytest.mark.slow
+def test_campaign_oom_escalates_geometry_to_completion(tmp_path):
+    """The oom acceptance shape: attempt 1 (S=2) dies on an injected
+    oom, the policy escalates to S=4 with a halved store cache, attempt
+    2 adopts the S=2 tree by reshard-on-resume and completes — table
+    byte-parity with an uninterrupted solve, every geometry change on
+    the ledger, zero operator input."""
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+
+    golden = tmp_path / "golden.npz"
+    save_result_npz(
+        golden, ShardedSolver(get_game(_C3), num_shards=2).solve()
+    )
+    ck = tmp_path / "ck"
+    out_table = tmp_path / "resumed.npz"
+    out = _run_campaign([
+        _C3, "--checkpoint-dir", str(ck),
+        "--chaos", "sharded.backward:oom:2",
+        "--", "--devices", "2", "--table-out", str(out_table),
+    ])
+    assert out.returncode == 0, out.stderr[-3000:]
+    records = _ledger(ck / "campaign.jsonl")
+    attempts = [r for r in records if r["phase"] == "campaign_attempt"]
+    assert [a["cause"] for a in attempts] == ["oom", "complete"]
+    assert attempts[0]["shards"] == 2
+    assert attempts[1]["shards"] == 4
+    assert attempts[1]["sealed_shards"] == 2  # reshard adoption, on ledger
+    assert attempts[1]["cache_mb"] == 128
+    reshards = [r for r in records if r["phase"] == "campaign_reshard"]
+    assert len(reshards) == 1
+    assert reshards[0]["from_shards"] == 2
+    assert reshards[0]["to_shards"] == 4
+    _assert_tables_equal(out_table, golden)
+
+
+@pytest.mark.slow
+def test_campaign_adopts_foreign_shard_count(tmp_path):
+    """A tree sealed by a DIFFERENT geometry's run (S=4, SIGKILLed
+    mid-backward) is driven to completion by a campaign at S=2: the
+    first attempt IS a reshard adoption (sealed_shards=4 on the
+    ledger), table byte-parity."""
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+
+    golden = tmp_path / "golden.npz"
+    save_result_npz(
+        golden, ShardedSolver(get_game(_C3), num_shards=2).solve()
+    )
+    ck = tmp_path / "ck"
+    env = dict(os.environ)
+    env["GAMESMAN_PLATFORM"] = "cpu"
+    env["GAMESMAN_FAULTS"] = "sharded.backward:kill:2"
+    killed = subprocess.run(
+        [sys.executable, "-m", "gamesmanmpi_tpu.cli", _C3,
+         "--devices", "4", "--checkpoint-dir", str(ck)],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=str(REPO),
+    )
+    assert killed.returncode == KILL_EXIT_CODE, killed.stderr[-2000:]
+    out_table = tmp_path / "resumed.npz"
+    out = _run_campaign([
+        _C3, "--checkpoint-dir", str(ck),
+        "--", "--devices", "2", "--table-out", str(out_table),
+    ])
+    assert out.returncode == 0, out.stderr[-3000:]
+    records = _ledger(ck / "campaign.jsonl")
+    attempts = [r for r in records if r["phase"] == "campaign_attempt"]
+    assert attempts[0]["sealed_shards"] == 4
+    assert attempts[0]["shards"] == 2
+    assert attempts[-1]["cause"] == "complete"
+    _assert_tables_equal(out_table, golden)
+
+
+@pytest.mark.slow
+def test_campaign_elastic_ranks_world_shrinks_to_one(tmp_path):
+    """--elastic-ranks: a 2-process world killed on rank 0 retries as a
+    SINGLE process (W-1), which adopts the world's tree and completes."""
+    ck = tmp_path / "ck"
+    out = _run_campaign(
+        [_C3, "--checkpoint-dir", str(ck), "--processes", "2",
+         "--elastic-ranks",
+         "--chaos", "sharded.forward:kill:3",
+         "--", "--devices", "4"],
+        extra_env={"GAMESMAN_BARRIER_SECS": "10",
+                   "GAMESMAN_COLLECTIVE_TIMEOUT": "60"},
+    )
+    logs = " ".join(
+        p.read_text(errors="replace")
+        for p in (ck / "logs").rglob("rank*.err")
+    )
+    if _NO_BACKEND in logs:
+        pytest.skip("backend cannot run multiprocess collectives")
+    assert out.returncode == 0, out.stderr[-3000:]
+    records = _ledger(ck / "campaign.jsonl")
+    attempts = [r for r in records if r["phase"] == "campaign_attempt"]
+    assert attempts[0]["cause"] == "killed"
+    assert attempts[0]["processes"] == 2
+    degrades = [r for r in records if r["phase"] == "campaign_degrade"]
+    assert degrades and degrades[0]["kind"] == "lost_rank"
+    assert degrades[0]["to_processes"] == 1
+    assert attempts[-1]["cause"] == "complete"
+    assert attempts[-1]["processes"] == 1

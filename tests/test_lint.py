@@ -681,6 +681,122 @@ def test_gm505_dynamic_fire_point(tmp_path):
     assert got == [("GM505", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
 
 
+# -------------------------------------- GM506/GM507: exit-code parity
+
+
+def _campaign_module(extra="", registry=None):
+    """A minimal campaign module: attempt-death constants, a classify
+    that names them, and the CAMPAIGN_EXIT_CODES registry."""
+    registry = registry if registry is not None else """
+        CAMPAIGN_EXIT_CODES = {
+            0: "solved",
+            2: "usage",
+            BREAKER_EXIT_CODE: "breaker",
+        }
+    """
+    return """
+        KILL_EXIT_CODE = 77
+        BREAKER_EXIT_CODE = 3
+    """ + extra + registry + """
+
+        class Campaign:
+            @staticmethod
+            def classify(rcs):
+                if KILL_EXIT_CODE in set(rcs.values()):
+                    return "killed"
+                return "crash"
+    """
+
+
+def test_gm506_unclassified_exit_code(tmp_path):
+    """A new *_EXIT_CODE constant the classifier never learned and the
+    registry never named: a death that silently classifies as crash."""
+    build_project(tmp_path, {
+        "campaign.py": _campaign_module(),
+        "newfail.py": """
+            ROT_EXIT_CODE = 99  # MARK
+        """,
+    })
+    _, got = findings(tmp_path)
+    assert got == [
+        ("GM506", "pkg/newfail.py", mark_line(tmp_path, "pkg/newfail.py"))
+    ]
+
+
+def test_gm506_clean_when_classified_or_registered(tmp_path):
+    """Constants referenced by classify() OR registered (by name or by
+    literal value) in CAMPAIGN_EXIT_CODES are covered."""
+    build_project(tmp_path, {
+        "campaign.py": _campaign_module(extra="""
+        USAGE_EXIT_CODE = 2
+    """)})
+    _, got = findings(tmp_path)
+    assert got == []
+
+
+def test_gm507_documented_exit_codes_two_way(tmp_path):
+    """A script's "Exit codes:" docstring list must match the registry
+    both ways: a phantom documented code AND a registry value the doc
+    omits each flag."""
+    build_project(tmp_path, {
+        "campaign.py": _campaign_module(),
+        "run.py": '''
+            """Driver.
+
+            Exit codes: 0 solved, 9 mystery.
+            """
+
+            if __name__ == "__main__":
+                pass
+        ''',
+    })
+    _, got = findings(tmp_path)
+    ids = sorted((d[0], d[1]) for d in got)
+    # 9 documented-but-unregistered (on the script), 2 and 3
+    # registered-but-undocumented (on the registry).
+    assert ids == [
+        ("GM507", "pkg/campaign.py"),
+        ("GM507", "pkg/campaign.py"),
+        ("GM507", "pkg/run.py"),
+    ]
+
+
+def test_gm507_clean_script_and_library_docstring_exempt(tmp_path):
+    """A matching script list passes; a LIBRARY module describing
+    return codes (no __main__ guard) never participates."""
+    build_project(tmp_path, {
+        "campaign.py": _campaign_module(),
+        "run.py": '''
+            """Driver.
+
+            Exit codes: 0 solved, 2 usage, 3 breaker budget.
+            """
+
+            if __name__ == "__main__":
+                pass
+        ''',
+        "lib.py": '''
+            """Library helper.
+
+            Exit codes: 0 solved, 9 library-only lore.
+            """
+
+            def f():
+                return 0
+        ''',
+    })
+    _, got = findings(tmp_path)
+    assert got == []
+
+
+def test_gm506_skips_projects_without_registry(tmp_path):
+    build_project(tmp_path, {"mod.py": """
+        SOME_EXIT_CODE = 5
+    """})
+    _, got = findings(tmp_path)
+    assert got == []
+
+
 # ------------------------------------------------- GM6xx: SPMD safety
 
 

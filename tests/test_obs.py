@@ -533,6 +533,44 @@ def test_obs_report_campaign_summary(tmp_path, capsys):
                                "reason": "breaker", "code": 3}]
     lines = obs_report.summarize_campaign(records)
     assert lines and "ABORTED (breaker)" in lines[0]
+    # Geometry-free ledgers (PR 12 vintage) stay one line.
+    assert len(lines) == 1
+
+
+def test_obs_report_campaign_geometry_columns(tmp_path):
+    """ISSUE 13 satellite: attempt geometry (shards/ranks/cache-MB),
+    reshard count, and degrade causes from the ledger — with `!`
+    marking a reshard adoption (sealed_shards != shards going in)."""
+    obs_report = load_module(REPO / "tools" / "obs_report.py")
+    records = [
+        {"phase": "campaign_start", "processes": 1},
+        {"phase": "campaign_attempt", "attempt": 1, "cause": "oom",
+         "rcs": {"0": 1}, "wall_secs": 3.0, "resume_level": None,
+         "progressed": True, "shards": 2, "processes": 1,
+         "cache_mb": None, "sealed_shards": None},
+        {"phase": "campaign_reshard", "attempt": 1, "cause": "oom",
+         "from_shards": 2, "to_shards": 4, "from_cache_mb": 256,
+         "to_cache_mb": 128, "processes": 1},
+        {"phase": "campaign_degrade", "attempt": 2, "kind": "lost_rank",
+         "cause": "killed", "from_processes": 2, "to_processes": 1},
+        {"phase": "campaign_attempt", "attempt": 2, "cause": "complete",
+         "rcs": {"0": 0}, "wall_secs": 5.0, "resume_level": 7,
+         "progressed": True, "shards": 4, "processes": 1,
+         "cache_mb": 128, "sealed_shards": 2},
+        {"phase": "campaign_done", "attempts": 2, "wall_secs": 9.0},
+    ]
+    lines = obs_report.summarize_campaign(records)
+    assert len(lines) == 2
+    geom = lines[1]
+    assert geom.startswith("campaign geometry:")
+    assert "a1:S=2/W=1" in geom
+    assert "a2:S=4!/W=1/cache=128MB" in geom  # ! = reshard adoption
+    assert "reshards=1" in geom
+    assert "degrades=lost_rank:1,oom:1" in geom
+    # The new ledger phases stay out of the aux noise.
+    report = obs_report.report(records)
+    assert "campaign_reshard" not in report
+    assert "campaign_degrade" not in report
 
 
 @pytest.mark.smoke

@@ -1,0 +1,333 @@
+"""Elastic resume (ISSUE 13): reshard-on-resume parity.
+
+The shard-count-invariance contract (same tables for 1 and N shards,
+`parallel/sharded.py`) extended ACROSS resume: a solve killed at S
+shards must resume at S' shards — and at a different world size — to
+the byte-identical table, because checkpoint geometry is a resume-time
+choice, not a seal-time life sentence (docs/DISTRIBUTED.md "Elastic
+resume").
+
+Axes:
+
+* unit matrix for the row re-partitioner itself (tier-1 fast, pure
+  numpy): buckets recombine exactly, payload columns stay row-aligned,
+  reshard(old->new) equals partitioning the global array at new
+  directly;
+* in-process resume parity (tier-1): fatal faults mid-forward /
+  mid-backward at S=2, resumed at S' in {1, 4} — reshard adoption is
+  observable (`resharded_from`), sealed edge shards degrade the level
+  to the lookup backward, and the table matches the uninterrupted
+  solve;
+* strict mode: GAMESMAN_RESHARD=0 raises a geometry error NAMING the
+  sealed vs requested geometry instead of adapting;
+* whole-process matrix (slow): connect4 4x4 at S=8 SIGKILLed at a
+  forward, backward, and mid-write-behind point, resumed at S' in
+  {4, 16} and at W=2->1, `--table-out` byte parity throughout.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from gamesmanmpi_tpu.core.hashing import owner_shard_np
+from gamesmanmpi_tpu.games import get_game
+from gamesmanmpi_tpu.parallel import ShardedSolver
+from gamesmanmpi_tpu.resilience import faults
+from gamesmanmpi_tpu.solve import Solver
+from gamesmanmpi_tpu.solve.engine import SolverError
+from gamesmanmpi_tpu.utils.checkpoint import (
+    LevelCheckpointer,
+    _loadz,
+    repartition_rows,
+    reshard_shard_stream,
+    save_result_npz,
+)
+
+from helpers import REPO, full_table
+
+_CLI = [sys.executable, "-m", "gamesmanmpi_tpu.cli"]
+_C3 = "connect4:w=3,h=3,connect=3"
+_C4 = "connect4:w=4,h=4"
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def c3_clean():
+    return Solver(get_game(_C3)).solve()
+
+
+# ------------------------------------------------ re-partitioner (unit)
+
+
+def _keys(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return np.unique(rng.randint(0, 2 ** 62, n).astype(np.uint64))
+
+
+@pytest.mark.parametrize("new_s", [1, 2, 3, 4, 8, 16])
+def test_repartition_rows_recombines_exactly(new_s):
+    states = _keys(999)
+    payload = (states % np.uint64(251)).astype(np.int32)
+    parts = repartition_rows(states, new_s, payload)
+    assert sum(p[0].shape[0] for p in parts) == states.shape[0]
+    for t, (st, pl) in enumerate(parts):
+        assert (owner_shard_np(st, new_s) == t).all()
+        # payload stays row-aligned through the partition
+        assert np.array_equal(pl, (st % np.uint64(251)).astype(np.int32))
+        assert st.dtype == states.dtype
+    assert np.array_equal(
+        np.sort(np.concatenate([p[0] for p in parts])), states
+    )
+
+
+@pytest.mark.parametrize("old_s", [1, 2, 3, 8])
+@pytest.mark.parametrize("new_s", [1, 2, 4, 16])
+def test_reshard_stream_matches_direct_partition(old_s, new_s):
+    """reshard(old -> new) == partitioning the global sorted array at
+    new directly: per-shard sorted, payload aligned, nothing dropped."""
+    states = _keys(1234, seed=old_s * 31 + new_s)
+    payload = (states * np.uint64(3)).astype(np.uint64)
+    old = repartition_rows(states, old_s, payload)
+    out = reshard_shard_stream(lambda s: old[s], old_s, new_s)
+    direct = repartition_rows(states, new_s, payload)
+    assert len(out) == new_s
+    for t in range(new_s):
+        assert np.array_equal(out[t][0], direct[t][0])  # already sorted
+        assert np.array_equal(out[t][1], direct[t][1])
+        assert (np.diff(out[t][0].astype(np.uint64)) > 0).all() \
+            or out[t][0].shape[0] <= 1
+
+
+def test_reshard_stream_bare_arrays():
+    """Frontier files carry a single states member — load_shard may
+    return a bare array, not a tuple."""
+    states = _keys(500)
+    old = [p[0] for p in repartition_rows(states, 4)]
+    out = reshard_shard_stream(lambda s: old[s], 4, 2)
+    direct = repartition_rows(states, 2)
+    for t in range(2):
+        assert np.array_equal(out[t][0], direct[t][0])
+
+
+# ------------------------------------------- in-process resume (tier-1)
+
+
+@pytest.mark.parametrize("new_s", [1, 4])
+def test_backward_reshard_resume_parity(tmp_path, c3_clean, new_s):
+    """Killed mid-backward at S=2 (consolidated frontier + some solved
+    levels + edge shards sealed at 2), resumed at S': the frontier and
+    solved levels reshard on load, the foreign-geometry edge shards
+    degrade those levels to the lookup backward, and the table matches
+    the uninterrupted solve byte for byte."""
+    ck = LevelCheckpointer(tmp_path / "ck")
+    faults.configure("sharded.backward:fatal:3")
+    with pytest.raises(faults.FatalFault):
+        ShardedSolver(get_game(_C3), num_shards=2,
+                      checkpointer=ck).solve()
+    faults.clear()
+    resumed = ShardedSolver(
+        get_game(_C3), num_shards=new_s,
+        checkpointer=LevelCheckpointer(tmp_path / "ck"),
+    ).solve()
+    assert resumed.stats["resharded_from"] == 2
+    if resumed.stats["backward"] == "edges":
+        # The unsolved levels' edges were sealed at S=2: every one of
+        # them must have taken the structural lookup fallback.
+        assert resumed.stats["edges_geometry_fallback_levels"] >= 1
+    assert full_table(resumed) == full_table(c3_clean)
+
+
+def test_forward_prefix_reshard_resume_parity(tmp_path, c3_clean):
+    """Killed mid-forward at S=2 (a partial per-level forward prefix),
+    resumed at S=4: the prefix reshards per level, expansion continues
+    from its deepest, and the solve reaches parity. The resumed run's
+    own forward seals land at S=4 — a MIXED-count tree — which a second
+    resume (at S=8) must also adopt."""
+    ck = LevelCheckpointer(tmp_path / "ck")
+    faults.configure("sharded.forward:fatal:3")
+    with pytest.raises(faults.FatalFault):
+        ShardedSolver(get_game(_C3), num_shards=2,
+                      checkpointer=ck).solve()
+    faults.clear()
+    # Die once more mid-forward at the NEW count: levels 0..1 are now
+    # sealed at 2, deeper levels at 4 — the mixed-count shape.
+    faults.configure("sharded.forward:fatal:5")
+    with pytest.raises(faults.FatalFault):
+        ShardedSolver(get_game(_C3), num_shards=4,
+                      checkpointer=LevelCheckpointer(tmp_path / "ck"),
+                      ).solve()
+    faults.clear()
+    manifest = LevelCheckpointer(tmp_path / "ck").load_manifest()
+    counts = {int(v) for v in
+              manifest.get("forward_level_shards", {}).values()}
+    assert counts == {2, 4}, counts
+    resumed = ShardedSolver(
+        get_game(_C3), num_shards=8,
+        checkpointer=LevelCheckpointer(tmp_path / "ck"),
+    ).solve()
+    assert full_table(resumed) == full_table(c3_clean)
+
+
+def test_reshard_disabled_raises_named_geometry(tmp_path, monkeypatch):
+    """GAMESMAN_RESHARD=0: the mismatch is a loud error naming sealed
+    vs requested (shards, world, epoch) — satellite: no more opaque
+    resume refusals."""
+    ck = LevelCheckpointer(tmp_path / "ck")
+    faults.configure("sharded.backward:fatal:2")
+    with pytest.raises(faults.FatalFault):
+        ShardedSolver(get_game(_C3), num_shards=2,
+                      checkpointer=ck).solve()
+    faults.clear()
+    monkeypatch.setenv("GAMESMAN_RESHARD", "0")
+    with pytest.raises(SolverError) as ei:
+        ShardedSolver(get_game(_C3), num_shards=4,
+                      checkpointer=LevelCheckpointer(tmp_path / "ck"),
+                      ).solve()
+    msg = str(ei.value)
+    assert "sealed at" in msg
+    assert "shards=[2]" in msg and "shards=4" in msg
+    assert "epoch=" in msg and "GAMESMAN_RESHARD" in msg
+    # ...and the default (reshard on) resumes the same tree fine.
+    monkeypatch.delenv("GAMESMAN_RESHARD")
+    resumed = ShardedSolver(
+        get_game(_C3), num_shards=4,
+        checkpointer=LevelCheckpointer(tmp_path / "ck"),
+    ).solve()
+    assert resumed.stats["resharded_from"] == 2
+
+
+def test_sealed_geometry_and_digest_normalization(tmp_path):
+    """sealed_geometry reports the tree's shape; the resume digest is
+    geometry-normalized under reshard mode (a W'/S' world barriers on
+    the same digest a W/S world would), and strict mode keeps the
+    requested count in the digest."""
+    ck = LevelCheckpointer(tmp_path / "ck")
+    assert ck.sealed_geometry()["shard_counts"] == []
+    assert ck.check_resume_geometry(4)["status"] == "fresh"
+    faults.configure("sharded.backward:fatal:2")
+    with pytest.raises(faults.FatalFault):
+        ShardedSolver(get_game(_C3), num_shards=2,
+                      checkpointer=ck).solve()
+    faults.clear()
+    ck2 = LevelCheckpointer(tmp_path / "ck")
+    geom = ck2.sealed_geometry()
+    assert geom["num_shards"] == 2 and geom["shard_counts"] == [2]
+    assert geom["epoch"] >= 1
+    assert ck2.check_resume_geometry(2)["status"] == "match"
+    assert ck2.check_resume_geometry(4)["status"] == "reshard"
+    # Normalized digests: requested geometry drops out.
+    assert ck2.resume_digest(2) == ck2.resume_digest(4)
+    os.environ["GAMESMAN_RESHARD"] = "0"
+    try:
+        assert ck2.resume_digest(2) != ck2.resume_digest(4)
+    finally:
+        del os.environ["GAMESMAN_RESHARD"]
+
+
+# ------------------------------------------- whole-process matrix (slow)
+
+
+def _run_cli(args, extra_env=None, fake_devices=None, timeout=600):
+    env = dict(os.environ)
+    env["GAMESMAN_PLATFORM"] = "cpu"
+    env.pop("GAMESMAN_FAULTS", None)
+    if fake_devices is not None:
+        # The invoking suite's XLA_FLAGS pins 8 host devices and wins
+        # over GAMESMAN_FAKE_DEVICES — drop it so the child really gets
+        # `fake_devices` (16-shard resumes need it).
+        env.pop("XLA_FLAGS", None)
+        env["GAMESMAN_FAKE_DEVICES"] = str(fake_devices)
+    env.update(extra_env or {})
+    return subprocess.run(
+        _CLI + list(args), capture_output=True, text=True,
+        timeout=timeout, env=env, cwd=str(REPO),
+    )
+
+
+def _assert_tables_equal(a, b):
+    with _loadz(a) as za, _loadz(b) as zb:
+        assert sorted(za.files) == sorted(zb.files)
+        for f in za.files:
+            assert np.array_equal(za[f], zb[f]), f
+
+
+@pytest.fixture(scope="module")
+def c4_clean_table(tmp_path_factory):
+    path = tmp_path_factory.mktemp("golden") / "c4.npz"
+    save_result_npz(
+        path, ShardedSolver(get_game(_C4), num_shards=2).solve()
+    )
+    return path
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("point,resume_s", [
+    ("sharded.forward:kill:3", 4),
+    ("sharded.backward:kill:2", 4),
+    ("store.writebehind:kill:1", 16),
+    ("sharded.backward:kill:2", 16),
+])
+def test_chaos_kill_at_s8_resume_elastic(tmp_path, c4_clean_table,
+                                         point, resume_s):
+    """The acceptance matrix: solve at S=8, SIGKILL at a forward /
+    backward / mid-write-behind point, resume at S' in {4, 16} —
+    `--table-out` byte parity with the uninterrupted solve."""
+    ck = tmp_path / "ck"
+    killed = _run_cli(
+        [_C4, "--devices", "8", "--checkpoint-dir", str(ck)],
+        {"GAMESMAN_FAULTS": point}, fake_devices=8,
+    )
+    assert killed.returncode != 0, killed.stdout[-500:]
+    out = tmp_path / "resumed.npz"
+    resumed = _run_cli(
+        [_C4, "--devices", str(resume_s), "--checkpoint-dir", str(ck),
+         "--table-out", str(out)],
+        fake_devices=resume_s,
+    )
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    _assert_tables_equal(out, c4_clean_table)
+
+
+_NO_BACKEND = "Multiprocess computations aren't implemented"
+
+
+@pytest.mark.slow
+def test_chaos_world_shrink_2_to_1_resume_parity(tmp_path,
+                                                 c4_clean_table):
+    """W elasticity: a 2-process world (4 shards) killed on rank 0
+    mid-forward, resumed by a SINGLE process at the same shard count —
+    the W-rank tree adopted after the (normalized) consistency
+    barrier, table byte parity."""
+    if str(REPO) not in sys.path:
+        sys.path.insert(0, str(REPO))
+    from tools.launch_multihost import launch
+
+    ck = tmp_path / "ck"
+    results = launch(
+        [_C4, "--devices", "4", "--checkpoint-dir", str(ck)],
+        processes=2, log_dir=str(tmp_path / "logs"),
+        env={"GAMESMAN_BARRIER_SECS": "20",
+             "GAMESMAN_COLLECTIVE_TIMEOUT": "60"},
+        per_rank_env={0: {"GAMESMAN_FAULTS": "sharded.forward:kill:3"}},
+    )
+    logs = " ".join((r.stderr or "") + (r.stdout or "") for r in results)
+    if _NO_BACKEND in logs:
+        pytest.skip("backend cannot run multiprocess collectives")
+    assert results[0].returncode == faults.KILL_EXIT_CODE, logs[-2000:]
+    out = tmp_path / "resumed.npz"
+    resumed = _run_cli(
+        [_C4, "--devices", "4", "--checkpoint-dir", str(ck),
+         "--table-out", str(out)],
+        fake_devices=4,
+    )
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    _assert_tables_equal(out, c4_clean_table)

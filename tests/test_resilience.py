@@ -1075,6 +1075,81 @@ def test_enospc_fault_kind_fails_fast_prefix_intact(tmp_path, c3_clean):
     assert full_table(resumed) == full_table(c3_clean)
 
 
+def test_oom_fault_kind_fails_fast_prefix_intact(tmp_path, c3_clean):
+    """`oom` at a backward point: MemoryError carrying the
+    RESOURCE_EXHAUSTED marker (the campaign classifier's food), never
+    retried (an OOM at a fixed shape OOMs again), prefix intact,
+    resume to parity — the enospc contract for memory."""
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+
+    ck = LevelCheckpointer(tmp_path / "ck")
+    faults.configure("sharded.backward:oom:2")
+    with pytest.raises(MemoryError) as ei:
+        ShardedSolver(get_game(_C3), num_shards=2,
+                      checkpointer=ck).solve()
+    assert "RESOURCE_EXHAUSTED" in str(ei.value)
+    assert not is_transient(ei.value)  # retrying an OOM is wrong
+    sealed = ck.completed_levels()
+    for k in sealed:
+        ck.load_level(k)  # whatever sealed before the death loads clean
+    faults.clear()
+    resumed = ShardedSolver(
+        get_game(_C3), num_shards=2,
+        checkpointer=LevelCheckpointer(tmp_path / "ck"),
+    ).solve()
+    assert full_table(resumed) == full_table(c3_clean)
+
+
+def test_memguard_trips_at_boundary_and_resume_parity(tmp_path, c3_clean,
+                                                      monkeypatch):
+    """The host-memory guard: past GAMESMAN_HOST_MEM_LIMIT_MB the solve
+    raises HostMemoryExceeded at the NEXT level boundary — prefix
+    sealed, resume (limit lifted) to parity; off by default."""
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+    from gamesmanmpi_tpu.resilience import memguard
+
+    memguard.check("forward", level=0)  # disarmed: no raise
+    ck = LevelCheckpointer(tmp_path / "ck")
+    monkeypatch.setenv("GAMESMAN_HOST_MEM_LIMIT_MB", "1")  # any RSS trips
+    with pytest.raises(memguard.HostMemoryExceeded) as ei:
+        ShardedSolver(get_game(_C3), num_shards=2,
+                      checkpointer=ck).solve()
+    assert "RESOURCE_EXHAUSTED" in str(ei.value)
+    assert not is_transient(ei.value)
+    monkeypatch.delenv("GAMESMAN_HOST_MEM_LIMIT_MB")
+    resumed = ShardedSolver(
+        get_game(_C3), num_shards=2,
+        checkpointer=LevelCheckpointer(tmp_path / "ck"),
+    ).solve()
+    assert full_table(resumed) == full_table(c3_clean)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("point", ["sharded.forward:oom:3",
+                                   "sharded.backward:oom:2"])
+def test_chaos_oom_resumes_parity(tmp_path, c4_clean_table, point):
+    """oom injected in a whole process at a forward and a backward
+    point (the chaos-matrix entries for the `oom` kind): the process
+    dies with classifiable RESOURCE_EXHAUSTED/out-of-memory
+    diagnostics on stderr — what the campaign's death classifier reads
+    as `oom` — and resume reaches byte-parity."""
+    ck = tmp_path / "ck"
+    died = _run_cli(
+        [_C4, "--devices", "2", "--checkpoint-dir", str(ck)],
+        {"GAMESMAN_FAULTS": point},
+    )
+    assert died.returncode != 0
+    assert "out of memory" in died.stderr
+    assert "RESOURCE_EXHAUSTED" in died.stderr
+    out = tmp_path / "resumed.npz"
+    resumed = _run_cli(
+        [_C4, "--devices", "2", "--checkpoint-dir", str(ck),
+         "--table-out", str(out)]
+    )
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    _assert_tables_equal(out, c4_clean_table)
+
+
 @pytest.mark.slow
 def test_chaos_enospc_mid_writebehind_resumes_parity(tmp_path,
                                                      c4_clean_table):

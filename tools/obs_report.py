@@ -265,7 +265,7 @@ def summarize_export(records: list[dict]) -> list[str]:
 _CAMPAIGN_PHASES = (
     "campaign_start", "campaign_attempt", "campaign_backoff",
     "campaign_gc", "campaign_done", "campaign_abort",
-    "campaign_preempted",
+    "campaign_preempted", "campaign_reshard", "campaign_degrade",
 )
 
 
@@ -328,6 +328,44 @@ def summarize_campaign(records: list[dict]) -> list[str]:
         + f" time_lost_restarts={lost:.1f}s backoff={backoff:.1f}s"
         + (f" gc_reclaimed_MB={gc_bytes / 1e6:.1f}" if gc_bytes else "")
     ]
+    # Geometry columns (elastic resume, docs/DISTRIBUTED.md): one cell
+    # per attempt — shards/ranks/cache-MB, with `!` marking a reshard
+    # adoption (the tree was sealed at a different shard count going
+    # in) — plus the reshard count and degrade causes. Emitted only
+    # when the ledger carries geometry (older ledgers stay one line).
+    geom_cells = []
+    for rec in attempts:
+        if not any(rec.get(k) is not None
+                   for k in ("shards", "processes", "cache_mb")):
+            continue
+        sealed = rec.get("sealed_shards")
+        adopted = (sealed is not None and rec.get("shards") is not None
+                   and sealed != rec.get("shards"))
+        geom_cells.append(
+            f"a{rec.get('attempt')}:S={rec.get('shards') or '-'}"
+            + ("!" if adopted else "")
+            + f"/W={rec.get('processes') or '-'}"
+            + (f"/cache={rec['cache_mb']}MB"
+               if rec.get("cache_mb") else "")
+        )
+    reshards = sum(
+        1 for r in records if r.get("phase") == "campaign_reshard"
+    )
+    degrades: dict = {}
+    for r in records:
+        if r.get("phase") == "campaign_reshard":
+            degrades["oom"] = degrades.get("oom", 0) + 1
+        elif r.get("phase") == "campaign_degrade":
+            kind = r.get("kind", "?")
+            degrades[kind] = degrades.get(kind, 0) + 1
+    if geom_cells or reshards or degrades:
+        lines.append(
+            "campaign geometry: " + " ".join(geom_cells)
+            + f" reshards={reshards}"
+            + (" degrades=" + ",".join(
+                f"{k}:{v}" for k, v in sorted(degrades.items())
+            ) if degrades else "")
+        )
     return lines
 
 

@@ -8,6 +8,15 @@ checkpoint with bounded backoff, a no-progress breaker aborts with a
 diagnosis bundle, ENOSPC degrades to GC-and-retry, and every attempt is
 a fsync'd line in the append-only campaign ledger.
 
+Elastic resume (docs/DISTRIBUTED.md "Elastic resume"): checkpoint
+geometry is a resume-time choice — an attempt may run at a different
+shard count or world size than the tree was sealed at (rows re-
+partition through the owner hash on load). The campaign exploits it:
+an oom-classified death escalates geometry for the next attempt
+(--devices doubles under --max-shards, the store cache halves to
+--cache-floor-mb), and with --elastic-ranks a lost-rank death retries
+the world at W-1 ranks. Every geometry change is a ledger record.
+
 Examples::
 
     # the ROADMAP item 1 staging ladder, one rung:
@@ -91,6 +100,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log-dir", default=None,
                    help="per-attempt solve logs (default "
                    "<checkpoint-dir>/logs)")
+    p.add_argument("--elastic-ranks", action="store_true", default=None,
+                   help="retry a lost-rank death (killed/signal/"
+                   "deadline-abort/timeout) at W-1 ranks, floor 1 — the "
+                   "checkpoint tree is world-size-elastic (env "
+                   "GAMESMAN_CAMPAIGN_ELASTIC_RANKS, default off)")
+    p.add_argument("--no-oom-escalate", action="store_true",
+                   help="disable the oom policy (an oom-classified "
+                   "death otherwise doubles --devices under the "
+                   "shard cap and halves GAMESMAN_STORE_CACHE_MB for "
+                   "the next attempt; env "
+                   "GAMESMAN_CAMPAIGN_OOM_ESCALATE, default on)")
+    p.add_argument("--max-shards", type=int, default=None,
+                   help="ceiling for oom shard escalation (env "
+                   "GAMESMAN_CAMPAIGN_MAX_SHARDS, default 64)")
+    p.add_argument("--cache-floor-mb", type=int, default=None,
+                   help="floor for oom store-cache shrinking (env "
+                   "GAMESMAN_CAMPAIGN_CACHE_FLOOR_MB, default 16)")
     p.add_argument("--chaos", action="append", default=None,
                    metavar="SPEC",
                    help="GAMESMAN_FAULTS spec armed for attempt i "
@@ -136,6 +162,10 @@ def main(argv=None) -> int:
         attempt_timeout_secs=args.attempt_timeout,
         disk_soft_mb=args.disk_soft_mb,
         disk_floor_mb=args.disk_floor_mb,
+        oom_escalate=False if args.no_oom_escalate else None,
+        max_shards=args.max_shards,
+        cache_floor_mb=args.cache_floor_mb,
+        elastic_ranks=args.elastic_ranks,
         ledger_path=args.ledger,
         log_dir=args.log_dir,
         chaos=list(args.chaos or []),
