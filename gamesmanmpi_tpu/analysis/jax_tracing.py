@@ -64,6 +64,15 @@ _TRACE_WRAPPERS = {"shard_map", "pallas_call", "checkpoint", "remat",
 #: that gets jitted (solve/engine.get_kernel / schedule_kernel).
 _BUILDER_FUNNELS = {"get_kernel", "schedule_kernel"}
 
+#: Host-callback funnels: a function passed into these from traced code
+#: runs on the HOST with concrete numpy arrays, not tracers — its numpy
+#: calls, branches and host syncs are the whole point (the fused dedup's
+#: np.unique callback, compat/shim's scalar-game lifts). Without this
+#: exemption the callback rule below would re-enqueue those bodies as
+#: traced and flag every np.* call in them (GM105 false positives on the
+#: ISSUE 14 fused kernels).
+_HOST_CALLBACK_FUNNELS = {"pure_callback", "io_callback", "debug_callback"}
+
 #: Per-module cap on (function, taint-set) walks — a loop breaker, set
 #: far above what any real module needs.
 _MAX_WALKS = 4000
@@ -599,7 +608,8 @@ class _TaintWalker:
 
         # --- propagation into local functions ----------------------------
         scope = self.fn
-        is_funnel = last in _BUILDER_FUNNELS
+        is_funnel = last in _BUILDER_FUNNELS \
+            or last in _HOST_CALLBACK_FUNNELS
         if isinstance(node.func, ast.Name):
             target = self.mod.scopes.resolve(scope, node.func.id)
             if target is not None:

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from gamesmanmpi_tpu.core.bitops import popcount, msb_index
 from gamesmanmpi_tpu.core.values import LOSE, TIE, UNDECIDED
 from gamesmanmpi_tpu.games.base import TensorGame
+from gamesmanmpi_tpu.utils.env import env_bool
 
 
 class Connect4(TensorGame):
@@ -65,6 +66,33 @@ class Connect4(TensorGame):
         self._one = dt(1)
         # {vertical, diag down, horizontal, diag up} strides.
         self._dirs = tuple(dt(d) for d in (1, height, h1, height + 2))
+        # Whole-word guard extraction (the Ludii-style bitboard fast path,
+        # arXiv 2111.02839): masks for the leak-killed down-smear in
+        # _decompose. Shifting the whole word right by i moves a column's
+        # bottom bits into the column BELOW it; every such leak lands at
+        # in-column offset >= h1-i, while every legitimate smear landing
+        # (source offset <= h, the guard) stays < h1-i — so one mask per
+        # shift distance separates them exactly.
+        self._bitboard = env_bool("GAMESMAN_C4_BITBOARD", True)
+        self._smear_keep = {}
+        i = 1
+        while i <= height:
+            self._smear_keep[i] = dt(
+                sum(((1 << (h1 - i)) - 1) << (c * h1) for c in range(width))
+            )
+            i <<= 1
+        if 1 not in self._smear_keep:  # height 1: smear loop never runs
+            self._smear_keep[1] = dt(
+                sum(((1 << (h1 - 1)) - 1) << (c * h1) for c in range(width))
+            )
+
+    @property
+    def cache_key(self):
+        # The bitboard flag changes the traced programs; without it in the
+        # key an env flip mid-process would reuse kernels lowered the other
+        # way (the exact staleness the lowering-tuple convention prevents).
+        return (type(self).__qualname__, self.name, self.state_bits,
+                self._bitboard)
 
     def initial_state(self):
         return self._bottom_mask
@@ -91,7 +119,34 @@ class Connect4(TensorGame):
         return jnp.minimum(states, self._mirror(states))
 
     def _decompose(self, states):
-        """-> (guards, filled, current, opponent) bitboards for a [B] batch."""
+        """-> (guards, filled, current, opponent) bitboards for a [B] batch.
+
+        Bitboard fast path (default): all columns' guards are extracted in
+        one masked down-smear over the whole word — log2(height) shift+
+        and+or passes — instead of a per-column msb loop (width x ~5 ops).
+        The smear fills every position at or below each column's msb; the
+        per-shift masks kill cross-column leaks exactly (see __init__).
+        A contiguous run xored with its own 1-shift leaves only the top
+        bit, which per column IS the guard. GAMESMAN_C4_BITBOARD=0 keeps
+        the per-column loop for A/B (tests assert bit-equality of both).
+        """
+        if not self._bitboard:
+            return self._decompose_loop(states)
+        dt = self.state_dtype
+        smear = states
+        i = 1
+        while i <= self.height:
+            smear = smear | ((smear >> dt(i)) & self._smear_keep[i])
+            i <<= 1
+        guards = smear ^ ((smear >> dt(1)) & self._smear_keep[1])
+        filled = smear ^ guards
+        current = states ^ guards
+        opponent = filled ^ current
+        return guards, filled, current, opponent
+
+    def _decompose_loop(self, states):
+        """Per-column reference decompose (the pre-ISSUE-14 kernel): kept
+        as the parity oracle for the bitboard fast path."""
         dt = self.state_dtype
         guards = jnp.zeros(states.shape, dtype=dt)
         filled = jnp.zeros(states.shape, dtype=dt)

@@ -169,6 +169,29 @@ def sort_with_payload(keys, payload, merge: bool | None = None):
     return merge_sort(keys, payload)
 
 
+def sort_rank(x, merge: bool | None = None):
+    """Key sort that also reports where every input slot landed.
+
+    Returns (sorted [N], rank_back [N] int32): rank_back[j] is the index
+    of input slot j within the sorted output. One (key, origin) pair sort
+    plus one permutation-inverting scatter — the sorted origin column is a
+    permutation of iota, so `zeros.at[origin].set(iota)` inverts it in one
+    O(n) pass. This is the fused half of the rank/sort+dedup kernel
+    (ops/fused.fused_dedup_provenance 'scatterinv'): dedup_provenance
+    reconstructs the same mapping with a SECOND (origin, uid) pair sort,
+    i.e. a full extra ~log2(n)-pass network of HBM traffic per level.
+
+    merge: sort-backend flag, resolved at BUILD time by kernel builders
+    (see sort1).
+    """
+    import jax
+
+    origin = jax.lax.iota(jnp.int32, x.shape[0])
+    s, o = sort_with_payload(x, origin, merge)
+    rank_back = jnp.zeros_like(origin).at[o].set(origin)
+    return s, rank_back
+
+
 def merge_sort(x, *payloads):
     """Sort [N] keys ascending (with optional same-length payloads carried).
 

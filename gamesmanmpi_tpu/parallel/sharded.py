@@ -127,6 +127,12 @@ from gamesmanmpi_tpu.utils.env import (
     env_opt,
     env_str,
 )
+from gamesmanmpi_tpu.ops.fused import (
+    fused_dedup_method,
+    fused_dedup_provenance,
+    fused_enabled,
+    fused_sort_unique,
+)
 from gamesmanmpi_tpu.solve.engine import (
     LevelTable,
     SolveResult,
@@ -137,6 +143,8 @@ from gamesmanmpi_tpu.solve.engine import (
     canonical_children,
     canonical_scalar,
     get_kernel,
+    set_dispatch_sink,
+    tally_dispatch,
 )
 
 
@@ -219,7 +227,8 @@ def _route_by_owner(flat, S: int, cap_out: int, sentinel):
 def _sharded_forward_step(game: TensorGame, S: int, route_cap: int, local,
                           merge: bool | None = None,
                           compact: str | None = None,
-                          provenance: bool = False):
+                          provenance: bool = False,
+                          fused: str | None = None):
     """Per-shard forward body: expand -> owner-bucket -> all_to_all -> dedup.
 
     local: [1, cap] this shard's frontier slice (shard_map gives the leading
@@ -251,12 +260,29 @@ def _sharded_forward_step(game: TensorGame, S: int, route_cap: int, local,
     )
     routed = jax.lax.all_to_all(send, AXIS, split_axis=0, concat_axis=0,
                                 tiled=True)
+    # fused (ISSUE 14): the dedup after the route runs through the fused
+    # rank/sort+dedup stage (ops/fused) — per-shard callback on CPU,
+    # single-pair-sort scatterinv on accelerators. The routed buffer has
+    # no dense real prefix (each source row is sentinel-padded), so no
+    # count limit applies; the collectives around the dedup are untouched,
+    # which is what keeps these dispatch sites inside _retry_collective
+    # (GM603) exactly as before.
     if not provenance:
-        uniq, count = sort_unique(routed.reshape(-1), merge, compact)
+        if fused:
+            uniq, count = fused_sort_unique(routed.reshape(-1), None,
+                                            fused, merge, compact)
+        else:
+            uniq, count = sort_unique(routed.reshape(-1), merge, compact)
         all_counts = jax.lax.all_gather(count, AXIS)  # [S] replicated
         all_sends = jax.lax.all_gather(counts, AXIS)  # [S, S] replicated
         return uniq[None], all_counts, all_sends
-    uniq, count, uidx = dedup_provenance(routed.reshape(-1), merge, compact)
+    if fused:
+        uniq, count, uidx = fused_dedup_provenance(
+            routed.reshape(-1), None, fused, merge, compact
+        )
+    else:
+        uniq, count, uidx = dedup_provenance(routed.reshape(-1), merge,
+                                             compact)
     # Route each child's unique-index-within-owner back to its parent:
     # uidx is in routed layout (row i = slots received from source i), so
     # the return all_to_all lands row o of the parent's eidx with the uids
@@ -706,6 +732,12 @@ class ShardedSolver:
         self.bytes_gathered = 0
         #: transient level-step failures absorbed by retry (stats field).
         self.retries = 0
+        #: ISSUE 14 dispatch accounting (see engine.note_dispatch): device
+        #: computations/transfers this solve issued, with the per-(phase,
+        #: level) breakdown the fused A/B asserts on.
+        self.dispatch_total = 0
+        self.level_dispatches: Dict[tuple, int] = {}
+        self.dispatch_by_kind: Dict[str, int] = {}
         #: elastic resume (ISSUE 13): shard count the adopted checkpoint
         #: tree was sealed at when it differs from this run's (None = no
         #: reshard happened), and how many levels fell back from the
@@ -775,6 +807,12 @@ class ShardedSolver:
             raise preempt.PreemptionRequested(
                 f"peer rank preempted at {phase} boundary (level {level})"
             )
+
+    def _on_dispatch(self, kind: str) -> None:
+        """Dispatch sink (engine.set_dispatch_sink): one shared tally body
+        with the single-device engine (engine.tally_dispatch) so the
+        gamesman_dispatches_total series can never fork between them."""
+        tally_dispatch(self, kind)
 
     def _retry(self, point: str, fn, reset=None, level=None, entry=None):
         """Level-step retry wrapper (see resilience.retry): the sharded
@@ -1002,13 +1040,19 @@ class ShardedSolver:
         """
         mesh, S = self.mesh, self.S
 
+        # Fused-dedup lowering, resolved at cache-key time (ISSUE 14): the
+        # flag changes the traced program, so it rides the lowering tuple —
+        # a mid-process GAMESMAN_FUSED flip can neither reuse a kernel
+        # traced the other way nor disagree with its key.
+        fz = fused_dedup_method() if fused_enabled() else None
+
         def build(game):
             # resolved at cache-key time
             mb, cm = use_merge_sort(), compact_method()
 
             def per_shard(local):
                 return _sharded_forward_step(game, S, route_cap, local, mb,
-                                             cm, provenance)
+                                             cm, provenance, fz)
 
             data_specs = (P(AXIS), P(AXIS), P(AXIS)) if provenance \
                 else (P(AXIS),)
@@ -1023,7 +1067,7 @@ class ShardedSolver:
         return get_kernel(
             self.game, "sfwdp" if provenance else "sfwd",
             (self._mesh_key, cap, route_cap), build,
-            lowering=(backend_key(), compact_method()),
+            lowering=(backend_key(), compact_method(), fz or "off"),
         )
 
     # Edge-backward kernel builders are factored out of their get_kernel
@@ -1508,6 +1552,7 @@ class ShardedSolver:
             # HERE and resume re-expands from the deepest sealed level.
             self._check_preempt("forward", k)
             b0 = (self.bytes_routed, self.bytes_sorted)
+            disp0 = self.dispatch_total
             route_cap = self._initial_route_cap(cap)
             eidx = slot = None
             while True:
@@ -1535,17 +1580,25 @@ class ShardedSolver:
                 route_cap = bucket_size(max_sent)
             item = np.dtype(g.state_dtype).itemsize
             compaction = compaction_sort_bytes(item)
+            # Fused dedup changes the sort-operand denominator (ISSUE 14):
+            # callback = one numpy radix pass over the routed block;
+            # scatterinv = ONE pair sort + compaction instead of two.
+            fz = fused_dedup_method() if fused_enabled() else None
             if self.use_edges:
-                # States out + the uid reply riding back; the provenance
-                # dedup's two pair sorts + compaction.
+                # States out + the uid reply riding back.
                 self.bytes_routed += S * S * route_cap * (item + 4)
-                self.bytes_sorted += (
-                    S * S * route_cap
-                    * provenance_sort_bytes(item, compaction)
-                )
+                if fz == "callback":
+                    prov_bytes = item
+                elif fz == "scatterinv":
+                    prov_bytes = item + 4 + compaction
+                else:
+                    prov_bytes = provenance_sort_bytes(item, compaction)
+                self.bytes_sorted += S * S * route_cap * prov_bytes
             else:
                 self.bytes_routed += S * S * route_cap * item
-                self.bytes_sorted += S * S * route_cap * (item + compaction)
+                self.bytes_sorted += S * S * route_cap * (
+                    item if fz == "callback" else item + compaction
+                )
             counts = np.asarray(count).reshape(-1).astype(np.int64)
             total = int(counts.sum())
             if total == 0:
@@ -1618,6 +1671,7 @@ class ShardedSolver:
                         "route_cap": route_cap,
                         "bytes_routed": self.bytes_routed - b0[0],
                         "bytes_sorted": self.bytes_sorted - b0[1],
+                        "dispatches": self.dispatch_total - disp0,
                         "secs": time.perf_counter() - t0,
                     }
                 )
@@ -1653,6 +1707,7 @@ class ShardedSolver:
                              "rank": self.rank}
             self._check_preempt("forward", k)
             b0 = (self.bytes_routed, self.bytes_sorted)
+            disp0 = self.dispatch_total
             frontier, counts = pools.pop(k)
             rec = _SLevel(counts, frontier, None)
             levels[k] = rec
@@ -1684,8 +1739,11 @@ class ShardedSolver:
                 route_cap = bucket_size(max_sent)
             item = np.dtype(g.state_dtype).itemsize
             compaction = compaction_sort_bytes(item)
+            fz = fused_dedup_method() if fused_enabled() else None
             self.bytes_routed += S * S * route_cap * item
-            self.bytes_sorted += S * S * route_cap * (item + compaction)
+            self.bytes_sorted += S * S * route_cap * (
+                item if fz == "callback" else item + compaction
+            )
             ccounts = np.asarray(count).reshape(-1)
             total = int(ccounts.sum())
             if total > 0:
@@ -1771,6 +1829,7 @@ class ShardedSolver:
                         "route_cap": route_cap,
                         "bytes_routed": self.bytes_routed - b0[0],
                         "bytes_sorted": self.bytes_sorted - b0[1],
+                        "dispatches": self.dispatch_total - disp0,
                         "secs": time.perf_counter() - t0,
                     }
                 )
@@ -2688,9 +2747,11 @@ class ShardedSolver:
         single-device engine; `progress` is replaced atomically at each
         phase/level boundary)."""
         wd = maybe_watchdog(lambda: self.progress, logger=self.logger)
+        prev_sink = set_dispatch_sink(self._on_dispatch)
         try:
             return self._solve_impl()
         finally:
+            set_dispatch_sink(prev_sink)
             # Pending pipelined seals are safe to run even on the error
             # path — their payload writes are already queued and waited
             # on — and losing them would unseal levels whose files are
@@ -2853,6 +2914,12 @@ class ShardedSolver:
             "bytes_routed": self.bytes_routed,
             "bytes_sorted": self.bytes_sorted,
             "bytes_gathered": self.bytes_gathered,
+            # ISSUE 14 dispatch economy (see engine stats of the same
+            # names): proves the fused kernels dispatch less per level.
+            "dispatches_total": self.dispatch_total,
+            "dispatches_per_level": round(
+                self.dispatch_total / max(len(levels), 1), 2),
+            "fused": fused_enabled(),
             **self.store_stats(),
         }
         self.progress = {"phase": "done", "rank": self.rank}

@@ -48,6 +48,36 @@ def _run_bench(extra_env, timeout=600):
     return json.loads(lines[0]), proc.stderr
 
 
+def test_bench_smoke_fused_contract():
+    """BENCH_SMOKE=1 (ISSUE 14): the fast contract check that pins the
+    new record fields so they can't rot — warmup excluded from the
+    variance block but published raw, dispatch economy present, and the
+    fused/unfused A/B with per-arm dispatches and byte-parity."""
+    record, stderr = _run_bench({
+        "BENCH_ENGINE": "classic",
+        "BENCH_SMOKE": "1",
+    })
+    # Warmup contract: excluded from n/median/all_pps, raw rate kept.
+    assert record["runs"]["n"] == 1
+    assert len(record["runs"]["all_pps"]) == 1
+    assert len(record["runs"]["warmup_pps"]) == 1
+    # Dispatch economy fields (the ISSUE 14 claim surface).
+    assert record["dispatches"]["total"] > 0
+    assert record["dispatches"]["per_level"] > 0
+    assert "overlap_secs" in record and "fused" in record
+    # Fused A/B: parity proven every round, per-arm dispatch counts.
+    ab = record["fused_ab"]
+    assert ab["parity_ok"] is True
+    assert ab["unfused"]["table_sha256"] == ab["fused"]["table_sha256"]
+    assert ab["fused"]["dispatches_per_level"] \
+        < ab["unfused"]["dispatches_per_level"]
+    assert ab["speedup"] > 0
+    # The XLA host-feature-mismatch spam is filtered from the forwarded
+    # stderr (it dwarfed the run lines in BENCH_r05.json's tail).
+    assert "host machine features" not in stderr
+    assert "could lead to execution errors" not in stderr
+
+
 def test_bench_hybrid_sym_subrun_keeps_engine():
     """ADVICE r5 leftover (pinned by ISSUE 10): BENCH_ENGINE=hybrid must
     NOT gate on game.sym — the secondary sym sub-run benches the SAME

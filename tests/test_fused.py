@@ -1,0 +1,300 @@
+"""ISSUE 14: fused level megakernel + ping-pong pipeline.
+
+Byte-parity matrix over ttt/nim/chomp/connect4 on the single-device
+engine and the sharded engine (both backward modes), ops-level parity of
+the fused rank/sort+dedup stage against its unfused twins (both
+lowerings), the connect4 bitboard decompose A/B, and the dispatch-economy
+asserts: the fused fast path spends exactly ONE forward megakernel
+dispatch per level (zero extra, via the new counter) and at least halves
+dispatches-per-level against the unfused arm.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gamesmanmpi_tpu.games import get_game
+from gamesmanmpi_tpu.ops.dedup import sort_unique
+from gamesmanmpi_tpu.ops.fused import (
+    fused_dedup_provenance,
+    fused_sort_unique,
+)
+from gamesmanmpi_tpu.ops.mergesort import sort_rank
+from gamesmanmpi_tpu.ops.provenance import dedup_provenance
+from gamesmanmpi_tpu.solve import Solver
+
+from helpers import full_table
+
+
+def _fused_env(monkeypatch, pipeline="pingpong"):
+    monkeypatch.setenv("GAMESMAN_FUSED", "1")
+    monkeypatch.setenv("GAMESMAN_PIPELINE", pipeline)
+
+
+# ------------------------------------------------------------- ops parity
+
+
+def _rand_children(n=4096, dup_space=512, seed=7, dtype=np.uint32):
+    rng = np.random.default_rng(seed)
+    flat = rng.integers(0, dup_space, size=n).astype(dtype)
+    sent = np.iinfo(dtype).max
+    flat[rng.random(n) < 0.15] = sent  # masked-move sentinels
+    return flat, sent
+
+
+@pytest.mark.parametrize("method", ["callback", "scatterinv"])
+def test_fused_sort_unique_parity(method):
+    flat, _ = _rand_children()
+    base_u, base_c = jax.jit(sort_unique)(jnp.asarray(flat))
+    fu, fc = jax.jit(
+        lambda f: fused_sort_unique(f, None, method)
+    )(jnp.asarray(flat))
+    assert int(base_c) == int(fc)
+    np.testing.assert_array_equal(np.asarray(base_u), np.asarray(fu))
+
+
+@pytest.mark.parametrize("method", ["callback", "scatterinv"])
+def test_fused_dedup_provenance_parity(method):
+    flat, _ = _rand_children(seed=11)
+    bu, bc, bi = jax.jit(dedup_provenance)(jnp.asarray(flat))
+    fu, fc, fi = jax.jit(
+        lambda f: fused_dedup_provenance(f, None, method)
+    )(jnp.asarray(flat))
+    assert int(bc) == int(fc)
+    np.testing.assert_array_equal(np.asarray(bu), np.asarray(fu))
+    np.testing.assert_array_equal(np.asarray(bi), np.asarray(fi))
+
+
+def test_fused_callback_count_limit():
+    """nvalid: slots past the count must be ignored by the callback dedup
+    exactly as sentinel slots are (the engines guarantee they ARE
+    sentinel; here we plant garbage to prove the limit is real)."""
+    flat, sent = _rand_children(seed=13)
+    n = 1000
+    garbage = flat.copy()
+    garbage[n:] = 123456789  # non-sentinel garbage beyond the count
+    ref = flat.copy()
+    ref[n:] = sent
+    bu, bc = jax.jit(sort_unique)(jnp.asarray(ref))
+    fu, fc = jax.jit(
+        lambda f, nn: fused_sort_unique(f, nn, "callback")
+    )(jnp.asarray(garbage), jnp.int32(n))
+    assert int(bc) == int(fc)
+    np.testing.assert_array_equal(np.asarray(bu), np.asarray(fu))
+
+
+def test_sort_rank_inverts_permutation():
+    flat, _ = _rand_children(seed=17)
+    s, rank_back = jax.jit(sort_rank)(jnp.asarray(flat))
+    s, rank_back = np.asarray(s), np.asarray(rank_back)
+    # s must be the sorted input, and rank_back must route every input
+    # slot to its own value's position in s.
+    np.testing.assert_array_equal(s, np.sort(flat))
+    np.testing.assert_array_equal(s[rank_back], flat)
+
+
+# --------------------------------------------------- engine parity matrix
+
+
+ENGINE_SPECS = [
+    "tictactoe",                 # fast path, dihedral symmetry
+    "connect4:w=4,h=4",          # fast path, value-table backward
+    "connect4:w=4,h=3,sym=1",    # fast path + mirror canonicalize
+    "nim:heaps=3-4-5",           # generic path (multi-jump)
+    "chomp:w=3,h=3",             # generic path, widest max_moves
+]
+
+
+@pytest.mark.parametrize("spec", ENGINE_SPECS)
+def test_engine_fused_full_parity(monkeypatch, spec):
+    base = Solver(get_game(spec), paranoid=True).solve()
+    _fused_env(monkeypatch)
+    fused = Solver(get_game(spec), paranoid=True).solve()
+    assert (fused.value, fused.remoteness) == (base.value, base.remoteness)
+    assert fused.num_positions == base.num_positions
+    assert full_table(fused) == full_table(base)
+    assert fused.stats["fused"] is True
+
+
+def test_engine_fused_level_pipeline_parity(monkeypatch):
+    """GAMESMAN_PIPELINE=level under fusion: same tables, no deferral."""
+    base = Solver(get_game("connect4:w=4,h=4")).solve()
+    _fused_env(monkeypatch, pipeline="level")
+    fused = Solver(get_game("connect4:w=4,h=4"), paranoid=True).solve()
+    assert full_table(fused) == full_table(base)
+    assert fused.stats["overlap_secs"] == 0.0
+
+
+def test_engine_fused_store_tables_false(monkeypatch):
+    """Big-run mode (the bench config): root-only materialization."""
+    base = Solver(get_game("connect4:w=4,h=4")).solve()
+    _fused_env(monkeypatch)
+    lean = Solver(get_game("connect4:w=4,h=4"), store_tables=False).solve()
+    assert (lean.value, lean.remoteness) == (base.value, base.remoteness)
+    assert lean.num_positions == base.num_positions
+    assert len(lean.levels) == 1  # root only
+
+
+def test_engine_fused_provenance_mode_parity(monkeypatch):
+    """Games outside the value-table gate (or with it disabled) take the
+    fused forward + gather-only provenance backward; tables must still be
+    byte-identical."""
+    base = Solver(get_game("connect4:w=4,h=4")).solve()
+    _fused_env(monkeypatch)
+    monkeypatch.setenv("GAMESMAN_FUSED_TABLE_BITS", "0")  # force off
+    fused = Solver(get_game("connect4:w=4,h=4"), paranoid=True).solve()
+    assert full_table(fused) == full_table(base)
+
+
+def test_engine_fused_blocked_backward_parity(monkeypatch):
+    """Wide levels resolve in column blocks against the same cells table."""
+    base = Solver(get_game("tictactoe")).solve()
+    _fused_env(monkeypatch)
+    blocked = Solver(get_game("tictactoe"), paranoid=True)
+    blocked.backward_block = 256
+    result = blocked.solve()
+    assert full_table(result) == full_table(base)
+
+
+# ------------------------------------------------------- dispatch economy
+
+
+def test_fused_forward_single_dispatch_per_level(monkeypatch):
+    """The megakernel claim, asserted via the new counter: the fused fast
+    path spends exactly ONE forward megakernel dispatch per discovered
+    level — zero extra dispatches — and the backward resolve is one
+    table kernel per level."""
+    _fused_env(monkeypatch)
+    solver = Solver(get_game("connect4:w=4,h=4"), store_tables=False)
+    solver.solve()
+    # store_tables=False keeps only the root level table; count levels
+    # from the per-level dispatch breakdown instead.
+    fwd_levels = {lvl for ph, lvl in solver.level_dispatches if
+                  ph == "forward"}
+    assert solver.dispatch_by_kind["fwdm"] == len(fwd_levels)
+    # one bwdt per non-checkpointed level (no bwdc here), no bwd/bwdp
+    assert solver.dispatch_by_kind.get("bwd", 0) == 0
+    assert solver.dispatch_by_kind.get("bwdp", 0) == 0
+    assert solver.dispatch_by_kind["bwdt"] == len(fwd_levels)
+
+
+def test_fused_halves_dispatches_per_level(monkeypatch):
+    """Acceptance gate: >= 2x fewer dispatches per level than unfused."""
+    unfused = Solver(get_game("connect4:w=4,h=4"), store_tables=False)
+    ru = unfused.solve()
+    _fused_env(monkeypatch)
+    fused = Solver(get_game("connect4:w=4,h=4"), store_tables=False)
+    rf = fused.solve()
+    assert rf.stats["dispatches_per_level"] * 2 \
+        <= ru.stats["dispatches_per_level"]
+    assert rf.stats["dispatches_total"] * 2 <= ru.stats["dispatches_total"]
+
+
+def test_dispatch_counter_registry_series():
+    """gamesman_dispatches_total{phase} grows with a solve."""
+    from gamesmanmpi_tpu.obs import default_registry
+
+    reg = default_registry()
+    game = get_game("tictactoe")
+    before = reg.counter(
+        "gamesman_dispatches_total",
+        phase="forward", game=game.name,
+    ).value
+    Solver(game).solve()
+    after = reg.counter(
+        "gamesman_dispatches_total",
+        phase="forward", game=game.name,
+    ).value
+    assert after > before
+
+
+# ----------------------------------------------------------- sharded
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (fake) devices"
+)
+
+
+@needs_mesh
+@pytest.mark.parametrize("backward", ["lookup", "edges"])
+@pytest.mark.parametrize("spec", ["connect4:w=4,h=3", "nim:heaps=3-4-5"])
+def test_sharded_fused_parity(monkeypatch, spec, backward):
+    from gamesmanmpi_tpu.parallel import ShardedSolver
+
+    base = Solver(get_game(spec), paranoid=True).solve()
+    monkeypatch.setenv("GAMESMAN_BACKWARD", backward)
+    _fused_env(monkeypatch)
+    fused = ShardedSolver(get_game(spec), num_shards=4,
+                          paranoid=True).solve()
+    assert (fused.value, fused.remoteness) == (base.value, base.remoteness)
+    assert full_table(fused) == full_table(base)
+    assert fused.stats["fused"] is True
+
+
+# ------------------------------------------------------ checkpoint paths
+
+
+def test_fused_checkpoint_and_resume_parity(monkeypatch, tmp_path):
+    """Fused solves checkpoint like unfused ones, and a second run over
+    the same tree resumes through the bwdc cell-scatter path (loaded
+    levels fold into the value table without resolving) to identical
+    tables."""
+    from gamesmanmpi_tpu.utils.checkpoint import LevelCheckpointer
+
+    base = Solver(get_game("connect4:w=4,h=3"), paranoid=True).solve()
+    _fused_env(monkeypatch)
+    ck = LevelCheckpointer(str(tmp_path / "ck"))
+    first = Solver(get_game("connect4:w=4,h=3"), paranoid=True,
+                   checkpointer=ck).solve()
+    assert full_table(first) == full_table(base)
+    # Resume: every level completed — the backward must LOAD, not solve.
+    ck2 = LevelCheckpointer(str(tmp_path / "ck"))
+    resumed_solver = Solver(get_game("connect4:w=4,h=3"), paranoid=True,
+                            checkpointer=ck2)
+    resumed = resumed_solver.solve()
+    assert full_table(resumed) == full_table(base)
+    assert resumed_solver.dispatch_by_kind.get("bwdt", 0) == 0  # all loaded
+    assert resumed_solver.dispatch_by_kind.get("bwdc", 0) > 0
+
+
+# ------------------------------------------------- connect4 bitboard A/B
+
+
+@pytest.mark.parametrize("wh", [(4, 4), (5, 4), (7, 6)])
+def test_connect4_bitboard_decompose_parity(wh):
+    """The whole-word masked-smear decompose must be bit-identical to the
+    per-column msb loop on every REACHABLE state shape (random playouts;
+    garbage lanes are out of contract — the engines mask them)."""
+    w, h = wh
+    game = get_game(f"connect4:w={w},h={h}")
+    rng = np.random.default_rng(3)
+    states = [int(game.initial_state())]
+    frontier = [int(game.initial_state())]
+    for _ in range(min(w * h, 12)):
+        batch = np.asarray(frontier, dtype=game.state_dtype)
+        kids, mask = jax.jit(game.expand)(jnp.asarray(batch))
+        kids, mask = np.asarray(kids), np.asarray(mask)
+        nxt = list(np.unique(kids[mask]))
+        if not nxt:
+            break
+        rng.shuffle(nxt)
+        frontier = nxt[:256]
+        states.extend(frontier)
+    batch = jnp.asarray(np.asarray(states, dtype=game.state_dtype))
+    fast = jax.jit(game._decompose)(batch)
+    ref = jax.jit(game._decompose_loop)(batch)
+    for a, b, name in zip(fast, ref,
+                          ("guards", "filled", "current", "opponent")):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=name)
+
+
+def test_connect4_bitboard_solve_parity(monkeypatch):
+    """End-to-end: bitboard on/off produce identical full tables (the
+    flag is part of cache_key, so kernels cannot cross-contaminate)."""
+    base = Solver(get_game("connect4:w=4,h=3"), paranoid=True).solve()
+    monkeypatch.setenv("GAMESMAN_C4_BITBOARD", "0")
+    loop = Solver(get_game("connect4:w=4,h=3"), paranoid=True).solve()
+    assert full_table(loop) == full_table(base)

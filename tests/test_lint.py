@@ -1797,3 +1797,91 @@ def test_repository_passes_ruff():
         timeout=120,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ------------------------------------------------ ISSUE 14: fused sites
+
+
+def test_gm603_fused_callback_kernel_routing(tmp_path):
+    """The fused-dedup kernel bodies (pure_callback inside a shard_map
+    body, collectives around it) change nothing about GM603: the body is
+    traced-via-get_kernel (exempt), the dispatch site is what's checked —
+    routed through _retry_collective passes, unrouted is flagged."""
+    build_project(tmp_path, {"mod.py": """
+        import jax
+        import numpy as np
+
+        def shard_map(f):
+            return f
+
+        def get_kernel(key, build):
+            return build()
+
+        def _np_unique(flat):
+            return np.unique(flat)
+
+        class Eng:
+            def _retry(self, point, fn):
+                return self._retry_collective(point, fn)
+
+            def _retry_collective(self, point, fn):
+                return fn()
+
+            def _fused_fn(self):
+                def build():
+                    def body(x):
+                        y = jax.pure_callback(_np_unique, x, x)
+                        return jax.lax.all_to_all(y, "i", 0, 0)
+                    return shard_map(body)
+                return get_kernel("k", build)
+
+            def good(self, x):
+                def _step():
+                    return self._fused_fn()(x)
+                return self._retry("p", _step)
+
+            def bad(self, x):
+                return self._fused_fn()(x)  # MARK
+    """})
+    _, got = findings(tmp_path)
+    assert got == [("GM603", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
+
+
+def test_gm1xx_pure_callback_body_is_host_code(tmp_path):
+    """GM1xx trace-safety of the fused megakernel shape: a module-level
+    numpy function passed BY NAME into jax.pure_callback from traced code
+    runs on the HOST with concrete arrays — its np.* calls and host syncs
+    must NOT be flagged. The same function reached through a non-callback
+    combinator still is (the callback rule's reason to exist)."""
+    build_project(tmp_path, {"clean.py": """
+        import jax
+        import numpy as np
+
+        def _np_dedup(flat, n):
+            u = np.unique(flat[:int(n)])
+            out = np.full(flat.shape[0], 0, dtype=flat.dtype)
+            out[:len(u)] = u
+            return out
+
+        @jax.jit
+        def fused_kernel(flat, n):
+            return jax.pure_callback(_np_dedup, flat, flat, n)
+    """})
+    _, got = findings(tmp_path)
+    assert got == [], got
+
+
+def test_gm1xx_non_callback_callee_still_traced(tmp_path):
+    build_project(tmp_path, {"mod.py": """
+        import jax
+        import numpy as np
+
+        def _body(carry, x):
+            return carry, np.cumsum(x)  # MARK
+
+        @jax.jit
+        def kernel(xs):
+            return jax.lax.scan(_body, 0, xs)
+    """})
+    _, got = findings(tmp_path)
+    assert got == [("GM105", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
