@@ -1312,6 +1312,10 @@ def main() -> int:
             "efficiency": {
                 "bytes_sorted": 0, "bytes_gathered": 0, "operand_gbps": 0.0,
             },
+            "roofline": {
+                "operand_gbps": 0.0, "pps_per_chip": 0.0,
+                "dispatch_overhead_frac": 0.0,
+            },
             "error": f"bench failed; attempted: {', '.join(attempts)}",
         }
     # The parent is authoritative for fallback_cpu: a forced CPU run is a
@@ -1435,6 +1439,27 @@ def inner() -> int:
 
     dev = jax.devices()[0]
     print(f"bench device: {dev.platform} ({dev})", file=sys.stderr)
+
+    # ISSUE 15 roofline accounting: measure THIS host's per-dispatch
+    # overhead (a tiny compiled kernel round-tripped N times) and
+    # publish it as GAMESMAN_DISPATCH_COST_SECS so every solve's
+    # stats["roofline"]["dispatch_overhead_frac"] prices its dispatch
+    # count against a measured figure instead of a guess. An inherited
+    # operator value wins (a deliberate override for a known platform).
+    if not os.environ.get("GAMESMAN_DISPATCH_COST_SECS"):
+        import jax.numpy as jnp
+
+        probe = jax.jit(lambda a: a + 1)
+        arg = jnp.zeros((8,), dtype=jnp.int32)
+        probe(arg).block_until_ready()  # compile outside the timing
+        t0 = time.perf_counter()
+        reps = 64
+        for _ in range(reps):
+            probe(arg).block_until_ready()
+        cost = (time.perf_counter() - t0) / reps
+        os.environ["GAMESMAN_DISPATCH_COST_SECS"] = f"{cost:.9f}"
+        print(f"dispatch cost: {cost * 1e6:.1f} us/dispatch",
+              file=sys.stderr)
 
     # Engine selection: the dense class-partitioned engine (solve/dense.py)
     # is the fast path for non-symmetric Connect-4 boards on the
@@ -1602,6 +1627,27 @@ def inner() -> int:
             },
             "overlap_secs": round(stats.get("overlap_secs", 0.0), 3),
             "fused": bool(stats.get("fused", False)),
+            # ISSUE 15 roofline fields: analytic HBM operand throughput,
+            # the headline per-chip rate, and the wall fraction spent on
+            # dispatch overhead (dispatch count x the calibrated
+            # per-dispatch cost measured above) — what bench_compare
+            # diffs across the committed BENCH_* trajectory.
+            "roofline": {
+                "operand_gbps": round(
+                    traffic / max(stats.get("secs_total", 0.0), 1e-9)
+                    / 1e9, 3),
+                # Per CHIP, same rule as the engines' roofline_stats:
+                # shards count as chips only on a real accelerator mesh
+                # (a faked CPU mesh is one physical chip) — the record
+                # and the solve stats must agree on this field's
+                # denominator or an 8-shard TPU record inflates 8x.
+                "pps_per_chip": round(
+                    best_pps / (stats.get("shards", 1)
+                                if dev.platform != "cpu" else 1), 1),
+                "dispatch_overhead_frac": (
+                    stats.get("roofline") or {}
+                ).get("dispatch_overhead_frac", 0.0),
+            },
         }
         if "shards" in stats:
             # Sharded engine only: the shard count that ACTUALLY ran (a

@@ -23,6 +23,7 @@ from gamesmanmpi_tpu.analysis import (
     lifecycle,
     locks,
     metrics_parity,
+    spans_parity,
     spmd,
 )
 from gamesmanmpi_tpu.analysis.diagnostics import (
@@ -41,6 +42,7 @@ CHECKERS = (
     locks.check,
     env_parity.check,
     metrics_parity.check,
+    spans_parity.check,
     faults_parity.check,
     exit_parity.check,
     spmd.check,

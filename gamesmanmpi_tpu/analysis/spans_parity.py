@@ -1,0 +1,124 @@
+"""GM4xx (continued) — span-name registry parity (GM405).
+
+``Span``/``trace_span`` names are the phase vocabulary of every
+observability surface at once: the JSONL ``phase`` records bench.py
+parses, the ``gamesman_span_seconds{span=...}`` series, the Chrome
+trace events, the flight recorder's ring, and the per-level rows
+``tools/obs_report.py`` folds. A span name an operator cannot look up
+in docs/OBSERVABILITY.md is a phase nobody can interpret in a
+post-mortem — the same drift GM402 closes for metric names, enforced
+the same TWO-WAY shape as GM302/GM303 closes for env vars:
+
+| id | finding |
+|---|---|
+| GM405 | a ``Span(...)``/``trace_span(...)`` name used in the codebase is missing from docs/OBSERVABILITY.md's "Span name registry" table — or a registered name is used nowhere (stale doc row); also a span name that is not statically resolvable (the registry can't be audited) |
+
+The doc anchor is the "Span name registry" section of
+docs/OBSERVABILITY.md: every table row whose first cell is a
+backticked name registers one span. A project whose OBSERVABILITY.md
+has no such section skips the family entirely (same opt-in shape as
+the exit-code registry). Conditional names
+(``Span("backward_edges" if want_edges else "backward")``) resolve to
+both branches. The definition site (``obs/tracing.py``) is skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from gamesmanmpi_tpu.analysis.diagnostics import Diagnostic
+from gamesmanmpi_tpu.analysis.project import (
+    OBSERVABILITY_MD,
+    Project,
+    call_name,
+    const_str,
+    module_string_consts,
+)
+
+#: Call names that start a span (last dotted component).
+_SPAN_CALLS = {"Span", "trace_span"}
+
+_SECTION_RE = re.compile(r"^#+\s.*span name registry", re.IGNORECASE)
+_ROW_RE = re.compile(r"^\|\s*`([a-z_][a-z0-9_]*)`\s*\|")
+
+
+def _doc_registry(doc: str) -> Optional[Dict[str, int]]:
+    """Registered span names -> 1-based doc line, or None when the doc
+    has no "Span name registry" section (family opt-out)."""
+    rows: Dict[str, int] = {}
+    in_section = False
+    found = False
+    for i, line in enumerate(doc.splitlines(), 1):
+        stripped = line.strip()
+        if _SECTION_RE.match(stripped):
+            in_section = True
+            found = True
+            continue
+        if in_section and stripped.startswith("#"):
+            in_section = False
+            continue
+        if in_section:
+            m = _ROW_RE.match(stripped)
+            if m:
+                rows.setdefault(m.group(1), i)
+    return rows if found else None
+
+
+def _resolve_span_names(node: ast.AST, consts) -> Optional[List[str]]:
+    """The statically-resolvable name(s) a span-call first argument can
+    take: a literal/constant, or an IfExp whose branches both resolve
+    (the mixed-mode backward span). None = not resolvable."""
+    got = const_str(node, consts)
+    if got is not None:
+        return [got]
+    if isinstance(node, ast.IfExp):
+        a = _resolve_span_names(node.body, consts)
+        b = _resolve_span_names(node.orelse, consts)
+        if a is not None and b is not None:
+            return a + b
+    return None
+
+
+def check(project: Project) -> List[Diagnostic]:
+    registry = _doc_registry(project.observability_md)
+    if registry is None:
+        return []  # project without a span-name registry section
+    diags: List[Diagnostic] = []
+    used: Dict[str, Tuple[str, int]] = {}  # name -> first (file, line)
+    for src in project.files:
+        if src.tree is None or src.rel.endswith("obs/tracing.py"):
+            continue
+        consts = module_string_consts(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if call_name(node).rsplit(".", 1)[-1] not in _SPAN_CALLS:
+                continue
+            names = _resolve_span_names(node.args[0], consts)
+            if names is None:
+                diags.append(Diagnostic(
+                    src.rel, node.lineno, "GM405",
+                    "span name is not statically resolvable — use a "
+                    "literal (or a conditional over literals) so the "
+                    "span registry stays auditable",
+                ))
+                continue
+            for name in names:
+                used.setdefault(name, (src.rel, node.lineno))
+                if name not in registry:
+                    diags.append(Diagnostic(
+                        src.rel, node.lineno, "GM405",
+                        f"span {name!r} is used here but not registered "
+                        f"in {OBSERVABILITY_MD}'s \"Span name registry\" "
+                        "table",
+                    ))
+    for name, line in sorted(registry.items()):
+        if name not in used:
+            diags.append(Diagnostic(
+                OBSERVABILITY_MD, line, "GM405",
+                f"span {name!r} is registered in the span-name registry "
+                "but no Span/trace_span call uses it — stale doc row",
+            ))
+    return diags

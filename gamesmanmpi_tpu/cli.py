@@ -109,6 +109,18 @@ def build_parser() -> argparse.ArgumentParser:
         "mid-flight (env GAMESMAN_HEARTBEAT_SECS; 0 = off)",
     )
     p.add_argument(
+        "--status-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="serve read-only live solve status on this port: GET "
+        "/status (phase/level, positions solved, per-level progress "
+        "model with ETA, fleet-merged per-rank view on rank 0) and GET "
+        "/metrics (Prometheus text). 0 = ephemeral port (published via "
+        "GAMESMAN_STATUS_ADDR_FILE); env GAMESMAN_STATUS_PORT; unset = "
+        "off (docs/OBSERVABILITY.md \"Live status\")",
+    )
+    p.add_argument(
         "--watchdog-secs",
         type=float,
         default=None,
@@ -319,6 +331,17 @@ def _report(result, devices: int, elapsed: float, args) -> None:
             print(f"query {q}: invalid position ({e})")
 
 
+def _dump_flightrec(reason: str) -> None:
+    """Leave the flight recorder's post-mortem (recent spans/levels/
+    retries/faults + in-flight spans) on every abnormal solve exit —
+    the file lands in GAMESMAN_FLIGHTREC_DIR, which main() defaults to
+    the checkpoint directory. Never raises: the post-mortem writer must
+    not add its own failure to the one it records."""
+    from gamesmanmpi_tpu.obs import flightrec
+
+    flightrec.dump(reason)
+
+
 #: Serving subcommands dispatched ahead of the flat solve parser. A game
 #: spec can never collide: specs are lowercase single tokens already taken
 #: by the registry, and module paths contain a '.' or '/'.
@@ -334,6 +357,15 @@ def main(argv=None) -> int:
     # construction; set them before any solver is built, and restore on
     # exit so programmatic main() calls don't leak config to the next one.
     saved_env = {}
+    # Flight-recorder dumps land next to the checkpoints by default: a
+    # checkpointed solve's post-mortems (crash, watchdog, preemption
+    # deadline, level-boundary snapshots) belong with the tree they
+    # describe. An explicit GAMESMAN_FLIGHTREC_DIR wins.
+    flightrec_dir = (
+        args.checkpoint_dir
+        if args.checkpoint_dir and not env_opt("GAMESMAN_FLIGHTREC_DIR")
+        else None
+    )
     for flag, env in (
         (args.backward_block, "GAMESMAN_BACKWARD_BLOCK"),
         (args.window_block, "GAMESMAN_WINDOW_BLOCK"),
@@ -341,6 +373,8 @@ def main(argv=None) -> int:
         (args.heartbeat_secs, "GAMESMAN_HEARTBEAT_SECS"),
         (args.watchdog_secs, "GAMESMAN_WATCHDOG_SECS"),
         (args.backward, "GAMESMAN_BACKWARD"),
+        (args.status_port, "GAMESMAN_STATUS_PORT"),
+        (flightrec_dir, "GAMESMAN_FLIGHTREC_DIR"),
     ):
         if flag is not None:
             saved_env[env] = env_opt(env)
@@ -753,6 +787,7 @@ def _solve_main(args, t0: float, logger) -> int:
         with maybe_profile(args.profile_dir):
             result = solver.solve()
     except PreemptionRequested as e:
+        _dump_flightrec("preempted")
         progress = getattr(solver, "progress", {})
         print(f"preempted: {e}\nprogress: {progress}", file=sys.stderr)
         sys.stderr.flush()
@@ -771,6 +806,7 @@ def _solve_main(args, t0: float, logger) -> int:
             os._exit(GRACE_EXIT_CODE)
         return GRACE_EXIT_CODE
     except MemoryError as e:
+        _dump_flightrec("oom")
         # Host allocator exhaustion — the guard's HostMemoryExceeded at
         # a level boundary, or a real MemoryError mid-level. Either way
         # the sealed prefix is intact (atomic payload writes, atomic
@@ -796,6 +832,18 @@ def _solve_main(args, t0: float, logger) -> int:
             os._exit(1)
         return 1
     except CoordinatedAbort as e:
+        import jax
+
+        if jax.process_count() <= 1:
+            # Multi-process ranks must NOT pay the dump's file I/O here:
+            # jax's coordination service is already racing to SIGABRT
+            # this process over the dead peer, and losing that race
+            # turns the contractual exit 124 into -6 (observed in the
+            # 2-process kill-resume chaos test). Their post-mortems come
+            # from the level-boundary ring checkpoints and the
+            # collective-deadline dump, which runs before the race
+            # starts.
+            _dump_flightrec("coordinated_abort")
         # The fleet agreed to stop (a peer died, diverged, or timed out):
         # same resumable-abort contract as the watchdog — diagnostics to
         # stderr, exit 124, checkpoint prefix intact, restart resumes.
@@ -819,6 +867,13 @@ def _solve_main(args, t0: float, logger) -> int:
         # until the coordination service SIGABRTs this process ~100 s
         # later — the watchdog contract is "gone within the deadline".
         os._exit(WATCHDOG_EXIT_CODE)
+    except Exception:
+        # The crash handler: any other death leaves the flight
+        # recorder's post-mortem (last completed level, in-flight
+        # spans) before the traceback propagates — exactly the cases
+        # that used to need a rerun under instrumentation.
+        _dump_flightrec("crash")
+        raise
     finally:
         restore_grace()
     _report(result, args.devices, time.perf_counter() - t0, args)

@@ -21,6 +21,15 @@ Connect-Four solve, arXiv:2507.05267). Three pieces, one subsystem:
 * ``Heartbeat`` (heartbeat.py): a daemon thread that periodically logs
   level progress, RSS, and device memory stats so a multi-hour solve is
   diagnosable mid-flight.
+* ``StatusServer`` / ``SolveStatusTracker`` (status.py, ISSUE 15): a
+  read-only ``/status`` + ``/metrics`` HTTP endpoint served from the
+  solver process (``GAMESMAN_STATUS_PORT``) with a level-schedule
+  progress model + ETA, fleet-merged per-rank view on rank 0, and the
+  campaign proxy one stable port across restarts.
+* ``FlightRecorder`` (flightrec.py, ISSUE 15): an always-on bounded
+  ring of recent spans/levels/retries/faults/store events dumped as
+  ``flightrec_<rank>.json`` on every abnormal exit — the post-mortem
+  that used to need a rerun under instrumentation.
 
 docs/OBSERVABILITY.md is the operator guide.
 """
@@ -38,6 +47,12 @@ from gamesmanmpi_tpu.obs.tracing import (
     trace_span,
 )
 from gamesmanmpi_tpu.obs.heartbeat import Heartbeat
+from gamesmanmpi_tpu.obs.flightrec import FlightRecorder, default_recorder
+from gamesmanmpi_tpu.obs.status import (
+    SolveStatusTracker,
+    StatusServer,
+    maybe_status_server,
+)
 
 __all__ = [
     "MetricsRegistry",
@@ -49,4 +64,9 @@ __all__ = [
     "set_trace_sink",
     "trace_span",
     "Heartbeat",
+    "FlightRecorder",
+    "default_recorder",
+    "SolveStatusTracker",
+    "StatusServer",
+    "maybe_status_server",
 ]

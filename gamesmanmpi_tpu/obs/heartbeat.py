@@ -32,8 +32,15 @@ from typing import Callable, Optional
 from gamesmanmpi_tpu.obs.registry import MetricsRegistry, default_registry
 
 
-def rss_bytes() -> int:
-    """Resident set size of this process, 0 when undeterminable."""
+def rss_bytes() -> Optional[int]:
+    """Resident set size of this process, None when undeterminable.
+
+    None, not 0: a containerized /proc-less host (or a masked
+    ``/proc/self/statm``) is a *measurement* failure, and a heartbeat
+    stream full of ``rss_bytes: 0`` reads as "the solver uses no
+    memory" — the record carries ``null`` instead and the gauge is
+    simply not set. Never raises (the beat must not be able to
+    traceback once per interval on an exotic host)."""
     try:
         with open("/proc/self/statm") as fh:
             return int(fh.read().split()[1]) * (os.sysconf("SC_PAGE_SIZE"))
@@ -47,8 +54,8 @@ def rss_bytes() -> int:
         # but a usable fallback where /proc is absent.
         rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
         return rss if sys.platform == "darwin" else rss * 1024
-    except Exception:  # pragma: no cover - exotic platforms
-        return 0
+    except Exception:  # exotic platforms / faked failures in tests
+        return None
 
 
 def process_rank():
@@ -163,6 +170,9 @@ class Heartbeat:
         rec: dict = {
             "phase": "heartbeat",
             "uptime_secs": round(self._clock() - self._t0, 3),
+            # None (JSON null) when /proc and the resource fallback are
+            # both unavailable — a masked /proc must degrade the one
+            # field, not traceback every beat (tests fake the failure).
             "rss_bytes": rss_bytes(),
         }
         rank = process_rank()
@@ -179,7 +189,10 @@ class Heartbeat:
                 rec["progress"] = dict(self.progress() or {})
             except Exception:  # the watched solver owns its own errors
                 pass
-        dev = device_memory_stats()
+        try:
+            dev = device_memory_stats()
+        except Exception:  # noqa: BLE001 - belt-and-braces: never a
+            dev = {}       # traceback-per-beat, whatever the backend does
         if dev:
             rec["devices"] = dev
         self.beats += 1
@@ -187,9 +200,11 @@ class Heartbeat:
         reg.counter(
             "gamesman_heartbeat_beats_total", "heartbeat records emitted"
         ).inc()
-        reg.gauge(
-            "gamesman_rss_bytes", "resident set size of the solver process"
-        ).set(rec["rss_bytes"])
+        if rec["rss_bytes"] is not None:
+            reg.gauge(
+                "gamesman_rss_bytes",
+                "resident set size of the solver process",
+            ).set(rec["rss_bytes"])
         for label, stats in dev.items():
             if "bytes_in_use" in stats:
                 reg.gauge(

@@ -302,6 +302,16 @@ class MetricsRegistry:
                             },
                             "sum": total,
                             "count": count,
+                            # Estimated quantiles (bucket interpolation):
+                            # the one derivation site — /status payloads,
+                            # obs tooling, and tests read these instead of
+                            # re-deriving from the raw buckets.
+                            "quantiles": {
+                                _quantile_key(q): v
+                                for q, v in estimate_quantiles(
+                                    fam.buckets, counts
+                                ).items()
+                            },
                         })
                     else:
                         rows.append(
@@ -338,6 +348,60 @@ class MetricsRegistry:
                     else:
                         lines.append(_sample(name, key, fam.values[key]))
         return "\n".join(lines) + ("\n" if lines else "")
+
+
+#: Quantiles every histogram snapshot estimates (p50/p95/p99 — the
+#: operator set; consumers wanting others call estimate_quantiles).
+SNAPSHOT_QUANTILES = (0.5, 0.95, 0.99)
+
+
+def estimate_quantiles(bounds, counts, qs=SNAPSHOT_QUANTILES):
+    """Estimate quantiles from histogram buckets by linear interpolation.
+
+    ``bounds`` are the inclusive upper bucket boundaries (ending +Inf),
+    ``counts`` the NON-cumulative per-bucket counts. Within a bucket the
+    distribution is assumed uniform (the standard Prometheus
+    ``histogram_quantile`` model); a quantile landing in the +Inf bucket
+    returns the last finite bound (the estimate is saturated, never
+    invented). Returns ``{q: value | None}`` — None when the histogram
+    is empty. One implementation, so ``/status``, ``--metrics-out``
+    consumers, obs_report, and tests stop re-deriving it from raw
+    buckets independently.
+    """
+    total = sum(counts)
+    out: dict = {}
+    finite = [b for b in bounds if not math.isinf(b)]
+    top = finite[-1] if finite else 0.0
+    for q in qs:
+        if total == 0:
+            out[q] = None
+            continue
+        target = q * total
+        cum = 0
+        value = top
+        for i, (b, c) in enumerate(zip(bounds, counts)):
+            if c == 0:
+                cum += c
+                continue
+            if cum + c >= target:
+                if math.isinf(b):
+                    value = top
+                else:
+                    lo = 0.0 if i == 0 else (
+                        bounds[i - 1]
+                        if not math.isinf(bounds[i - 1]) else 0.0
+                    )
+                    value = lo + (b - lo) * (target - cum) / c
+                break
+            cum += c
+        out[q] = value
+    return out
+
+
+def _quantile_key(q: float) -> str:
+    """0.5 -> "p50", 0.99 -> "p99" (snapshot key spelling)."""
+    s = f"{q * 100:g}".replace(".", "_")
+    return f"p{s}"
 
 
 def _sample(name: str, labels: Tuple[Tuple[str, str], ...],

@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Optional
 
+from gamesmanmpi_tpu.obs import flightrec
 from gamesmanmpi_tpu.obs.registry import MetricsRegistry, default_registry
 
 #: Registry families spans record into.
@@ -135,6 +136,19 @@ class Span:
         self._log = log
         self._secs: Optional[float] = None
         self._t0 = self._clock()
+        # Flight recorder (obs/flightrec.py): every span registers as
+        # in-flight at construction so a post-mortem dump can name what
+        # was running when the process died; end() converts it to a
+        # ring event. One lock + dict op per span — span rate is
+        # per-level/per-batch, never per-position. Guarded: the
+        # recorder is an auxiliary surface and must never be able to
+        # kill the solve it is recording.
+        try:
+            flightrec.default_recorder().span_begin(
+                id(self), name, self.fields
+            )
+        except Exception:  # noqa: BLE001 - diagnostics only
+            pass
 
     def set(self, **fields) -> "Span":
         self.fields.update(fields)
@@ -167,6 +181,12 @@ class Span:
                     "summed integer payload fields of traced phases",
                     span=self.name, key=k,
                 ).inc(v)
+        try:
+            flightrec.default_recorder().span_end(
+                id(self), self.name, self._secs, self.fields
+            )
+        except Exception:  # noqa: BLE001 - diagnostics only
+            pass
         sink = _SINK
         if sink is not None:
             sink.add_complete(

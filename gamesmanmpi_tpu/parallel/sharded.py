@@ -100,7 +100,14 @@ from gamesmanmpi_tpu.ops.provenance import (
     dedup_provenance,
     provenance_sort_bytes,
 )
-from gamesmanmpi_tpu.obs import Span, default_registry
+from gamesmanmpi_tpu.obs import (
+    SolveStatusTracker,
+    Span,
+    default_registry,
+    maybe_status_server,
+)
+from gamesmanmpi_tpu.obs import flightrec
+from gamesmanmpi_tpu.obs import status as obs_status
 from gamesmanmpi_tpu.parallel.mesh import AXIS, make_mesh, shard_map
 from gamesmanmpi_tpu.resilience import faults
 from gamesmanmpi_tpu.resilience import preempt
@@ -143,6 +150,7 @@ from gamesmanmpi_tpu.solve.engine import (
     canonical_children,
     canonical_scalar,
     get_kernel,
+    roofline_stats,
     set_dispatch_sink,
     tally_dispatch,
 )
@@ -769,6 +777,11 @@ class ShardedSolver:
         #: phase/level progress for the watchdog (replaced atomically,
         #: never mutated — same contract as the single-device engine's).
         self.progress: dict = {"phase": "init", "rank": self.rank}
+        #: live-status progress model + endpoint (obs/status.py,
+        #: GAMESMAN_STATUS_PORT): rank 0 additionally serves the
+        #: fleet-merged view scraped via the coordinator address book.
+        self.status_tracker = SolveStatusTracker()
+        self._status_server = None
         # Mesh identity participates in the process-wide kernel cache key
         # (same shard count over different device sets must not share).
         self._mesh_key = tuple(d.id for d in self.mesh.devices.flat)
@@ -937,6 +950,11 @@ class ShardedSolver:
             "transient step failures absorbed by retry",
             point=point,
         ).inc()
+        flightrec.record(
+            "retry", point=point, attempt=attempt, level=level,
+            coordinated=True,
+            error=str(err)[:120] if err is not None else "peer",
+        )
         if self.logger is not None:
             rec = {
                 "phase": "retry",
@@ -1015,6 +1033,12 @@ class ShardedSolver:
                         self.logger.log(rec)
                     except Exception:  # noqa: BLE001 - exiting anyway
                         pass
+                # Post-mortem before the hard exit: this rank's ring
+                # names the collective it died inside (timer thread,
+                # never a signal handler — flightrec's locking is fine).
+                flightrec.record("collective_deadline", point=point,
+                                 level=level)
+                flightrec.dump("collective_deadline")
                 os._exit(WATCHDOG_EXIT_CODE)
 
             timer = threading.Timer(secs, expire)
@@ -1602,6 +1626,11 @@ class ShardedSolver:
             counts = np.asarray(count).reshape(-1).astype(np.int64)
             total = int(counts.sum())
             if total == 0:
+                self.status_tracker.forward_level(
+                    k, int(levels[k].counts.sum()),
+                    time.perf_counter() - t0,
+                )
+                flightrec.boundary("forward", k)
                 break
             if self.use_edges:
                 # Edges belong to the level just EXPANDED (they index into
@@ -1660,6 +1689,7 @@ class ShardedSolver:
             frontier = nxt
             cap = next_cap
             self._ckpt_forward_level(k + 1, rec)
+            lvl_secs = time.perf_counter() - t0
             if self.logger is not None:
                 self.logger.log(
                     {
@@ -1671,10 +1701,15 @@ class ShardedSolver:
                         "route_cap": route_cap,
                         "bytes_routed": self.bytes_routed - b0[0],
                         "bytes_sorted": self.bytes_sorted - b0[1],
+                        "bytes_hbm": self.bytes_sorted - b0[1],
                         "dispatches": self.dispatch_total - disp0,
-                        "secs": time.perf_counter() - t0,
+                        "secs": lvl_secs,
                     }
                 )
+            self.status_tracker.forward_level(
+                k, int(levels[k].counts.sum()), lvl_secs
+            )
+            flightrec.boundary("forward", k)
             k += 1
         return levels
 
@@ -1818,6 +1853,7 @@ class ShardedSolver:
                     self.bytes_sorted += (
                         S * (pool.shape[1] + ccap) * (item + compaction)
                     )
+            lvl_secs = time.perf_counter() - t0
             if self.logger is not None:
                 self.logger.log(
                     {
@@ -1829,10 +1865,14 @@ class ShardedSolver:
                         "route_cap": route_cap,
                         "bytes_routed": self.bytes_routed - b0[0],
                         "bytes_sorted": self.bytes_sorted - b0[1],
+                        "bytes_hbm": self.bytes_sorted - b0[1],
                         "dispatches": self.dispatch_total - disp0,
-                        "secs": time.perf_counter() - t0,
+                        "secs": lvl_secs,
                     }
                 )
+            self.status_tracker.forward_level(k, int(counts.sum()),
+                                              lvl_secs)
+            flightrec.boundary("forward", k)
         return levels
 
     def _run_backward_step(self, stacked, cap: int, window_caps: tuple,
@@ -2206,10 +2246,17 @@ class ShardedSolver:
                 bytes_routed=self.bytes_routed - b0[0],
                 bytes_sorted=self.bytes_sorted - b0[1],
                 bytes_gathered=self.bytes_gathered - b0[2],
+                bytes_hbm=(self.bytes_sorted - b0[1])
+                + (self.bytes_gathered - b0[2]),
                 io_wait_secs=round(
                     self.store.stats()["io_wait_secs"] - io0, 6
                 ),
             )
+            self.status_tracker.backward_level(
+                k, int(rec.counts.sum()), sp.secs,
+                resumed=from_checkpoint,
+            )
+            flightrec.boundary("backward", k)
         return resolved
 
     def _hint_backward_level(self, k: int, rec, completed) -> None:
@@ -2747,11 +2794,29 @@ class ShardedSolver:
         single-device engine; `progress` is replaced atomically at each
         phase/level boundary)."""
         wd = maybe_watchdog(lambda: self.progress, logger=self.logger)
+        self.status_tracker.begin(
+            game=self.game.name, engine="sharded", shards=self.S,
+            world=self.num_processes, rank=self.rank,
+        )
+        self._status_server = maybe_status_server(
+            self._status_payload, rank=self.rank,
+            world=self.num_processes,
+        )
+        if self._status_server is not None and self.coord is not None:
+            # Publish this rank's /status address into the coordinator's
+            # address book so rank 0's fleet view can scrape it.
+            try:
+                self.coord.announce(self._status_server.address)
+            except CoordinationError:
+                pass  # status stays rank-local; the solve is unaffected
         prev_sink = set_dispatch_sink(self._on_dispatch)
         try:
             return self._solve_impl()
         finally:
             set_dispatch_sink(prev_sink)
+            if self._status_server is not None:
+                self._status_server.stop()
+                self._status_server = None
             # Pending pipelined seals are safe to run even on the error
             # path — their payload writes are already queued and waited
             # on — and losing them would unseal levels whose files are
@@ -2764,6 +2829,41 @@ class ShardedSolver:
                 wd.stop()
             if self.coord is not None:
                 self.coord.close()
+
+    def _status_payload(self) -> dict:
+        """The /status body (HTTP handler threads; reads only
+        atomically-replaced state). Rank 0 of a multi-process run folds
+        in the fleet-merged view: every announced peer's /status is
+        scraped (short deadline, dead peers degrade to absent) and
+        per-level walls merge as max-across-ranks with stragglers
+        flagged past GAMESMAN_STATUS_STRAGGLER_FACTOR x the median."""
+        snap = self.status_tracker.snapshot(progress=self.progress)
+        snap["retries"] = self.retries
+        snap["dispatches_total"] = self.dispatch_total
+        try:
+            snap["io"] = {
+                k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in self.store_stats().items()
+            }
+        except Exception:  # noqa: BLE001 - stubbed stores in tests
+            pass
+        if self.rank == 0 and self.num_processes > 1:
+            peer_snaps = {0: snap}
+            if self.coord is not None:
+                try:
+                    book = self.coord.peers()
+                except CoordinationError:
+                    book = {}
+                for r, addr in book.items():
+                    if r == 0:
+                        continue
+                    got = obs_status.fetch_status(addr)
+                    if got is not None:
+                        peer_snaps[r] = got
+            snap["fleet"] = obs_status.merge_fleet(
+                peer_snaps, world=self.num_processes
+            )
+        return snap
 
     def _solve_impl(self) -> SolveResult:
         g = self.game
@@ -2874,6 +2974,11 @@ class ShardedSolver:
         # Positions counted from the per-shard counters, not the tables —
         # valid in store_tables=False mode too.
         num_positions = sum(int(rec.counts.sum()) for rec in levels.values())
+        # The level schedule is fixed: /status's ETA model now knows the
+        # remaining backward work exactly (obs/status.py).
+        self.status_tracker.set_schedule(
+            {k: int(rec.counts.sum()) for k, rec in levels.items()}
+        )
         resolved = self._backward(levels, start_level, init)
         # Settle the tail of the pipeline before accounting: deferred
         # seals run, their tickets resolve into ckpt_bytes_*, and the
@@ -2920,6 +3025,21 @@ class ShardedSolver:
             "dispatches_per_level": round(
                 self.dispatch_total / max(len(levels), 1), 2),
             "fused": fused_enabled(),
+            # ISSUE 15 roofline rollup (engine.roofline_stats): HBM
+            # operand bytes are the sort+gather sides (routed bytes are
+            # ICI traffic, accounted separately); bytes_host approximates
+            # the host side from the spill + checkpoint payloads.
+            # chips = shards only on REAL accelerator meshes: a faked
+            # CPU mesh (tests, CPU benches) is one physical chip, and
+            # dividing by S there would make this field disagree 8x
+            # with bench.py's identically-named record field.
+            "bytes_host": self.edges_bytes_spilled + self.ckpt_bytes_raw,
+            "roofline": roofline_stats(
+                self.bytes_sorted + self.bytes_gathered,
+                num_positions, t_total, self.dispatch_total,
+                chips=(self.S if jax.devices()[0].platform != "cpu"
+                       else 1),
+            ),
             **self.store_stats(),
         }
         self.progress = {"phase": "done", "rank": self.rank}

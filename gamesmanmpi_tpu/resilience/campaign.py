@@ -58,6 +58,8 @@ import time
 from typing import Dict, List, Optional
 
 from gamesmanmpi_tpu.obs import default_registry
+from gamesmanmpi_tpu.obs import flightrec
+from gamesmanmpi_tpu.obs import status as obs_status
 from gamesmanmpi_tpu.resilience.preempt import GRACE_EXIT_CODE
 from gamesmanmpi_tpu.resilience.faults import (
     KILL_EXIT_CODE,
@@ -324,6 +326,18 @@ class Campaign:
         self._shards0 = self._shards
         self._cache_mb: Optional[int] = None  # None = inherit env
         self._geometry_dirty = False
+        #: live mission-control state (ISSUE 15): mirrored by the run
+        #: loop for the /status payload — plain attribute stores read by
+        #: HTTP handler threads, never locked (the progress contract).
+        self._attempt = 0
+        self._last_cause: Optional[str] = None
+        self._no_progress = 0
+        self._backoff_deadline: Optional[float] = None
+        self._status_server = None
+        #: where the CHILD's ephemeral status server publishes its
+        #: bound address; the campaign proxies it through its own
+        #: stable port so one URL survives restarts.
+        self._solve_addr_file = pathlib.Path(config.log_dir) / "status_addr"
 
     # ----------------------------------------------------- geometry args
 
@@ -435,6 +449,17 @@ class Campaign:
             # leak-prevention as launch_multihost's child env).
             env.pop("XLA_FLAGS", None)
             env["GAMESMAN_FAKE_DEVICES"] = str(self._shards)
+        # Flight recorder (ISSUE 15): every attempt checkpoints its ring
+        # at level boundaries into the log dir, so even a SIGKILLed
+        # attempt leaves flightrec_<rank>.json from its last boundary.
+        # An operator's explicit dir wins.
+        env.setdefault("GAMESMAN_FLIGHTREC_DIR", str(self.cfg.log_dir))
+        if self._status_server is not None:
+            # The campaign owns the operator-facing status port; the
+            # child binds an ephemeral one and publishes its address,
+            # which _status_payload proxies — one port, every attempt.
+            env["GAMESMAN_STATUS_PORT"] = "0"
+            env["GAMESMAN_STATUS_ADDR_FILE"] = str(self._solve_addr_file)
         return env
 
     def _solver_args(self) -> List[str]:
@@ -445,11 +470,63 @@ class Campaign:
             "--checkpoint-dir", str(self.cfg.checkpoint_dir),
         ]
 
+    def _status_payload(self) -> dict:
+        """The campaign's /status body: its own attempt/backoff/breaker
+        state, the jax-free checkpoint progress, and — when the current
+        attempt's child has published its status address — the child's
+        live /status proxied through (one operator port that survives
+        every restart). Runs on HTTP handler threads: reads only plain
+        attributes the run loop replaces atomically."""
+        now = time.monotonic()
+        deadline = self._backoff_deadline
+        payload = {
+            "kind": "campaign",
+            "attempt": self._attempt,
+            "max_attempts": self.cfg.max_attempts,
+            "last_cause": self._last_cause,
+            "no_progress": self._no_progress,
+            "no_progress_limit": self.cfg.no_progress_limit,
+            "breaker": (
+                "open" if self._no_progress >= self.cfg.no_progress_limit
+                else "closed"
+            ),
+            "backoff_secs_remaining": (
+                round(max(0.0, deadline - now), 3)
+                if deadline is not None and deadline > now else None
+            ),
+            "preempted": self._preempted,
+            "processes": self._processes,
+            "shards": self._shards,
+            "cache_mb": self._cache_mb,
+            "progress": checkpoint_progress(self.cfg.checkpoint_dir),
+        }
+        try:
+            addr = self._solve_addr_file.read_text().strip()
+        except OSError:
+            addr = None
+        if addr:
+            # Outer budget > the child's own per-peer scrape deadline x
+            # world: the child's rank-0 handler may spend up to
+            # (W-1) x GAMESMAN_STATUS_SCRAPE_TIMEOUT assembling its
+            # fleet view (slow/dead peers), and the proxy must not time
+            # out first — that would report "solve": null exactly when
+            # the operator is investigating a sick fleet.
+            per_peer = env_float("GAMESMAN_STATUS_SCRAPE_TIMEOUT", 2.0)
+            budget = max(5.0, per_peer * (self._processes + 1))
+            payload["solve"] = obs_status.fetch_status(addr,
+                                                       timeout=budget)
+        return payload
+
     def _run_attempt(self, attempt: int) -> dict:
         """Launch one attempt and wait it out; -> {"rcs": {rank: rc},
         "log_tails": {name: str}, "wall_secs": float}. A ``None`` rc
         means the attempt timeout killed a straggler."""
         t0 = time.monotonic()
+        try:
+            # A dead child's stale address must not be proxied as live.
+            self._solve_addr_file.unlink()
+        except OSError:
+            pass
         timeout = self.cfg.attempt_timeout_secs or None
         if self._processes > 1:
             out = self._run_attempt_world(attempt, timeout)
@@ -801,10 +878,30 @@ class Campaign:
 
     def _sleep_backoff(self, secs: float) -> None:
         deadline = time.monotonic() + secs
-        while not self._preempted and time.monotonic() < deadline:
-            time.sleep(min(0.2, max(0.0, deadline - time.monotonic())))
+        self._backoff_deadline = deadline  # /status shows the countdown
+        try:
+            while not self._preempted and time.monotonic() < deadline:
+                time.sleep(
+                    min(0.2, max(0.0, deadline - time.monotonic()))
+                )
+        finally:
+            self._backoff_deadline = None
 
     def run(self) -> int:
+        # Mission-control endpoint (GAMESMAN_STATUS_PORT): the campaign
+        # holds the operator port across every attempt and proxies the
+        # live child's status through it.
+        self._status_server = obs_status.maybe_status_server(
+            self._status_payload
+        )
+        try:
+            return self._run()
+        finally:
+            if self._status_server is not None:
+                self._status_server.stop()
+                self._status_server = None
+
+    def _run(self) -> int:
         cfg = self.cfg
         t0 = time.monotonic()
         self.ledger.log({
@@ -830,6 +927,7 @@ class Campaign:
                     return GRACE_EXIT_CODE
                 self._check_disk(had_enospc=False)
                 attempt += 1
+                self._attempt = attempt
                 before = checkpoint_progress(cfg.checkpoint_dir)
                 self.echo(
                     f"[campaign] attempt {attempt}/{cfg.max_attempts} "
@@ -839,6 +937,19 @@ class Campaign:
                 )
                 last = self._run_attempt(attempt)
                 cause = self.classify(last["rcs"], last["log_tails"])
+                self._last_cause = cause
+                flightrec.record(
+                    "campaign_attempt", attempt=attempt, cause=cause,
+                    rcs=json.dumps(
+                        {str(k): v for k, v in last["rcs"].items()}
+                    ),
+                )
+                if cause != "complete":
+                    # The death classifier's post-mortem: the campaign's
+                    # own ring (attempt history, causes, geometry moves)
+                    # lands next to the attempt's per-rank dumps.
+                    flightrec.dump(cause, directory=cfg.log_dir,
+                                   rank="campaign")
                 after = checkpoint_progress(cfg.checkpoint_dir)
                 progressed = progress_score(after) > progress_score(before)
                 self.ledger.log({
@@ -893,6 +1004,7 @@ class Campaign:
                     no_progress = 0
                 else:
                     no_progress += 1
+                self._no_progress = no_progress
                 if no_progress >= cfg.no_progress_limit:
                     raise CampaignAborted(
                         f"{no_progress} consecutive attempts died "

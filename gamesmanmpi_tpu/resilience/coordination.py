@@ -128,6 +128,9 @@ class CoordinatorServer:
         self._lock = threading.Lock()
         self._rounds: Dict[str, _Round] = {}  # guarded-by: _lock
         self._resolved: Dict[str, tuple] = {}  # guarded-by: _lock
+        #: rank -> announced service address (the address book the live
+        #: status fleet scraper reads; ISSUE 15). guarded-by: _lock
+        self._peers: Dict[int, str] = {}
         self._closed = False
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -185,6 +188,21 @@ class CoordinatorServer:
             req = json.loads(_recv_line(conn).decode())
             if req.get("op") == "ping":
                 _send_json(conn, {"ok": True, "world": self.world})
+                conn.close()
+                return
+            if req.get("op") == "announce":
+                # Address-book registration (one line, no round): rank i
+                # publishes where its /status endpoint listens so rank
+                # 0's fleet-merged status view can scrape it.
+                with self._lock:
+                    self._peers[int(req["rank"])] = str(req["addr"])
+                _send_json(conn, {"ok": True})
+                conn.close()
+                return
+            if req.get("op") == "peers":
+                with self._lock:
+                    peers = {str(r): a for r, a in self._peers.items()}
+                _send_json(conn, {"ok": True, "peers": peers})
                 conn.close()
                 return
             if req.get("op") != "propose":
@@ -376,6 +394,44 @@ class EpochBarrier:
                 f"(decision={decision})"
             )
 
+    def _one_shot(self, req: dict) -> dict:
+        """One request/reply exchange outside the round protocol (the
+        address-book ops — no sequence number, no consensus)."""
+        conn = self._connect()
+        try:
+            conn.settimeout(self.connect_timeout)
+            _send_json(conn, req)
+            return json.loads(_recv_line(conn).decode())
+        except (OSError, ValueError) as e:
+            raise CoordinationError(
+                f"coordination op {req.get('op')!r} failed ({e})"
+            ) from e
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def announce(self, addr: str) -> None:
+        """Publish this rank's service address (its /status endpoint)
+        into the coordinator's address book."""
+        self._one_shot({"op": "announce", "rank": self.rank,
+                        "addr": str(addr)})
+
+    def peers(self) -> Dict[int, str]:
+        """The announced address book: ``{rank: "host:port"}``."""
+        reply = self._one_shot({"op": "peers"})
+        peers = reply.get("peers")
+        if not isinstance(peers, dict):
+            raise CoordinationError(f"coordinator replied junk: {reply!r}")
+        out: Dict[int, str] = {}
+        for r, a in peers.items():
+            try:
+                out[int(r)] = str(a)
+            except (TypeError, ValueError):
+                continue
+        return out
+
 
 class Coordination:
     """What a solver holds: the client, plus the server when this rank
@@ -391,6 +447,12 @@ class Coordination:
 
     def barrier(self, tag: str) -> None:
         self.client.barrier(tag)
+
+    def announce(self, addr: str) -> None:
+        self.client.announce(addr)
+
+    def peers(self) -> Dict[int, str]:
+        return self.client.peers()
 
     def close(self) -> None:
         if self.server is not None:

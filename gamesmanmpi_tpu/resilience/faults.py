@@ -211,6 +211,12 @@ def _inject(d: _Directive, point: str, path, ctx: dict) -> None:
         "gamesman_faults_injected_total", "injected faults fired",
         point=point, kind=d.kind,
     ).inc()
+    # Flight recorder (ISSUE 15): an injected fault is exactly the kind
+    # of recent event a post-mortem dump must show.
+    from gamesmanmpi_tpu.obs import flightrec
+
+    flightrec.record("fault", point=point, fault_kind=d.kind,
+                     visit=d.visits)
     if d.kind == "transient":
         raise TransientFault(f"injected transient fault at {where}")
     if d.kind == "fatal":

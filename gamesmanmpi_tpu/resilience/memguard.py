@@ -51,7 +51,13 @@ def check(phase: str, level=None, logger=None) -> None:
     lim = limit_mb()
     if lim <= 0:
         return
-    rss_mb = rss_bytes() / (1 << 20)
+    rss = rss_bytes()
+    if rss is None:
+        # RSS unmeasurable on this host (masked /proc, exotic platform):
+        # an armed guard that cannot read memory must not fail the solve
+        # — the kernel OOM-killer path remains, exactly as if unarmed.
+        return
+    rss_mb = rss / (1 << 20)
     if rss_mb <= lim:
         return
     from gamesmanmpi_tpu.obs import default_registry

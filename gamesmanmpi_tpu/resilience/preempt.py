@@ -92,6 +92,14 @@ def _force_exit(grace_secs: float) -> None:  # pragma: no cover - kills
         "level boundary; forcing resumable abort\n"
     )
     sys.stderr.flush()
+    # Post-mortem first (timer thread, NOT the signal handler — taking
+    # the recorder lock here is legal; the handler itself stays
+    # lock-free per GM205): what was in flight when the grace window
+    # closed is exactly what the next attempt's operator asks.
+    from gamesmanmpi_tpu.obs import flightrec
+
+    flightrec.record("preempt_deadline", grace_secs=grace_secs)
+    flightrec.dump("preempt_deadline")
     from gamesmanmpi_tpu.resilience.supervisor import WATCHDOG_EXIT_CODE
 
     os._exit(WATCHDOG_EXIT_CODE)

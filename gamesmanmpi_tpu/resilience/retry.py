@@ -106,6 +106,15 @@ def retry_call(fn, *, point: str, reset=None, level=None, attempts=None,
                 "transient step failures absorbed by retry",
                 point=point,
             ).inc()
+            # Flight recorder (ISSUE 15): retries are post-mortem gold —
+            # a death minutes after a burst of absorbed transients reads
+            # completely differently from one out of the blue.
+            from gamesmanmpi_tpu.obs import flightrec
+
+            flightrec.record(
+                "retry", point=point, attempt=attempt,
+                level=level, error=str(e)[:120],
+            )
             if on_retry is not None:
                 on_retry(attempt, e)
             if logger is not None:

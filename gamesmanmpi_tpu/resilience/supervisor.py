@@ -157,6 +157,16 @@ class Watchdog:
                 self.logger.log(rec)
             except Exception:
                 pass
+        # Post-mortem before the abort action (ISSUE 15): the flight
+        # recorder names the last completed level and the spans that
+        # were in flight when progress stopped — the diagnosis an
+        # exit-124 used to need a rerun under instrumentation for.
+        # (Watchdog thread, never a signal handler — locking is fine.)
+        from gamesmanmpi_tpu.obs import flightrec
+
+        flightrec.record("watchdog_abort",
+                         stalled_secs=round(stalled, 3))
+        flightrec.dump("watchdog_abort")
         self.action()
 
 

@@ -985,6 +985,12 @@ class _ControlHandler(BaseHTTPRequestHandler):
         sup = self.server.supervisor
         if self.path == "/healthz":
             self._send_json(200, sup.status())
+        elif self.path == "/status":
+            # Status parity with the solve stack's GAMESMAN_STATUS_PORT
+            # endpoint (docs/OBSERVABILITY.md "Live status"): one URL
+            # shape whether the process is a solver, a campaign, or
+            # this serving fleet's supervisor.
+            self._send_json(200, {"kind": "serve_fleet", **sup.status()})
         elif self.path == "/metrics":
             self._send(
                 200, sup.registry.render_prometheus().encode(),

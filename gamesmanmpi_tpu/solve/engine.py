@@ -88,7 +88,15 @@ from gamesmanmpi_tpu.ops.lookup import lookup_window, search_method
 from gamesmanmpi_tpu.ops.pallas_gather import cells_table_gather
 from gamesmanmpi_tpu.ops.provenance import dedup_provenance, gather_cells
 from gamesmanmpi_tpu.ops.padding import MIN_BUCKET, bucket_size, pad_to, pad_to_bucket
-from gamesmanmpi_tpu.obs import Heartbeat, Span, default_registry, trace_span
+from gamesmanmpi_tpu.obs import (
+    Heartbeat,
+    SolveStatusTracker,
+    Span,
+    default_registry,
+    maybe_status_server,
+    trace_span,
+)
+from gamesmanmpi_tpu.obs import flightrec
 from gamesmanmpi_tpu.resilience import faults
 from gamesmanmpi_tpu.resilience import memguard, preempt
 from gamesmanmpi_tpu.resilience.retry import retry_call
@@ -200,6 +208,25 @@ def _counted(kind: str, fn):
         return fn(*args, **kwargs)
 
     return call
+
+
+def roofline_stats(hbm_bytes: int, positions: int, wall_secs: float,
+                   dispatches: int, chips: int = 1) -> dict:
+    """The ISSUE 15 roofline rollup both engines put in their stats and
+    bench.py folds into the record: analytic HBM operand throughput,
+    per-chip solve rate, and the wall fraction spent on dispatch
+    overhead (dispatch count x the host-calibrated per-dispatch cost,
+    ``GAMESMAN_DISPATCH_COST_SECS`` — bench.py measures it; uncalibrated
+    processes report 0.0, never a guess)."""
+    wall = max(float(wall_secs), 1e-9)
+    cost = env_float("GAMESMAN_DISPATCH_COST_SECS", 0.0)
+    return {
+        "operand_gbps": round(hbm_bytes / wall / 1e9, 3),
+        "pps_per_chip": round(positions / wall / max(int(chips), 1), 1),
+        "dispatch_overhead_frac": round(
+            min(int(dispatches) * cost / wall, 1.0), 6
+        ),
+    }
 
 
 def tally_dispatch(solver, kind: str) -> None:
@@ -791,6 +818,13 @@ class Solver:
         #: kernel was in flight (downloads/export/checkpoint deferred one
         #: level — stats field; 0.0 in level mode).
         self.overlap_secs = 0.0
+        #: analytic host-transfer bytes (frontier/table uploads+downloads
+        #: and checkpoint materializations) — the host-side roofline
+        #: denominator next to bytes_sorted/bytes_gathered's HBM side.
+        self.bytes_host = 0
+        #: live-status progress model (obs/status.py): per-level schedule
+        #: + ETA behind the GAMESMAN_STATUS_PORT /status endpoint.
+        self.status_tracker = SolveStatusTracker()
 
     def _on_dispatch(self, kind: str) -> None:
         """Dispatch sink (set_dispatch_sink) — see tally_dispatch."""
@@ -1281,6 +1315,8 @@ class Solver:
                 # wait time is real) but no JSONL record — the per-level
                 # stream is unchanged from the hand-rolled log calls.
                 sp.end(log=False)
+                self.status_tracker.forward_level(k, levels[k].n, sp.secs)
+                flightrec.boundary("forward", k)
                 break
             if k + 1 >= g.num_levels:
                 # num_levels is the declared exclusive bound on level_of over
@@ -1350,12 +1386,23 @@ class Solver:
                 # expand_core: one dedup sort + the compaction.
                 level_sort_bytes = cap * g.max_moves * (item + compaction)
             self.bytes_sorted += level_sort_bytes
+            # Host-transfer bytes this level caused: the frontier download
+            # for the checkpoint write (host_states caches it) — the
+            # per-level roofline denominator on the host side.
+            fwd_host_bytes = (
+                n * item if self.checkpointer is not None else 0
+            )
+            self.bytes_host += fwd_host_bytes
             sp.end(
                 frontier=levels[k].n,
                 children=n,
                 bytes_sorted=level_sort_bytes,
+                bytes_hbm=level_sort_bytes,
+                bytes_host=fwd_host_bytes,
                 dispatches=self.dispatch_total - d0,
             )
+            self.status_tracker.forward_level(k, levels[k].n, sp.secs)
+            flightrec.boundary("forward", k)
             k += 1
         return levels
 
@@ -1452,6 +1499,8 @@ class Solver:
                 stored_bytes += extra
             if n == 0:
                 sp.end(log=False)
+                self.status_tracker.forward_level(k, levels[k].n, sp.secs)
+                flightrec.boundary("forward", k)
                 break
             if k + 1 >= g.num_levels:
                 raise SolverError(
@@ -1501,12 +1550,21 @@ class Solver:
                     item + 4 + compaction_sort_bytes(item)
                 )
             self.bytes_sorted += level_sort_bytes
+            fwd_host_bytes = (
+                n * item
+                if (self.checkpointer is not None or over_budget) else 0
+            )
+            self.bytes_host += fwd_host_bytes
             sp.end(
                 frontier=levels[k].n,
                 children=n,
                 bytes_sorted=level_sort_bytes,
+                bytes_hbm=level_sort_bytes,
+                bytes_host=fwd_host_bytes,
                 dispatches=self.dispatch_total - d0,
             )
+            self.status_tracker.forward_level(k, levels[k].n, sp.secs)
+            flightrec.boundary("forward", k)
             k += 1
         return levels
 
@@ -1518,6 +1576,26 @@ class Solver:
         note_dispatch("eager")
         return jnp.concatenate(
             [arr, jnp.full(cap - arr.shape[0], fill, dtype=arr.dtype)]
+        )
+
+    def _level_host_bytes(self, k: int, root_level: int, cap: int,
+                          n: int, item: int, uploaded: bool,
+                          from_checkpoint: bool) -> int:
+        """Analytic host-transfer bytes of one resolved fast-path level
+        (the roofline span field): the state re-upload when the level
+        was host-spilled, plus the table materialization download
+        (states + packed values/remoteness) when one will happen. ONE
+        formula for both fast backward variants — hand-synced copies
+        drift."""
+        will_tbl = (
+            self.store_tables or k == root_level
+            or self.checkpointer is not None
+            or self.level_sink is not None
+        )
+        return (
+            (cap * item if uploaded else 0)
+            + (n * (item + 5)
+               if will_tbl and not from_checkpoint else 0)
         )
 
     def _backward_plan(self, levels: Dict[int, _Level]):
@@ -1625,6 +1703,7 @@ class Solver:
             self.progress = {"phase": "backward", "level": k, "n": n}
             preempt.check("backward", level=k, logger=self.logger)
             memguard.check("backward", level=k, logger=self.logger)
+            uploaded = rec.dev is None
             if rec.dev is not None:
                 states_dev = rec.dev
             else:
@@ -1719,12 +1798,22 @@ class Solver:
                 # Same enqueue-run-ahead bound as the unfused path: one
                 # 8-byte fetch per BIG level caps liveness.
                 np.asarray(misses)
+            item = np.dtype(g.state_dtype).itemsize
+            lvl_host_bytes = self._level_host_bytes(
+                k, root_level, cap, n, item, uploaded, from_checkpoint
+            )
+            self.bytes_host += lvl_host_bytes
             sp.end(
                 n=n,
                 resumed=from_checkpoint,
                 bytes_gathered=lvl_gather_bytes,
+                bytes_hbm=lvl_gather_bytes,
+                bytes_host=lvl_host_bytes,
                 dispatches=self.dispatch_total - d0,
             )
+            self.status_tracker.backward_level(k, n, sp.secs,
+                                               resumed=from_checkpoint)
+            flightrec.boundary("backward", k)
         if pending_fin is not None:
             pending_fin()
         return resolved
@@ -1770,6 +1859,7 @@ class Solver:
             preempt.check("backward", level=k, logger=self.logger)
             memguard.check("backward", level=k, logger=self.logger)
             C = common[k]
+            uploaded = rec.dev is None
             if rec.dev is not None:
                 states_dev = rec.dev
             else:
@@ -1933,13 +2023,22 @@ class Solver:
                 np.asarray(misses)
             self.bytes_sorted += lvl_sort_bytes
             self.bytes_gathered += lvl_gather_bytes
+            lvl_host_bytes = self._level_host_bytes(
+                k, root_level, cap, n, item, uploaded, from_checkpoint
+            )
+            self.bytes_host += lvl_host_bytes
             sp.end(
                 n=n,
                 resumed=from_checkpoint,
                 bytes_sorted=lvl_sort_bytes,
                 bytes_gathered=lvl_gather_bytes,
+                bytes_hbm=lvl_sort_bytes + lvl_gather_bytes,
+                bytes_host=lvl_host_bytes,
                 dispatches=self.dispatch_total - d0,
             )
+            self.status_tracker.backward_level(k, n, sp.secs,
+                                               resumed=from_checkpoint)
+            flightrec.boundary("backward", k)
         if pending_fin is not None:
             pending_fin()
         return resolved
@@ -2024,7 +2123,12 @@ class Solver:
                 frontier=int(frontier.shape[0]),
                 children=n,
                 bytes_sorted=lvl_sort_bytes,
+                bytes_hbm=lvl_sort_bytes,
             )
+            self.status_tracker.forward_level(
+                k, int(frontier.shape[0]), sp.secs
+            )
+            flightrec.boundary("forward", k)
             k += 1
 
     def _backward_generic(self, pools: Dict[int, np.ndarray],
@@ -2134,12 +2238,27 @@ class Solver:
             # Levels deeper than the lookback window can never be read again.
             for done in [d for d in padded_cache if d > k + g.max_level_jump]:
                 del padded_cache[done]
+            item = np.dtype(g.state_dtype).itemsize
+            # Deliberately NOT _level_host_bytes: generic-path pools are
+            # host-resident (the padded frontier uploads every level)
+            # and states never re-download — only the packed
+            # values/remoteness (5 B/row) come back.
+            lvl_host_bytes = (
+                padded.shape[0] * item
+                + (0 if from_checkpoint else int(n) * 5)
+            )
+            self.bytes_host += lvl_host_bytes
             sp.end(
                 n=n,
                 resumed=from_checkpoint,
                 bytes_sorted=lvl_sort_bytes,
                 bytes_gathered=lvl_gather_bytes,
+                bytes_hbm=lvl_sort_bytes + lvl_gather_bytes,
+                bytes_host=lvl_host_bytes,
             )
+            self.status_tracker.backward_level(k, int(n), sp.secs,
+                                               resumed=from_checkpoint)
+            flightrec.boundary("backward", k)
             if self.checkpointer is not None and not from_checkpoint:
                 with trace_span("checkpoint", level=k, kind="level"):
                     self.checkpointer.save_level(k, table)
@@ -2166,6 +2285,12 @@ class Solver:
                 logger=self.logger,
             ).start()
         wd = maybe_watchdog(lambda: self.progress, logger=self.logger)
+        # Live status endpoint (GAMESMAN_STATUS_PORT / --status-port):
+        # read-only /status + /metrics served for the solve's lifetime.
+        self.status_tracker.begin(
+            game=self.game.name, engine="classic", world=1, rank=0,
+        )
+        status_srv = maybe_status_server(self._status_payload)
         prev_sink = set_dispatch_sink(self._on_dispatch)
         try:
             return self._solve_impl()
@@ -2175,6 +2300,16 @@ class Solver:
                 hb.stop()
             if wd is not None:
                 wd.stop()
+            if status_srv is not None:
+                status_srv.stop()
+
+    def _status_payload(self) -> dict:
+        """The /status body (runs on HTTP handler threads: reads only
+        atomically-replaced state — the `progress` contract)."""
+        snap = self.status_tracker.snapshot(progress=self.progress)
+        snap["retries"] = self.retries
+        snap["dispatches_total"] = self.dispatch_total
+        return snap
 
     def _solve_impl(self) -> SolveResult:
         g = self.game
@@ -2240,6 +2375,12 @@ class Solver:
                     self.checkpointer.mark_frontiers_complete()
             t_forward = time.perf_counter() - t0
             num_positions = sum(rec.n for rec in levels.values())
+            # Forward fixed the per-level position counts: publish the
+            # level schedule so /status's ETA model knows the remaining
+            # backward work exactly (obs/status.py).
+            self.status_tracker.set_schedule(
+                {k: rec.n for k, rec in levels.items()}
+            )
             resolved = self._backward_fast(levels, start_level)
         else:
             if saved is not None:
@@ -2254,6 +2395,9 @@ class Solver:
                     self.checkpointer.save_frontiers(pools)
             t_forward = time.perf_counter() - t0
             num_positions = sum(int(a.shape[0]) for a in pools.values())
+            self.status_tracker.set_schedule(
+                {k: int(a.shape[0]) for k, a in pools.items()}
+            )
             resolved = self._backward_generic(pools, start_level)
 
         t_total = time.perf_counter() - t0
@@ -2297,6 +2441,17 @@ class Solver:
             "overlap_secs": round(self.overlap_secs, 3),
             "fused": bool(self.use_fused),
             "pipeline": self.pipeline,
+            # ISSUE 15 roofline accounting: the per-solve rollup of the
+            # per-level bytes_hbm/bytes_host/dispatches/wall span fields.
+            # dispatch_overhead_frac prices the dispatch count against a
+            # measured per-dispatch cost (bench.py calibrates
+            # GAMESMAN_DISPATCH_COST_SECS on the running host; 0 = not
+            # calibrated, the fraction reads 0 rather than invented).
+            "bytes_host": self.bytes_host,
+            "roofline": roofline_stats(
+                self.bytes_sorted + self.bytes_gathered,
+                num_positions, t_total, self.dispatch_total, chips=1,
+            ),
         }
         self.progress = {"phase": "done"}
         if self.logger is not None:

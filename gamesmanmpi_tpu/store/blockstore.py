@@ -65,6 +65,7 @@ import threading
 import time
 
 from gamesmanmpi_tpu.obs import default_registry
+from gamesmanmpi_tpu.obs import flightrec
 from gamesmanmpi_tpu.resilience import faults
 from gamesmanmpi_tpu.store.cache import TieredCache
 from gamesmanmpi_tpu.utils.env import env_bool, env_int
@@ -330,6 +331,10 @@ class BlockStore:
             try:
                 value = loader()
             except BaseException as e:  # noqa: BLE001 - re-raised at read
+                # Store events belong in the flight recorder: a torn
+                # block surfacing minutes later reads back to this.
+                flightrec.record("store_read_error", key=str(key)[:120],
+                                 error=str(e)[:120])
                 entry.error = e
                 with self._lock:
                     self._inflight.pop(key, None)
@@ -435,6 +440,10 @@ class BlockStore:
                 # seal, not kill this daemon and wedge every drain.
                 faults.fire("store.writebehind", path=ticket.path)
             except BaseException as e:  # noqa: BLE001 - surfaced at drain
+                flightrec.record(
+                    "store_write_error",
+                    path=str(ticket.path)[:160], error=str(e)[:120],
+                )
                 with self._lock:
                     self._wb_writes += 1
                     if self._wb_failed is None:

@@ -572,6 +572,99 @@ def test_gm402_prefix_of_documented_metric_still_flagged(tmp_path):
     assert got == [("GM402", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
 
 
+_SPAN_REGISTRY_DOC = """
+### Span name registry
+
+| Span | Emitted by | One per |
+|---|---|---|
+| `forward` | engine | level |
+"""
+
+
+def test_gm405_unregistered_span(tmp_path):
+    build_project(tmp_path, {"mod.py": """
+        from obs import Span, trace_span
+
+        def work(logger):
+            sp = Span("forward", logger=logger)
+            with trace_span("mystery_phase"):  # MARK
+                pass
+            sp.end()
+    """}, observability_md=_SPAN_REGISTRY_DOC)
+    _, got = findings(tmp_path)
+    assert got == [("GM405", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
+
+
+def test_gm405_stale_registry_row(tmp_path):
+    doc = _SPAN_REGISTRY_DOC + "| `ghost_phase` | nobody | nothing |\n"
+    build_project(tmp_path, {"mod.py": """
+        from obs import Span
+
+        def work():
+            Span("forward").end()
+    """}, observability_md=doc)
+    _, got = findings(tmp_path)
+    assert len(got) == 1
+    assert got[0][0] == "GM405"
+    assert got[0][1] == "docs/OBSERVABILITY.md"
+    # The finding points at the ghost row's exact doc line.
+    doc_line = next(
+        i for i, line in enumerate(doc.splitlines(), 1)
+        if "ghost_phase" in line
+    )
+    assert got[0][2] == doc_line
+
+
+def test_gm405_conditional_span_resolves_both_branches(tmp_path):
+    """The sharded backward's IfExp name registers BOTH branches; one
+    branch missing from the registry is still a finding."""
+    doc = _SPAN_REGISTRY_DOC + "| `backward` | engine | level |\n"
+    build_project(tmp_path, {"mod.py": """
+        from obs import Span
+
+        def work(edges):
+            Span("backward_edges" if edges else "backward").end()  # MARK
+            Span("forward").end()
+    """}, observability_md=doc)
+    _, got = findings(tmp_path)
+    assert got == [("GM405", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
+    # Registering the other branch clears it.
+    build_project(tmp_path, {"mod.py": """
+        from obs import Span
+
+        def work(edges):
+            Span("backward_edges" if edges else "backward").end()
+            Span("forward").end()
+    """}, observability_md=doc + "| `backward_edges` | engine | level |\n")
+    _, got = findings(tmp_path)
+    assert got == []
+
+
+def test_gm405_dynamic_span_name(tmp_path):
+    build_project(tmp_path, {"mod.py": """
+        from obs import Span
+
+        def work(name):
+            Span(name).end()  # MARK
+            Span("forward").end()
+    """}, observability_md=_SPAN_REGISTRY_DOC)
+    _, got = findings(tmp_path)
+    assert got == [("GM405", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
+
+
+def test_gm405_skipped_without_registry_section(tmp_path):
+    """A project whose OBSERVABILITY.md has no span registry opts the
+    family out entirely (same shape as the exit-code registry)."""
+    build_project(tmp_path, {"mod.py": """
+        from obs import Span
+
+        def work():
+            Span("anything_at_all").end()
+    """}, observability_md="no registry section here")
+    _, got = findings(tmp_path)
+    assert got == []
+
+
 def test_gm403_dynamic_metric_name(tmp_path):
     build_project(tmp_path, {"mod.py": """
         def emit(reg, which):

@@ -150,6 +150,83 @@ def test_jsonl_records_carry_rank(two_process_solve):
         assert all(r.get("rank") == rank for r in records), records[:3]
 
 
+def _free_port_pair():
+    """Two consecutive free ports (base for rank 0, base+1 for rank 1 —
+    the status server's rank-offset convention). Best-effort: bind both
+    to prove the pair, retrying a few candidates."""
+    import socket
+
+    for _ in range(16):
+        s0 = socket.socket()
+        try:
+            s0.bind(("127.0.0.1", 0))
+            base = s0.getsockname()[1]
+            s1 = socket.socket()
+            try:
+                s1.bind(("127.0.0.1", base + 1))
+            except OSError:
+                continue
+            finally:
+                s1.close()
+            return base
+        finally:
+            s0.close()
+    pytest.skip("could not find two consecutive free ports")
+
+
+def test_two_process_live_status_fleet_merge(tmp_path):
+    """ISSUE 15 acceptance: a REAL 2-process sharded solve serves
+    /status on rank 0 with the fleet-merged per-rank view (peer
+    addresses via the coordinator address book), monotone
+    positions_solved, and a finite ETA."""
+    import time
+    import urllib.request
+
+    base = _free_port_pair()
+    env = dict(__import__("os").environ)
+    # Stretch levels so the poller observes the run mid-flight; the
+    # collective structure means a rank-0 delay paces both ranks.
+    env["GAMESMAN_FAULTS_RANK_0"] = (
+        "sharded.forward:delay=0.1:always,"
+        "sharded.backward:delay=0.05:always"
+    )
+    env["GAMESMAN_STATUS_PORT"] = str(base)
+    world = launch_multihost.start_world(
+        [_C3, "--devices", "4", "--no-tables"],
+        processes=2, log_dir=str(tmp_path), env=env,
+    )
+    samples = []
+    try:
+        while any(p.poll() is None for p in world._procs):
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{base}/status", timeout=2
+                ) as resp:
+                    samples.append(json.loads(resp.read().decode()))
+            except Exception:
+                pass
+            time.sleep(0.02)
+    finally:
+        ranks = world.wait(timeout=240)
+    _assert_world_ok(ranks)
+    assert samples, "poller never reached rank 0's /status"
+    solved = [s["positions_solved"] for s in samples]
+    assert solved == sorted(solved), "positions_solved regressed"
+    fleet_samples = [s["fleet"] for s in samples if "fleet" in s]
+    assert fleet_samples, "rank 0 never served the fleet view"
+    assert fleet_samples[-1]["world"] == 2
+    # The peer announced itself through the coordinator address book
+    # and was scraped into the merged view at least once.
+    assert any(
+        "1" in f["ranks"] for f in fleet_samples
+    ), "rank 1 never appeared in the fleet-merged view"
+    merged_levels = [f for f in fleet_samples if f["levels"]]
+    assert merged_levels, "no per-level fleet walls merged"
+    etas = [s["eta_secs"] for s in samples
+            if s.get("eta_secs") is not None]
+    assert etas and all(e < 3600 for e in etas), etas
+
+
 @pytest.mark.slow
 def test_multihost_generic_path_nim(tmp_path):
     """Generic (multi-jump) engine across 2 processes: nim 2-3-4 is WIN

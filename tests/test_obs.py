@@ -319,6 +319,39 @@ def test_rss_bytes_reports_something():
     assert rss_bytes() > 1 << 20  # a Python + jax process is > 1 MB
 
 
+def test_heartbeat_rss_unavailable_emits_null(monkeypatch):
+    """A /proc-less (or masked-/proc) host degrades the rss field to
+    null — one beat, one null, no traceback, gauge untouched (the
+    ISSUE 15 heartbeat-degradation satellite)."""
+    import builtins
+    import resource
+
+    real_open = builtins.open
+
+    def fake_open(path, *a, **kw):
+        if str(path) == "/proc/self/statm":
+            raise OSError("masked /proc")
+        return real_open(path, *a, **kw)
+
+    monkeypatch.setattr(builtins, "open", fake_open)
+    monkeypatch.setattr(
+        resource, "getrusage",
+        lambda *_: (_ for _ in ()).throw(OSError("no rusage")),
+    )
+    assert rss_bytes() is None
+    reg = MetricsRegistry()
+    logger = _ListLogger()
+    hb = Heartbeat(1, logger=logger, registry=reg)
+    rec = hb.beat()  # must not raise
+    assert rec["rss_bytes"] is None
+    assert json.loads(json.dumps(rec))["rss_bytes"] is None  # JSON null
+    assert "gamesman_rss_bytes" not in reg.snapshot()
+    # The beat still counted and still logged.
+    snap = reg.snapshot()
+    assert snap["gamesman_heartbeat_beats_total"]["values"][0]["value"] == 1
+    assert logger.records[0]["rss_bytes"] is None
+
+
 def test_solver_heartbeat_integration():
     """Solver(heartbeat_secs=...) emits heartbeat records carrying the
     solver's live progress into the shared JSONL stream."""
@@ -440,6 +473,50 @@ def test_obs_report_cli(tmp_path, capsys):
     assert "TOTAL" in out
     assert "done: game=x positions=10" in out
     assert obs_report.main([str(tmp_path / "missing.jsonl")]) == 2
+
+
+def test_obs_report_json_output(tmp_path, capsys):
+    """--json: the machine-readable report (per-level table, totals,
+    campaign summary) bench_compare/CI consume without screen-scraping
+    (the ISSUE 15 satellite)."""
+    jsonl = tmp_path / "m.jsonl"
+    jsonl.write_text(
+        json.dumps({"phase": "forward", "level": 0, "frontier": 4,
+                    "children": 9, "bytes_sorted": 72, "secs": 0.5}) + "\n"
+        + json.dumps({"phase": "backward", "level": 0, "n": 4,
+                      "bytes_sorted": 0, "bytes_gathered": 8,
+                      "secs": 0.25}) + "\n"
+        + json.dumps({"phase": "campaign_attempt", "attempt": 1,
+                      "cause": "killed", "wall_secs": 2.0,
+                      "resume_level": None}) + "\n"
+        + json.dumps({"phase": "campaign_attempt", "attempt": 2,
+                      "cause": "complete", "wall_secs": 1.0,
+                      "resume_level": 3}) + "\n"
+        + json.dumps({"phase": "campaign_done", "attempts": 2,
+                      "wall_secs": 3.5}) + "\n"
+        + json.dumps({"phase": "serve_batch", "worker": 0,
+                      "requests": 2, "batch_size": 3,
+                      "secs": 0.01}) + "\n"
+        + json.dumps({"phase": "done", "game": "x", "positions": 4,
+                      "positions_per_sec": 8.0}) + "\n"
+    )
+    obs_report = load_module(REPO / "tools" / "obs_report.py")
+    assert obs_report.main([str(jsonl), "--json"]) == 0
+    got = json.loads(capsys.readouterr().out)
+    assert got["levels"][0]["level"] == 0
+    assert got["levels"][0]["positions"] == 4
+    assert got["totals"]["positions"] == 4
+    assert got["totals"]["bytes_sorted"] == 72
+    assert got["done"][0]["game"] == "x"
+    assert got["campaign"]["attempts"] == 2
+    assert got["campaign"]["ending"]["state"] == "solved"
+    assert got["campaign"]["causes"] == {"killed": 1, "complete": 1}
+    assert got["campaign"]["time_lost_restarts_secs"] == 2.0
+    assert got["serving"][0]["worker"] == 0
+    assert got["serving"][0]["queries"] == 3
+    # The text report over the same records is unchanged in spirit.
+    assert obs_report.main([str(jsonl)]) == 0
+    assert "campaign: attempts=2" in capsys.readouterr().out
 
 
 def test_obs_report_compression_and_cache_columns(tmp_path, capsys):

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Gate a bench record against the committed BENCH_* trajectory.
+
+"As fast as the hardware allows" (ROADMAP item 3) is only a measured
+claim while someone diffs every new bench record against the history —
+this tool makes that diff a one-command CI gate::
+
+    python bench.py > BENCH_new.json
+    python tools/bench_compare.py BENCH_new.json
+
+It loads every committed ``BENCH_*.json`` at the repo root (the
+trajectory; older rounds wrapped their record under a ``parsed`` key —
+both shapes load), picks the comparable references — same ``metric``
+and same ``device`` as the new record — and compares the new record's
+headline value against the trajectory's best. The command exits
+nonzero (status 1) when ``new / best < --min-ratio`` (default 0.6, env
+``BENCH_COMPARE_MIN_RATIO``): a regression past the threshold fails
+CI; a pass prints the ratio plus the roofline-field deltas
+(operand_gbps, dispatches/level, dispatch_overhead_frac) so a
+borderline run is explainable from the output alone. Usage errors
+(unreadable/record-less input) exit with status 2; no comparable
+reference (first record on a new metric or device) passes with a note
+— there is nothing honest to gate against.
+
+Stdlib-only and jax-free, like tools/obs_report.py (whose ``--json``
+output covers per-level reports; this tool covers the one-line bench
+records).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # tools/ scripts get sys.path[0]=tools/
+    sys.path.insert(0, REPO)
+
+from gamesmanmpi_tpu.utils.env import env_float  # noqa: E402
+
+
+def load_record(path: str):
+    """One bench record from a file: a plain record dict, a
+    ``{"parsed": {...}}`` wrapper (the r01-r05 artifact shape), or the
+    last record-bearing line of a JSONL stream (bench.py prints
+    provisional records line by line). None when no record is found."""
+    try:
+        with open(path) as fh:
+            text = fh.read()
+    except OSError:
+        return None
+    candidates = []
+    try:
+        candidates.append(json.loads(text))
+    except ValueError:
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                candidates.append(json.loads(line))
+            except ValueError:
+                continue
+    for obj in reversed(candidates):
+        if not isinstance(obj, dict):
+            continue
+        if isinstance(obj.get("parsed"), dict):
+            obj = obj["parsed"]
+        if "metric" in obj and "value" in obj:
+            return obj
+    return None
+
+
+def load_trajectory(pattern: str):
+    """Every record the glob yields, newest-name-last: [(path, rec)]."""
+    out = []
+    for path in sorted(glob.glob(pattern)):
+        rec = load_record(path)
+        if rec is not None:
+            out.append((path, rec))
+    return out
+
+
+def _fmt_delta(label: str, new, ref) -> str:
+    if new is None or ref is None:
+        return f"  {label}: n/a"
+    return f"  {label}: {new} (trajectory best run: {ref})"
+
+
+def compare(new: dict, trajectory, min_ratio: float) -> tuple:
+    """-> (ok, lines). Reference = best comparable trajectory value."""
+    refs = [
+        (path, rec) for path, rec in trajectory
+        if rec.get("metric") == new.get("metric")
+        and rec.get("device") == new.get("device")
+        and float(rec.get("value") or 0.0) > 0
+        and not rec.get("provisional")
+    ]
+    lines = []
+    if not refs:
+        lines.append(
+            f"no comparable reference for metric={new.get('metric')!r} "
+            f"device={new.get('device')!r} in the trajectory — "
+            "nothing to gate against (pass)"
+        )
+        return True, lines
+    best_path, best = max(refs, key=lambda pr: float(pr[1]["value"]))
+    ratio = float(new.get("value") or 0.0) / float(best["value"])
+    lines.append(
+        f"{new['metric']}: new={float(new['value']):.1f} vs best "
+        f"{float(best['value']):.1f} ({os.path.basename(best_path)}) "
+        f"-> ratio {ratio:.3f} (min {min_ratio:.3f})"
+    )
+    nrf, brf = new.get("roofline") or {}, best.get("roofline") or {}
+    neff = new.get("efficiency") or {}
+    beff = best.get("efficiency") or {}
+    lines.append(_fmt_delta(
+        "operand_gbps",
+        nrf.get("operand_gbps", neff.get("operand_gbps")),
+        brf.get("operand_gbps", beff.get("operand_gbps")),
+    ))
+    lines.append(_fmt_delta(
+        "dispatches_per_level",
+        (new.get("dispatches") or {}).get("per_level"),
+        (best.get("dispatches") or {}).get("per_level"),
+    ))
+    lines.append(_fmt_delta(
+        "dispatch_overhead_frac",
+        nrf.get("dispatch_overhead_frac"),
+        brf.get("dispatch_overhead_frac"),
+    ))
+    if ratio < min_ratio:
+        lines.append(
+            f"REGRESSION: new value is {ratio:.2f}x the trajectory best "
+            f"(threshold {min_ratio:.2f}x) — investigate before "
+            "committing this record"
+        )
+        return False, lines
+    lines.append("ok")
+    return True, lines
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bench_compare",
+        description="Diff a new bench record against the committed "
+        "BENCH_* trajectory; nonzero status on regression past the "
+        "threshold (docs/OBSERVABILITY.md \"Roofline fields\").",
+    )
+    p.add_argument("record", help="new bench record (bench.py stdout, "
+                   "a BENCH_*.json artifact, or a JSONL stream)")
+    p.add_argument("--trajectory", default=None, metavar="GLOB",
+                   help="reference records (default: BENCH_*.json at "
+                   "the repo root)")
+    p.add_argument("--min-ratio", type=float, default=None,
+                   help="fail when new/best falls below this (env "
+                   "BENCH_COMPARE_MIN_RATIO, default 0.6)")
+    args = p.parse_args(argv)
+    min_ratio = (
+        env_float("BENCH_COMPARE_MIN_RATIO", 0.6)
+        if args.min_ratio is None else float(args.min_ratio)
+    )
+    new = load_record(args.record)
+    if new is None:
+        print(f"error: no bench record found in {args.record!r}",
+              file=sys.stderr)
+        return 2
+    pattern = args.trajectory or os.path.join(REPO, "BENCH_*.json")
+    trajectory = [
+        (path, rec) for path, rec in load_trajectory(pattern)
+        if os.path.abspath(path) != os.path.abspath(args.record)
+    ]
+    ok, lines = compare(new, trajectory, min_ratio)
+    print("\n".join(lines))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

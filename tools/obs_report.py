@@ -182,15 +182,9 @@ def format_table(rows: list[dict]) -> str:
     return "\n".join(lines)
 
 
-def summarize_serving(records: list[dict]) -> list[str]:
-    """Per-worker serving lines from ``serve_batch`` records.
-
-    A fleet run writes one worker-stamped JSONL stream per worker
-    (``serve.worker0.jsonl`` — utils/metrics.TagLogger), the serving
-    twin of the per-rank solve streams: pass them all and each worker's
-    batching behavior reports separately (workers are independent
-    processes — unlike ranks their batches never time the same event,
-    so figures accumulate per worker and are never merged by max)."""
+def serving_summary(records: list[dict]) -> list[dict]:
+    """Machine-readable per-worker serving rows (the --json form;
+    ``summarize_serving`` renders them as text)."""
     by_worker: dict = {}
     for rec in records:
         if rec.get("phase") != "serve_batch":
@@ -215,35 +209,66 @@ def summarize_serving(records: list[dict]) -> list[str]:
             cur = row["db_cache"].get(dbk)
             if cur is None or sum(cand) > sum(cur):
                 row["db_cache"][dbk] = cand
-    lines = []
+    rows = []
     for worker in sorted(by_worker, key=lambda w: (w is None, w)):
         row = by_worker[worker]
+        rows.append({
+            "worker": worker,
+            "batches": row["batches"],
+            "requests": row["requests"],
+            "queries": row["queries"],
+            "mean_batch": round(
+                row["queries"] / max(row["batches"], 1), 3
+            ),
+            "secs": round(row["secs"], 6),
+            "db_cache": {
+                str(dbk): {
+                    "hits": hits,
+                    "misses": misses,
+                    "hit_rate": round(hits / max(hits + misses, 1), 6),
+                }
+                for dbk, (hits, misses) in row["db_cache"].items()
+            },
+        })
+    return rows
+
+
+def summarize_serving(records: list[dict]) -> list[str]:
+    """Per-worker serving lines from ``serve_batch`` records.
+
+    A fleet run writes one worker-stamped JSONL stream per worker
+    (``serve.worker0.jsonl`` — utils/metrics.TagLogger), the serving
+    twin of the per-rank solve streams: pass them all and each worker's
+    batching behavior reports separately (workers are independent
+    processes — unlike ranks their batches never time the same event,
+    so figures accumulate per worker and are never merged by max)."""
+    lines = []
+    for row in serving_summary(records):
+        worker = row["worker"]
         label = "serve" if worker is None else f"serve[worker {worker}]"
-        mean = row["queries"] / max(row["batches"], 1)
         line = (
-            f"{label}: batches={row['batches']} requests={row['requests']} "
-            f"queries={row['queries']} mean_batch={mean:.1f} "
+            f"{label}: batches={row['batches']} "
+            f"requests={row['requests']} "
+            f"queries={row['queries']} mean_batch={row['mean_batch']:.1f} "
             f"secs={row['secs']:.3f}"
         )
         for dbk in sorted(row["db_cache"], key=str):
-            hits, misses = row["db_cache"][dbk]
-            rate = hits / max(hits + misses, 1)
+            cell = row["db_cache"][dbk]
             # One route keeps the plain column names; several routes
             # qualify each with its db name.
             tag = "" if len(row["db_cache"]) == 1 else f"[{dbk}]"
             line += (
-                f" db_cache_hits{tag}={hits} db_cache_misses{tag}={misses} "
-                f"db_cache_hit_rate{tag}={rate:.3f}"
+                f" db_cache_hits{tag}={cell['hits']} "
+                f"db_cache_misses{tag}={cell['misses']} "
+                f"db_cache_hit_rate{tag}={cell['hit_rate']:.3f}"
             )
         lines.append(line)
     return lines
 
 
-def summarize_export(records: list[dict]) -> list[str]:
-    """Compression summary from ``export_db`` records: a compressed
-    (format v2) export logs raw_bytes/stored_bytes per level, which
-    fold into one whole-DB ratio line (absent for v1 exports — no
-    ratio to report)."""
+def export_summary(records: list[dict]):
+    """Machine-readable compression summary from ``export_db`` records
+    (None when the stream has no compressed export)."""
     raw = stored = levels = 0
     for rec in records:
         if rec.get("phase") != "export_db" or "stored_bytes" not in rec:
@@ -252,11 +277,27 @@ def summarize_export(records: list[dict]) -> list[str]:
         raw += int(rec.get("raw_bytes", 0))
         stored += int(rec["stored_bytes"])
     if not levels:
+        return None
+    return {
+        "levels": levels,
+        "raw_bytes": raw,
+        "stored_bytes": stored,
+        "ratio": round(raw / max(stored, 1), 4),
+    }
+
+
+def summarize_export(records: list[dict]) -> list[str]:
+    """Compression summary from ``export_db`` records: a compressed
+    (format v2) export logs raw_bytes/stored_bytes per level, which
+    fold into one whole-DB ratio line (absent for v1 exports — no
+    ratio to report)."""
+    s = export_summary(records)
+    if s is None:
         return []
     return [
-        f"export_db: levels={levels} raw_MB={raw / 1e6:.1f} "
-        f"stored_MB={stored / 1e6:.1f} "
-        f"ratio={raw / max(stored, 1):.2f}x"
+        f"export_db: levels={s['levels']} raw_MB={s['raw_bytes'] / 1e6:.1f} "
+        f"stored_MB={s['stored_bytes'] / 1e6:.1f} "
+        f"ratio={s['ratio']:.2f}x"
     ]
 
 
@@ -269,15 +310,14 @@ _CAMPAIGN_PHASES = (
 )
 
 
-def summarize_campaign(records: list[dict]) -> list[str]:
-    """Campaign summary lines from a ``campaign.jsonl`` ledger
-    (resilience/campaign.py): attempts with causes and resume levels,
-    wall-clock lost to failed attempts + backoff, GC reclamation, and
-    how the campaign ended. Pass the ledger alongside (or instead of)
-    the solve streams — records interleave safely."""
+def campaign_summary(records: list[dict]):
+    """Machine-readable campaign summary from a ``campaign.jsonl``
+    ledger (None when the stream has no campaign records) — what
+    ``bench_compare``/CI consume instead of screen-scraping the text
+    line ``summarize_campaign`` renders from it."""
     attempts = [r for r in records if r.get("phase") == "campaign_attempt"]
     if not attempts:
-        return []
+        return None
     causes: dict = {}
     lost = 0.0
     resume_levels = []
@@ -310,44 +350,35 @@ def summarize_campaign(records: list[dict]) -> list[str]:
         None,
     )
     if terminal is None:
-        ending = "in flight"
+        ending = {"state": "in_flight"}
     elif terminal["phase"] == "campaign_done":
-        ending = f"solved in {float(terminal.get('wall_secs', 0.0)):.1f}s"
+        ending = {"state": "solved",
+                  "wall_secs": float(terminal.get("wall_secs", 0.0))}
     elif terminal["phase"] == "campaign_abort":
-        ending = f"ABORTED ({terminal.get('reason', '?')})"
+        ending = {"state": "aborted",
+                  "reason": terminal.get("reason", "?")}
     else:
-        ending = "preempted (resumable)"
-    lines = [
-        f"campaign: attempts={len(attempts)}"
-        + (f" runs={runs}" if runs > 1 else "")
-        + f" {ending} "
-        f"causes=" + ",".join(
-            f"{k}:{v}" for k, v in sorted(causes.items())
-        )
-        + f" resume_levels={resume_levels}"
-        + f" time_lost_restarts={lost:.1f}s backoff={backoff:.1f}s"
-        + (f" gc_reclaimed_MB={gc_bytes / 1e6:.1f}" if gc_bytes else "")
-    ]
-    # Geometry columns (elastic resume, docs/DISTRIBUTED.md): one cell
-    # per attempt — shards/ranks/cache-MB, with `!` marking a reshard
-    # adoption (the tree was sealed at a different shard count going
-    # in) — plus the reshard count and degrade causes. Emitted only
-    # when the ledger carries geometry (older ledgers stay one line).
-    geom_cells = []
+        ending = {"state": "preempted"}
+    # Geometry cells (elastic resume, docs/DISTRIBUTED.md): one per
+    # attempt carrying geometry; `adopted` marks a reshard adoption
+    # (the tree was sealed at a different shard count going in).
+    geometry = []
     for rec in attempts:
         if not any(rec.get(k) is not None
                    for k in ("shards", "processes", "cache_mb")):
             continue
         sealed = rec.get("sealed_shards")
-        adopted = (sealed is not None and rec.get("shards") is not None
-                   and sealed != rec.get("shards"))
-        geom_cells.append(
-            f"a{rec.get('attempt')}:S={rec.get('shards') or '-'}"
-            + ("!" if adopted else "")
-            + f"/W={rec.get('processes') or '-'}"
-            + (f"/cache={rec['cache_mb']}MB"
-               if rec.get("cache_mb") else "")
-        )
+        geometry.append({
+            "attempt": rec.get("attempt"),
+            "shards": rec.get("shards"),
+            "processes": rec.get("processes"),
+            "cache_mb": rec.get("cache_mb"),
+            "sealed_shards": sealed,
+            "adopted": bool(
+                sealed is not None and rec.get("shards") is not None
+                and sealed != rec.get("shards")
+            ),
+        })
     reshards = sum(
         1 for r in records if r.get("phase") == "campaign_reshard"
     )
@@ -358,15 +389,112 @@ def summarize_campaign(records: list[dict]) -> list[str]:
         elif r.get("phase") == "campaign_degrade":
             kind = r.get("kind", "?")
             degrades[kind] = degrades.get(kind, 0) + 1
-    if geom_cells or reshards or degrades:
+    return {
+        "attempts": len(attempts),
+        "runs": runs,
+        "ending": ending,
+        "causes": causes,
+        "resume_levels": resume_levels,
+        "time_lost_restarts_secs": round(lost, 3),
+        "backoff_secs": round(backoff, 3),
+        "gc_reclaimed_bytes": gc_bytes,
+        "geometry": geometry,
+        "reshards": reshards,
+        "degrades": degrades,
+    }
+
+
+def summarize_campaign(records: list[dict]) -> list[str]:
+    """Campaign summary lines from a ``campaign.jsonl`` ledger
+    (resilience/campaign.py): attempts with causes and resume levels,
+    wall-clock lost to failed attempts + backoff, GC reclamation, and
+    how the campaign ended. Pass the ledger alongside (or instead of)
+    the solve streams — records interleave safely."""
+    s = campaign_summary(records)
+    if s is None:
+        return []
+    end = s["ending"]
+    if end["state"] == "in_flight":
+        ending = "in flight"
+    elif end["state"] == "solved":
+        ending = f"solved in {end['wall_secs']:.1f}s"
+    elif end["state"] == "aborted":
+        ending = f"ABORTED ({end['reason']})"
+    else:
+        ending = "preempted (resumable)"
+    gc_bytes = s["gc_reclaimed_bytes"]
+    lines = [
+        f"campaign: attempts={s['attempts']}"
+        + (f" runs={s['runs']}" if s["runs"] > 1 else "")
+        + f" {ending} "
+        f"causes=" + ",".join(
+            f"{k}:{v}" for k, v in sorted(s["causes"].items())
+        )
+        + f" resume_levels={s['resume_levels']}"
+        + f" time_lost_restarts={s['time_lost_restarts_secs']:.1f}s"
+        + f" backoff={s['backoff_secs']:.1f}s"
+        + (f" gc_reclaimed_MB={gc_bytes / 1e6:.1f}" if gc_bytes else "")
+    ]
+    geom_cells = [
+        f"a{g['attempt']}:S={g['shards'] or '-'}"
+        + ("!" if g["adopted"] else "")
+        + f"/W={g['processes'] or '-'}"
+        + (f"/cache={g['cache_mb']}MB" if g.get("cache_mb") else "")
+        for g in s["geometry"]
+    ]
+    if geom_cells or s["reshards"] or s["degrades"]:
         lines.append(
             "campaign geometry: " + " ".join(geom_cells)
-            + f" reshards={reshards}"
+            + f" reshards={s['reshards']}"
             + (" degrades=" + ",".join(
-                f"{k}:{v}" for k, v in sorted(degrades.items())
-            ) if degrades else "")
+                f"{k}:{v}" for k, v in sorted(s["degrades"].items())
+            ) if s["degrades"] else "")
         )
     return lines
+
+
+def _aux_counts(records: list[dict]) -> dict:
+    aux: dict = {}
+    for rec in records:
+        phase = rec.get("phase")
+        # retry/ckpt_degraded already rolled into the level table's
+        # retries column; a retry without a level (serving) still lands
+        # here. serve_batch has its own per-worker summary lines.
+        if phase not in ("forward", "backward", "backward_edges", "done",
+                         "serve_batch") \
+                and phase not in _CAMPAIGN_PHASES \
+                and not (phase in ("retry", "ckpt_degraded")
+                         and "level" in rec):
+            aux[phase] = aux.get(phase, 0) + 1
+    return aux
+
+
+def report_json(records: list[dict]) -> dict:
+    """The machine-readable report (``--json``): the same level table,
+    worker merge, export/campaign summaries, and done records the text
+    report renders — as one JSON document, so ``tools/bench_compare.py``
+    and CI consume reports without screen-scraping the text table."""
+    rows = summarize_levels(records)
+    totals = {
+        "positions": sum(r["positions"] for r in rows),
+        "fwd_secs": round(sum(r["fwd_secs"] for r in rows), 6),
+        "bwd_secs": round(sum(r["bwd_secs"] for r in rows), 6),
+        "retries": sum(r.get("retries", 0) for r in rows),
+        "bytes_sorted": sum(r["bytes_sorted"] for r in rows),
+        "bytes_gathered": sum(r["bytes_gathered"] for r in rows),
+        "io_wait_secs": round(
+            sum(r.get("io_wait_secs", 0.0) for r in rows), 6
+        ),
+    }
+    return {
+        "levels": rows,
+        "totals": totals,
+        "done": [r for r in records if r.get("phase") == "done"],
+        "serving": serving_summary(records),
+        "export": export_summary(records),
+        "campaign": campaign_summary(records),
+        "other_records": _aux_counts(records),
+    }
 
 
 def report(records: list[dict]) -> str:
@@ -389,18 +517,7 @@ def report(records: list[dict]) -> str:
                     for k in keys if k in rec
                 )
             )
-    aux = {}
-    for rec in records:
-        phase = rec.get("phase")
-        # retry/ckpt_degraded already rolled into the level table's
-        # retries column; a retry without a level (serving) still lands
-        # here. serve_batch has its own per-worker summary lines.
-        if phase not in ("forward", "backward", "backward_edges", "done",
-                         "serve_batch") \
-                and phase not in _CAMPAIGN_PHASES \
-                and not (phase in ("retry", "ckpt_degraded")
-                         and "level" in rec):
-            aux[phase] = aux.get(phase, 0) + 1
+    aux = _aux_counts(records)
     if aux:
         out.append(
             "other records: " + " ".join(
@@ -419,6 +536,11 @@ def main(argv=None) -> int:
                    help="metrics file(s) written by --jsonl; pass every "
                    "per-rank file of a multi-process run and level times "
                    "merge wall-clock (max across ranks, not sum)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the machine-readable report (per-level "
+                   "table, totals, worker merge, campaign summary) as "
+                   "one JSON document instead of the text tables — the "
+                   "form bench_compare and CI consume")
     args = p.parse_args(argv)
     try:
         records = [r for path in args.jsonl for r in load_records(path)]
@@ -428,7 +550,10 @@ def main(argv=None) -> int:
     if not records:
         print("error: no parseable records", file=sys.stderr)
         return 2
-    print(report(records))
+    if args.json:
+        print(json.dumps(report_json(records), indent=1, default=str))
+    else:
+        print(report(records))
     return 0
 
 

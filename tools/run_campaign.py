@@ -126,6 +126,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--local-devices", type=int, default=None,
                    help="multi-process: fake CPU devices per rank "
                    "(launch_multihost's knob)")
+    p.add_argument("--status-port", type=int, default=None, metavar="P",
+                   help="mission control: serve GET /status on this "
+                   "port for the WHOLE campaign — attempt/backoff/"
+                   "breaker state plus the live attempt's own solve "
+                   "status proxied through, so one URL survives every "
+                   "restart (env GAMESMAN_STATUS_PORT; 0 = ephemeral; "
+                   "unset = off)")
     return p
 
 
@@ -138,6 +145,10 @@ def main(argv=None) -> int:
         split = argv.index("--")
         argv, extra = argv[:split], argv[split + 1:]
     args = build_parser().parse_args(argv)
+    if args.status_port is not None:
+        # The flag is the env twin's CLI spelling, like the solve CLI's
+        # capacity flags; Campaign reads GAMESMAN_STATUS_PORT itself.
+        os.environ["GAMESMAN_STATUS_PORT"] = str(args.status_port)
     from gamesmanmpi_tpu.resilience.campaign import (
         Campaign,
         CampaignConfig,
