@@ -679,6 +679,141 @@ def _store_bench() -> dict | None:
     return record
 
 
+def _gamedsl_bench() -> dict | None:
+    """BENCH_GAMEDSL=1: hand-written vs compiled-spec connect4 A/B.
+
+    The game compiler's performance contract (ISSUE 16) is that a
+    compiled GameSpec solves within 10% of the hand-written module it
+    replicates — the lowering emits the same masks and smear shifts, so
+    any gap is compiler overhead. Two CLI children on the same config
+    (CPU-pinned for comparability): the registry spec
+    ``connect4:w=W,h=H`` and a generated GameSpec .json for the same
+    board. Best positions/sec of BENCH_GAMEDSL_RUNS (default 2) per arm;
+    gates: compiled/hand >= BENCH_GAMEDSL_MIN_RATIO (default 0.9) and
+    byte-identical --table-out tables. Runs in the PARENT
+    (subprocess-only, never touches jax); any failure is recorded, not
+    raised. Full record → BENCH_GAMEDSL_OUT; summary joins the bench
+    record under `gamedsl`. The artifact doubles as a
+    tools/bench_compare.py record (metric
+    ``gamedsl_compiled_connect4_pps_ratio``).
+    """
+    if os.environ.get("BENCH_GAMEDSL", "0") in ("0", "", "off"):
+        return None
+    import tempfile
+
+    import numpy as np
+
+    width = int(_env_float("BENCH_GAMEDSL_W", 5))
+    height = int(_env_float("BENCH_GAMEDSL_H", 4))
+    runs = max(1, int(_env_float("BENCH_GAMEDSL_RUNS", 2)))
+    min_ratio = _env_float("BENCH_GAMEDSL_MIN_RATIO", 0.9)
+    out_path = os.environ.get("BENCH_GAMEDSL_OUT", "BENCH_gamedsl.json")
+    deadline = _env_float("GAMESMAN_BENCH_DEADLINE", 3000.0)
+    hand_spec = f"connect4:w={width},h={height}"
+    spec_doc = {
+        "gamedsl": 1,
+        "name": f"connect4_{width}x{height}",
+        "board": {"width": width, "height": height},
+        "moves": {"family": "drop"},
+        "win": {"kind": "k_in_line", "k": 4},
+    }
+    record: dict = {
+        "bench": "gamedsl_compiled_ab",
+        "metric": "gamedsl_compiled_connect4_pps_ratio",
+        "unit": "ratio",
+        "device": "cpu",
+        "game": hand_spec,
+        "spec_doc": spec_doc,
+        "runs": runs,
+        "min_ratio": min_ratio,
+    }
+
+    def _arm(name: str, game_arg: str, workdir: str) -> dict:
+        table = os.path.join(workdir, f"{name}.npz")
+        child_env = dict(os.environ)
+        child_env.pop("GAMESMAN_FAULTS", None)
+        child_env["GAMESMAN_PLATFORM"] = "cpu"
+        arm: dict = {"game": game_arg}
+        best = 0.0
+        for i in range(runs):
+            cmd = [sys.executable, "-m", "gamesmanmpi_tpu.cli", game_arg]
+            if i == 0:
+                cmd += ["--table-out", table]
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=deadline,
+                env=child_env,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            if proc.returncode != 0:
+                arm["error"] = proc.stderr[-1000:]
+                return arm
+            pps = None
+            for line in proc.stdout.splitlines():
+                if line.startswith("throughput:"):
+                    try:
+                        pps = float(line.split()[1])
+                    except (IndexError, ValueError):
+                        pass
+            if pps is None:
+                arm["error"] = "no throughput line in solve output"
+                return arm
+            best = max(best, pps)
+        arm["positions_per_sec"] = best
+        arm["table"] = table
+        return arm
+
+    try:
+        with tempfile.TemporaryDirectory(prefix="bench_gamedsl_") as wd:
+            spec_path = os.path.join(wd, "spec.json")
+            with open(spec_path, "w") as fh:
+                json.dump(spec_doc, fh)
+            hand = _arm("hand", hand_spec, wd)
+            compiled = _arm("compiled", spec_path, wd)
+            record["hand"] = hand
+            record["compiled"] = compiled
+            if "error" not in hand and "error" not in compiled:
+                ratio = (compiled["positions_per_sec"]
+                         / max(hand["positions_per_sec"], 1e-9))
+                record["value"] = round(ratio, 4)
+                record["hand_pps"] = hand["positions_per_sec"]
+                record["compiled_pps"] = compiled["positions_per_sec"]
+                # --table-out is plain npz: member-wise equality IS the
+                # solved-table equality proof (same convention as the
+                # store bench).
+                with np.load(hand["table"]) as za, \
+                        np.load(compiled["table"]) as zb:
+                    parity = sorted(za.files) == sorted(zb.files) and all(
+                        np.array_equal(za[f], zb[f]) for f in za.files
+                    )
+                record["parity_ok"] = bool(parity)
+                record["ratio_ok"] = bool(ratio >= min_ratio)
+                record["ok"] = bool(parity and record["ratio_ok"])
+            else:
+                record["ok"] = False
+                record["error"] = (
+                    hand.get("error") or compiled.get("error")
+                    or "arm failed"
+                )
+            # The table paths die with the tempdir — drop them from the
+            # committed artifact.
+            hand.pop("table", None)
+            compiled.pop("table", None)
+    except Exception as e:  # noqa: BLE001 - must never kill the bench
+        record["ok"] = False
+        record["error"] = f"{type(e).__name__}: {e}"
+    record.setdefault("value", 0.0)
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(record, fh, indent=1)
+            fh.write("\n")
+        print(f"gamedsl bench: wrote {out_path} "
+              f"(ok={record.get('ok')})", file=sys.stderr)
+    except OSError as e:
+        print(f"gamedsl bench: cannot write {out_path}: {e}",
+              file=sys.stderr)
+    return record
+
+
 def _campaign_bench() -> dict | None:
     """BENCH_CAMPAIGN=1: the self-healing campaign proof (ISSUE 12).
 
@@ -1358,6 +1493,16 @@ def main() -> int:
             if arm in sb and "io_wait_secs" in sb[arm]:
                 record["store"][f"{arm}_io_wait_secs"] = \
                     sb[arm]["io_wait_secs"]
+    gd = _gamedsl_bench()
+    if gd is not None:
+        # Summary only — per-arm run details live in the artifact file
+        # (BENCH_GAMEDSL_OUT); the one-line record stays one line.
+        record["gamedsl"] = {
+            k: gd.get(k) for k in
+            ("ok", "ratio_ok", "parity_ok", "value", "hand_pps",
+             "compiled_pps", "error")
+            if k in gd
+        }
     cb = _campaign_bench()
     if cb is not None:
         # Summary only — the full ledger lives in the artifact file

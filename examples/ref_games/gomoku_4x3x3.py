@@ -1,0 +1,71 @@
+"""Gomoku (exact-3, overline forbidden) on 4x3 as a scalar game module.
+
+Reference-style plugin shape (SURVEY.md §2.1.1): plain-int positions,
+`initial_position` / `gen_moves` / `do_move` / `primitive`. The bit
+layout matches the compiled examples/specs/gomoku_4x3x3.json game
+(X plane bits 0-11, O plane bits 12-23, cell = row * 4 + col) so the
+oracle's full table can be compared against the engine DB.
+
+The win predicate is gomoku's exact-k rule: a 3-window only wins when
+neither on-board extension cell belongs to the mover — a run of four
+(an overline) does NOT win. On a width-4 board horizontal overlines
+exist, so this differs from plain 3-in-a-row; the rule is inexpressible
+in the hand-written m,n,k module and exists here purely as a GameSpec.
+"""
+
+M, N, K = 3, 4, 3
+CELLS = M * N
+
+initial_position = 0
+
+
+def _planes(pos):
+    mask = (1 << CELLS) - 1
+    return pos & mask, (pos >> CELLS) & mask
+
+
+def _x_to_move(pos):
+    x, o = _planes(pos)
+    return bin(x).count("1") == bin(o).count("1")
+
+
+def gen_moves(pos):
+    x, o = _planes(pos)
+    occupied = x | o
+    return [i for i in range(CELLS) if not (occupied >> i) & 1]
+
+
+def do_move(pos, move):
+    if _x_to_move(pos):
+        return pos | (1 << move)
+    return pos | (1 << (CELLS + move))
+
+
+# (win_mask, forbid_mask) per 3-window: forbid holds the on-board cells
+# immediately before and after the window along its direction.
+_LINES = []
+for r in range(M):
+    for c in range(N):
+        for dr, dc in ((0, 1), (1, 0), (1, 1), (1, -1)):
+            rr, cc = r + dr * (K - 1), c + dc * (K - 1)
+            if not (0 <= rr < M and 0 <= cc < N):
+                continue
+            win = 0
+            for i in range(K):
+                win |= 1 << ((r + dr * i) * N + (c + dc * i))
+            forbid = 0
+            for fr, fc in ((r - dr, c - dc), (r + dr * K, c + dc * K)):
+                if 0 <= fr < M and 0 <= fc < N:
+                    forbid |= 1 << (fr * N + fc)
+            _LINES.append((win, forbid))
+
+
+def primitive(pos):
+    x, o = _planes(pos)
+    last = o if _x_to_move(pos) else x
+    for win, forbid in _LINES:
+        if last & win == win and last & forbid == 0:
+            return "LOSE"
+    if x | o == (1 << CELLS) - 1:
+        return "TIE"
+    return "UNDECIDED"

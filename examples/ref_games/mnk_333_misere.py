@@ -1,0 +1,59 @@
+"""Misere 3,3,3 tic-tac-toe as a reference-style scalar game module.
+
+Same plugin shape and bit layout as tictactoe.py (X plane bits 0-8,
+O plane bits 9-17, cell = row * 3 + col) with the misere convention:
+completing three-in-a-row LOSES for its maker, so the player to move
+facing a completed line has WON. The compiled counterpart is
+examples/specs/mnk_3x3x3_misere.json — the variant exists purely as a
+GameSpec (win.misere), no tensorized Python module.
+"""
+
+M, N, K = 3, 3, 3
+CELLS = M * N
+
+initial_position = 0
+
+
+def _planes(pos):
+    mask = (1 << CELLS) - 1
+    return pos & mask, (pos >> CELLS) & mask
+
+
+def _x_to_move(pos):
+    x, o = _planes(pos)
+    return bin(x).count("1") == bin(o).count("1")
+
+
+def gen_moves(pos):
+    x, o = _planes(pos)
+    occupied = x | o
+    return [i for i in range(CELLS) if not (occupied >> i) & 1]
+
+
+def do_move(pos, move):
+    if _x_to_move(pos):
+        return pos | (1 << move)
+    return pos | (1 << (CELLS + move))
+
+
+_LINES = []
+for r in range(M):
+    for c in range(N):
+        for dr, dc in ((0, 1), (1, 0), (1, 1), (1, -1)):
+            rr, cc = r + dr * (K - 1), c + dc * (K - 1)
+            if 0 <= rr < M and 0 <= cc < N:
+                mask = 0
+                for i in range(K):
+                    mask |= 1 << ((r + dr * i) * N + (c + dc * i))
+                _LINES.append(mask)
+
+
+def primitive(pos):
+    x, o = _planes(pos)
+    last = o if _x_to_move(pos) else x
+    for line in _LINES:
+        if last & line == line:
+            return "WIN"  # misere: the line's maker has lost
+    if x | o == (1 << CELLS) - 1:
+        return "TIE"
+    return "UNDECIDED"

@@ -19,6 +19,7 @@ from gamesmanmpi_tpu.analysis import (
     env_parity,
     exit_parity,
     faults_parity,
+    gamespec,
     jax_tracing,
     lifecycle,
     locks,
@@ -48,6 +49,7 @@ CHECKERS = (
     spmd.check,
     lifecycle.check,
     atomic_write.check,
+    gamespec.check,
 )
 
 

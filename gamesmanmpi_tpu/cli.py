@@ -46,8 +46,19 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "game",
-        help="built-in game spec (e.g. tictactoe, connect4:w=5,h=4, nim:heaps=3-4-5) "
-        "or a path to a reference-style game module file",
+        nargs="?",
+        default=None,
+        help="built-in game spec (e.g. tictactoe, connect4:w=5,h=4, nim:heaps=3-4-5), "
+        "a path to a declarative GameSpec .json file (docs/GAMEDSL.md), "
+        "or a path to a reference-style game module file; omit when "
+        "--spec is given",
+    )
+    p.add_argument(
+        "--spec",
+        default=None,
+        metavar="SPEC.json",
+        help="declarative GameSpec file compiled by gamedsl "
+        "(docs/GAMEDSL.md) — equivalent to passing the path as GAME",
     )
     p.add_argument(
         "--devices",
@@ -350,9 +361,28 @@ _DB_COMMANDS = ("export-db", "serve", "query")
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    # `gamesman solve ...` reads symmetrically with export-db/serve/query;
+    # the flat grammar (game spec first) stays the default. No game is
+    # named "solve", so the token is unambiguous.
+    if argv and argv[0] == "solve":
+        argv = argv[1:]
     if argv and argv[0] in _DB_COMMANDS:
         return _db_main(argv)
     args = build_parser().parse_args(argv)
+    if args.spec is not None:
+        if args.game is not None:
+            print(
+                "error: pass either GAME or --spec, not both",
+                file=sys.stderr,
+            )
+            return 2
+        args.game = args.spec
+    elif args.game is None:
+        print(
+            "error: a game is required: GAME or --spec SPEC.json",
+            file=sys.stderr,
+        )
+        return 2
     # Capacity flags are CLI spellings of the env knobs the engines read at
     # construction; set them before any solver is built, and restore on
     # exit so programmatic main() calls don't leak config to the next one.
@@ -553,7 +583,10 @@ def _solve_main(args, t0: float, logger) -> int:
 
         checkpointer = LevelCheckpointer(args.checkpoint_dir)
 
-    if pathlib.Path(args.game).is_file():
+    # A .json file is a declarative GameSpec, not a compat module: it
+    # compiles through get_game below and drives the real engine.
+    if (pathlib.Path(args.game).is_file()
+            and not args.game.lower().endswith(".json")):
         if args.engine in ("dense", "hybrid"):
             # The validation below never runs on the compat path; without
             # this, --engine dense/hybrid would be silently ignored here.
@@ -895,8 +928,14 @@ def _db_parser() -> argparse.ArgumentParser:
         "export-db",
         help="build an immutable DB from a fresh solve or a checkpoint dir",
     )
-    pe.add_argument("game", help="built-in game spec (registry specs only — "
-                    "the DB manifest must be able to reconstruct the game)")
+    pe.add_argument("game", nargs="?", default=None,
+                    help="built-in game spec, or a GameSpec .json file "
+                    "(the manifest embeds the canonical spec document, so "
+                    "the DB stays self-describing); omit when --spec is "
+                    "given")
+    pe.add_argument("--spec", default=None, metavar="SPEC.json",
+                    help="declarative GameSpec file (docs/GAMEDSL.md) — "
+                    "equivalent to passing the path as GAME")
     pe.add_argument("--out", required=True, help="DB output directory")
     pe.add_argument(
         "--from-checkpoint",
@@ -1048,6 +1087,16 @@ def _cmd_export_db(args) -> int:
     from gamesmanmpi_tpu.games import get_game
     from gamesmanmpi_tpu.utils.env import env_bool
 
+    if args.spec is not None:
+        if args.game is not None:
+            print("error: pass either GAME or --spec, not both",
+                  file=sys.stderr)
+            return 2
+        args.game = args.spec
+    elif args.game is None:
+        print("error: a game is required: GAME or --spec SPEC.json",
+              file=sys.stderr)
+        return 2
     try:
         game = get_game(args.game)
     except (KeyError, ValueError) as e:

@@ -392,7 +392,11 @@ def db_equal(dir_a, dir_b) -> list[str]:
     except DbFormatError as e:
         return [str(e)]
     diffs = []
-    for field in ("game", "spec", "state_dtype", "sym"):
+    # spec_sha256 is the gamedsl rules hash: absent on both sides for
+    # registry games (None == None), it only gates compiled-spec DBs —
+    # where a rules change must fail --same-as even before the tables
+    # are compared.
+    for field in ("game", "spec", "state_dtype", "sym", "spec_sha256"):
         if ma.get(field) != mb.get(field):
             diffs.append(
                 f"{field}: {ma.get(field)!r} != {mb.get(field)!r}"

@@ -106,6 +106,23 @@ class DbReader:
             "working-set denominator that says whether a level is being "
             "served from page cache or disk",
         )
+        if game is None and self.manifest.get("game_spec") is not None:
+            # gamedsl DB: the manifest embeds the canonical spec document,
+            # so the game reconstructs even when the original .json file
+            # moved or changed — the DB answers for the rules it was
+            # solved under, never for whatever the path now holds.
+            from gamesmanmpi_tpu.gamedsl import GameSpec, SpecError
+            from gamesmanmpi_tpu.gamedsl.compiler import compile_spec
+
+            try:
+                game = compile_spec(
+                    GameSpec.from_dict(self.manifest["game_spec"])
+                )
+            except SpecError as e:
+                raise DbFormatError(
+                    f"{self.dir}: embedded game_spec is not "
+                    f"compilable: {e}"
+                ) from e
         if game is None:
             from gamesmanmpi_tpu.games import get_game
 

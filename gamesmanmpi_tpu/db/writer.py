@@ -315,6 +315,13 @@ class DbWriter:
                 str(k): self._levels[k] for k in sorted(self._levels)
             },
         }
+        # Compiled gamedsl games carry their rules' identity: the canonical
+        # spec document makes the DB self-describing (the reader rebuilds
+        # the game even if the original .json moved), and the sha256 makes
+        # `check_db --same-as` fail loudly across a rules change.
+        if getattr(self.game, "spec_hash", None) is not None:
+            manifest["spec_sha256"] = self.game.spec_hash
+            manifest["game_spec"] = self.game.spec_doc
         if self.compress:
             manifest["compression"] = {
                 "block_positions": self.block_positions,

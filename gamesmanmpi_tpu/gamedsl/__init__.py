@@ -1,0 +1,36 @@
+"""gamedsl: declarative game descriptions compiled to solver kernels.
+
+Split in two so static tooling stays light:
+
+* gamedsl.spec — jax-free: GameSpec parsing, canonical hashing,
+  validation (spec_problems / lint_file). tools/spec_lint.py and the
+  gamesman-lint checker import only this half.
+* gamedsl.compiler — the JAX lowering (compile_spec -> TensorGame).
+
+`compile_spec` is re-exported lazily: importing gamedsl does not pull
+jax until a spec is actually compiled.
+"""
+
+from gamesmanmpi_tpu.gamedsl.spec import (  # noqa: F401
+    GameSpec,
+    SpecError,
+    lint_file,
+    load_spec,
+    spec_problems,
+)
+
+__all__ = [
+    "GameSpec",
+    "SpecError",
+    "compile_spec",
+    "lint_file",
+    "load_spec",
+    "spec_problems",
+]
+
+
+def __getattr__(name):
+    if name == "compile_spec":
+        from gamesmanmpi_tpu.gamedsl.compiler import compile_spec
+        return compile_spec
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,341 @@
+"""GameSpec -> TensorGame lowering (the compiler half of gamedsl).
+
+`compile_spec` turns a validated GameSpec into a generated TensorGame
+subclass whose expand/primitive/canonicalize/level_of are the same
+jit-ready batched JAX the hand-written games ship — built from
+topology-derived bitboard masks instead of hand-derived ones:
+
+* family "drop"  -> the guard-column encoding of games/connect4.py:
+  column c occupies bits [c*(h+1), c*(h+1)+h], guard = column msb,
+  whole-word masked down-smear decompose. The k-in-line fold's shift
+  strides are DERIVED from the spec's adjacency directions — direction
+  (dcol, drow) shifts the packed word by dcol*(h+1) + drow — which for
+  the full compass {e, n, ne, se} reproduces connect4's hand-coded
+  {h+1, 1, h+2, h} exactly.
+* family "place" -> the two-plane encoding of games/tictactoe.py:
+  X plane bits [0, m*n), O plane [m*n, 2*m*n), cell = r*n + c; the win
+  predicate is a fold over topology-enumerated k-window masks, with an
+  optional per-window forbid mask implementing the exact-k overline
+  rule (win.exact) that the hand-written module cannot express.
+
+Byte-parity with the hand-written modules is the correctness contract
+(tests/test_gamedsl.py asserts sha256-equal solved tables for connect4
+and tictactoe specs); misere and exact are the compiler-only axes that
+make genuinely new games pure descriptions.
+
+The compiled game's `cache_key` embeds the spec's canonical sha256, so
+the module-level kernel caches (solve/engine.py) and the Precompiler
+(solve/precompile.py) treat every rules change as a different program —
+a mutated spec can never silently reuse a stale kernel. `spec_doc` /
+`spec_hash` are also what db/writer.py persists into the manifest.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from gamesmanmpi_tpu.core.bitops import popcount
+from gamesmanmpi_tpu.core.values import LOSE, TIE, UNDECIDED, WIN
+from gamesmanmpi_tpu.games.base import TensorGame
+from gamesmanmpi_tpu.gamedsl.spec import (
+    DIRECTION_VECTORS,
+    GameSpec,
+    SpecError,
+    load_spec,
+    spec_problems,
+)
+
+
+def compile_spec(spec) -> TensorGame:
+    """Lower a GameSpec (or a path to one) into a generated TensorGame.
+
+    Refuses (SpecError) when the spec has error-severity problems; the
+    message carries every finding so a CLI user sees the whole list.
+    """
+    if isinstance(spec, str):
+        spec = load_spec(spec)
+    if not isinstance(spec, GameSpec):
+        spec = GameSpec.from_dict(spec)
+    errors = [
+        p for p in spec_problems(spec) if p["severity"] == "error"
+    ]
+    if errors:
+        raise SpecError(
+            f"spec {spec.name!r} is not compilable:\n" + "\n".join(
+                f"  {p['code']}: {p['message']}" for p in errors
+            )
+        )
+    cls = _DropGame if spec.family == "drop" else _PlaceGame
+    return cls(spec)
+
+
+class _CompiledGame(TensorGame):
+    """Shared shell: identity, spec plumbing, and the cache-key contract."""
+
+    uniform_level_jump = True  # both families add exactly one stone per move
+
+    def __init__(self, spec: GameSpec):
+        self.spec = spec
+        self.name = spec.name
+        self.spec_hash = spec.spec_hash
+        self.spec_doc = spec.to_doc()
+        self.sym = bool(spec.symmetry)
+        self.num_levels = spec.cells + 1
+        self.max_level_jump = 1
+        self.state_bits = spec.state_bits
+
+    @property
+    def cache_key(self):
+        # The sha256 of the canonical spec IS the rules' identity: two
+        # compiled games trace identical kernels iff their canonical specs
+        # match, so the hash (not the mutable file path or display name)
+        # keys the jit caches and the Precompiler.
+        return ("gamedsl", self.name, self.state_bits, self.spec_hash)
+
+
+class _DropGame(_CompiledGame):
+    """Gravity games (connect4 family): guard-column bitboard encoding."""
+
+    def __init__(self, spec: GameSpec):
+        super().__init__(spec)
+        width, height = spec.width, spec.height
+        self.width, self.height = width, height
+        self.k = spec.k
+        self.max_moves = width
+        dt = self.state_dtype
+        h1 = height + 1
+        self._col_masks = np.array(
+            [((1 << h1) - 1) << (c * h1) for c in range(width)], dtype=dt
+        )
+        self._top_bits = np.array(
+            [1 << (c * h1 + height) for c in range(width)], dtype=dt
+        )
+        self._full_mask = dt(
+            sum(((1 << height) - 1) << (c * h1) for c in range(width))
+        )
+        self._bottom_mask = dt(sum(1 << (c * h1) for c in range(width)))
+        # Topology-derived line strides: direction (dcol, drow) moves one
+        # step along a line, which in the packed word is a right-shift by
+        # dcol*(h+1) + drow (columns are h+1 bits apart, cells 1 bit).
+        # Sorted-deduped over the full compass this is {1, h, h+1, h+2} —
+        # connect4's hand-coded set.
+        self._dirs = tuple(
+            dt(s) for s in sorted({
+                DIRECTION_VECTORS[d][0] * h1 + DIRECTION_VECTORS[d][1]
+                for d in spec.directions_with_windows()
+            })
+        )
+        # Masks for the leak-killed whole-word down-smear (see
+        # games/connect4.py._decompose for the derivation).
+        self._smear_keep = {}
+        i = 1
+        while i <= height:
+            self._smear_keep[i] = dt(
+                sum(((1 << (h1 - i)) - 1) << (c * h1) for c in range(width))
+            )
+            i <<= 1
+        if 1 not in self._smear_keep:  # height 1: smear loop never runs
+            self._smear_keep[1] = dt(
+                sum(((1 << (h1 - 1)) - 1) << (c * h1) for c in range(width))
+            )
+
+    def initial_state(self):
+        return self._bottom_mask
+
+    def _mirror(self, states):
+        dt = self.state_dtype
+        h1 = self.height + 1
+        out = jnp.zeros(states.shape, dtype=dt)
+        for c in range(self.width):
+            col = (states >> dt(c * h1)) & self._col_masks[0]
+            out = out | (col << dt((self.width - 1 - c) * h1))
+        return out
+
+    def canonicalize(self, states):
+        if not self.sym:
+            return states
+        return jnp.minimum(states, self._mirror(states))
+
+    def _decompose(self, states):
+        dt = self.state_dtype
+        smear = states
+        i = 1
+        while i <= self.height:
+            smear = smear | ((smear >> dt(i)) & self._smear_keep[i])
+            i <<= 1
+        guards = smear ^ ((smear >> dt(1)) & self._smear_keep[1])
+        filled = smear ^ guards
+        current = states ^ guards
+        opponent = filled ^ current
+        return guards, filled, current, opponent
+
+    def expand(self, states):
+        guards, _, _, opponent = self._decompose(states)
+        children = []
+        masks = []
+        for c in range(self.width):
+            g = guards & self._col_masks[c]
+            children.append(opponent | (guards + g))
+            masks.append((guards & self._top_bits[c]) == 0)
+        return jnp.stack(children, axis=-1), jnp.stack(masks, axis=-1)
+
+    def _connected(self, stones):
+        won = jnp.zeros(stones.shape, dtype=bool)
+        for d in self._dirs:
+            x = stones
+            for i in range(1, self.k):
+                x = x & (stones >> (d * self.state_dtype(i)))
+            won = won | (x != 0)
+        return won
+
+    def primitive(self, states):
+        _, filled, _, opponent = self._decompose(states)
+        lined = self._connected(opponent)
+        full = filled == self._full_mask
+        # Normal play: the opponent completed a line, the mover has lost.
+        # Misere: completing a line loses for its maker, so the mover WINS.
+        lined_value = jnp.uint8(WIN if self.spec.misere else LOSE)
+        return jnp.where(
+            lined, lined_value,
+            jnp.where(full, jnp.uint8(TIE), jnp.uint8(UNDECIDED)),
+        )
+
+    def level_of(self, states):
+        _, filled, _, _ = self._decompose(states)
+        return popcount(filled)
+
+    def describe(self, state) -> str:
+        s = int(state)
+        h1 = self.height + 1
+        cols = [(s >> (c * h1)) & ((1 << h1) - 1) for c in range(self.width)]
+        heights = [cv.bit_length() - 1 for cv in cols]
+        total = sum(heights)
+        cur_char, opp_char = ("X", "O") if total % 2 == 0 else ("O", "X")
+        rows = []
+        for r in range(self.height - 1, -1, -1):
+            row = ""
+            for c in range(self.width):
+                if r >= heights[c]:
+                    row += "."
+                elif (cols[c] >> r) & 1:
+                    row += cur_char
+                else:
+                    row += opp_char
+            rows.append(row)
+        return "\n".join(rows)
+
+
+class _PlaceGame(_CompiledGame):
+    """Free-placement games (m,n,k family): two-bit-plane encoding."""
+
+    def __init__(self, spec: GameSpec):
+        super().__init__(spec)
+        self.m, self.n = spec.height, spec.width
+        self.cells = spec.cells
+        self.k = spec.k
+        self.max_moves = self.cells
+        dt = self.state_dtype
+        lines = []
+        for cells, forbid in spec.line_windows():
+            win_mask = 0
+            for r, c in cells:
+                win_mask |= 1 << (r * self.n + c)
+            forbid_mask = 0
+            for r, c in forbid:
+                forbid_mask |= 1 << (r * self.n + c)
+            lines.append((win_mask, forbid_mask))
+        lines = sorted(set(lines))
+        self._lines = np.array([w for w, _ in lines], dtype=dt)
+        self._forbids = np.array([f for _, f in lines], dtype=dt)
+        self._has_forbids = bool(spec.exact)
+        self._plane_mask = dt((1 << self.cells) - 1)
+        self._full = dt((1 << self.cells) - 1)
+        self._cells_shift = dt(self.cells)
+        self._bits = np.array([1 << i for i in range(self.cells)], dtype=dt)
+        self._sym_perms = spec.symmetry_group() if self.sym else []
+
+    def initial_state(self):
+        return self.state_dtype(0)
+
+    def canonicalize(self, states):
+        if not self.sym:
+            return states
+        dt = self.state_dtype
+        best = states
+        for perm in self._sym_perms:
+            out = jnp.zeros(states.shape, dtype=dt)
+            for dst, src in enumerate(perm):
+                bit = dt(1)
+                x = (states >> dt(src)) & bit
+                o = (states >> dt(self.cells + src)) & bit
+                out = out | (x << dt(dst)) | (o << dt(self.cells + dst))
+            best = jnp.minimum(best, out)
+        return best
+
+    def _planes(self, states):
+        x = states & self._plane_mask
+        o = (states >> self._cells_shift) & self._plane_mask
+        return x, o
+
+    def _x_to_move(self, states):
+        x, o = self._planes(states)
+        return popcount(x) == popcount(o)
+
+    def expand(self, states):
+        x, o = self._planes(states)
+        occupied = x | o
+        x_to_move = self._x_to_move(states)
+        zero = self.state_dtype(0)
+        shift = jnp.where(x_to_move, zero, self._cells_shift)
+        children = []
+        masks = []
+        for i in range(self.cells):
+            bit = self._bits[i]
+            empty = (occupied & bit) == 0
+            child = states | (bit << shift)
+            children.append(child)
+            masks.append(empty)
+        return jnp.stack(children, axis=-1), jnp.stack(masks, axis=-1)
+
+    def _lined(self, stones):
+        won = jnp.zeros(stones.shape, dtype=bool)
+        for i in range(self._lines.shape[0]):
+            line = self._lines[i]
+            hit = (stones & line) == line
+            if self._has_forbids:
+                # exact-k (overline) rule: the window only wins when
+                # neither on-board extension cell belongs to the mover.
+                hit = hit & ((stones & self._forbids[i]) == 0)
+            won = won | hit
+        return won
+
+    def primitive(self, states):
+        x, o = self._planes(states)
+        last = jnp.where(self._x_to_move(states), o, x)
+        lined = self._lined(last)
+        full = (x | o) == self._full
+        lined_value = jnp.uint8(WIN if self.spec.misere else LOSE)
+        return jnp.where(
+            lined, lined_value,
+            jnp.where(full, jnp.uint8(TIE), jnp.uint8(UNDECIDED)),
+        )
+
+    def level_of(self, states):
+        return popcount(states)
+
+    def describe(self, state) -> str:
+        s = int(state)
+        rows = []
+        for r in range(self.m):
+            row = ""
+            for c in range(self.n):
+                i = r * self.n + c
+                if (s >> i) & 1:
+                    row += "X"
+                elif (s >> (self.cells + i)) & 1:
+                    row += "O"
+                else:
+                    row += "."
+            rows.append(row)
+        return "\n".join(rows)

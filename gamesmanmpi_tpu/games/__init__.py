@@ -9,6 +9,11 @@ Spec grammar: "name" or "name:key=value,key=value", e.g.
     connect4:w=5,h=4     subtract:total=10,moves=1-2,misere=1
     nim:heaps=3-4-5      nim:heaps=1-2-10,misere=1
     chomp:w=4,h=3        chomp:w=3,h=3,sym=1
+
+A spec ending in ".json" is a declarative GameSpec file (docs/GAMEDSL.md)
+compiled on the fly by gamesmanmpi_tpu.gamedsl — new games with zero
+Python:
+    examples/specs/gomoku_4x3x3.json
 """
 
 from __future__ import annotations
@@ -37,6 +42,16 @@ def _intlist(v: str):
 
 def get_game(spec: str) -> TensorGame:
     """Construct a built-in game from a spec string (see module docstring)."""
+    if spec.strip().lower().endswith(".json"):
+        # A declarative GameSpec file: compile it. SpecError subclasses
+        # ValueError, so callers' bad-spec handling covers both paths.
+        from gamesmanmpi_tpu.gamedsl.compiler import compile_spec
+        try:
+            return compile_spec(spec.strip())
+        except OSError as e:
+            raise ValueError(
+                f"cannot read game spec file {spec!r}: {e}"
+            ) from e
     name, _, rest = spec.partition(":")
     kw = _parse_kwargs(rest)
     name = name.strip().lower()

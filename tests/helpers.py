@@ -27,6 +27,21 @@ def full_table(result):
     return out
 
 
+def table_sha256(result):
+    """sha256 over a SolveResult's level tables (states, values,
+    remoteness, in level order) — the byte-parity fingerprint the
+    gamedsl acceptance tests compare."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for level in sorted(result.levels):
+        t = result.levels[level]
+        h.update(np.asarray(t.states).tobytes())
+        h.update(np.asarray(t.values).tobytes())
+        h.update(np.asarray(t.remoteness).tobytes())
+    return h.hexdigest()
+
+
 def parse_prometheus_text(text):
     """Strict-enough parser for text exposition format v0.0.4: the test
     oracle for GET /metrics and render_prometheus(). Returns

@@ -118,6 +118,39 @@ def test_engine_fused_full_parity(monkeypatch, spec):
     assert fused.stats["fused"] is True
 
 
+#: The gamedsl acceptance matrix (ISSUE 16): description-only games must
+#: survive BOTH fused dedup lowerings byte-for-byte, not just the default
+#: one for the platform — a compiled game is only "wired through" if the
+#: megakernel path treats it exactly like a hand-written module.
+GAMEDSL_SPECS = [
+    "examples/specs/gomoku_4x3x3.json",      # place family, exact-k
+    "examples/specs/mnk_3x3x3_misere.json",  # misere + symmetry group
+    "examples/specs/connect4_4x4.json",      # drop family
+]
+_gamedsl_base = {}  # unfused reference solves, shared across the matrix
+
+
+@pytest.mark.parametrize("dedup", ["callback", "scatterinv"])
+@pytest.mark.parametrize("relpath", GAMEDSL_SPECS)
+def test_engine_fused_gamedsl_parity(monkeypatch, relpath, dedup):
+    import pathlib
+
+    from helpers import REPO, table_sha256
+
+    spec = str(pathlib.Path(REPO) / relpath)
+    if relpath not in _gamedsl_base:
+        _gamedsl_base[relpath] = Solver(get_game(spec),
+                                        paranoid=True).solve()
+    base = _gamedsl_base[relpath]
+    _fused_env(monkeypatch)
+    monkeypatch.setenv("GAMESMAN_FUSED_DEDUP", dedup)
+    fused = Solver(get_game(spec), paranoid=True).solve()
+    assert (fused.value, fused.remoteness) == (base.value, base.remoteness)
+    assert fused.num_positions == base.num_positions
+    assert table_sha256(fused) == table_sha256(base)
+    assert fused.stats["fused"] is True
+
+
 def test_engine_fused_level_pipeline_parity(monkeypatch):
     """GAMESMAN_PIPELINE=level under fusion: same tables, no deferral."""
     base = Solver(get_game("connect4:w=4,h=4")).solve()

@@ -139,3 +139,101 @@ def test_owner_u32_matches_u64_widening():
 def test_sentinels_sort_last():
     assert SENTINEL64 == np.iinfo(np.uint64).max
     assert SENTINEL32 == np.iinfo(np.uint32).max
+
+
+# ------------------------------------------------------------------ canonicalize
+# The contract games/base.py documents for overrides: canonicalize must be
+# a game-automorphism projection. Checked for every registered game shape
+# (sym on and off) AND every committed GameSpec compiled by gamedsl — the
+# compiler derives its symmetry permutations from generators, so this is
+# the law that keeps `sym=1` tables equal to unsymmetrized ones.
+
+from helpers import REPO as _REPO  # noqa: E402
+from gamesmanmpi_tpu.games import get_game as _get_game  # noqa: E402
+
+_CANON_SPECS = [
+    "tictactoe",
+    "tictactoe:sym=1",
+    "connect4:w=4,h=3",
+    "connect4:w=4,h=3,sym=1",
+    "nim:heaps=3-4-5",
+    "subtract:total=10,moves=1-2",
+    "chomp:w=3,h=3,sym=1",
+] + sorted(
+    str(p) for p in (_REPO / "examples" / "specs").glob("*.json")
+)
+_canon_games = {}
+
+
+def _canon_game(spec):
+    if spec not in _canon_games:
+        _canon_games[spec] = _get_game(spec)
+    return _canon_games[spec]
+
+
+def _canon_child_multisets(game, states):
+    """Per state: the sorted multiset of canonicalized legal children."""
+    kids, mask = game.expand(jnp.asarray(states))
+    canon = np.asarray(
+        game.canonicalize(kids.reshape(-1)).reshape(kids.shape)
+    )
+    mask = np.asarray(mask)
+    return [
+        tuple(sorted(int(c) for c in canon[b][mask[b]]))
+        for b in range(canon.shape[0])
+    ]
+
+
+@pytest.mark.parametrize(
+    "spec",
+    _CANON_SPECS,
+    ids=[
+        "spec-" + s.rsplit("/", 1)[-1].removesuffix(".json")
+        if s.endswith(".json") else s
+        for s in _CANON_SPECS
+    ],
+)
+@given(seed=st.integers(0, 2**16 - 1))
+@settings(max_examples=2, deadline=None)
+def test_canonicalize_is_automorphism_projection(spec, seed):
+    """Random-walk reachable states; canonicalize must be idempotent,
+    preserve level and primitive value, and project child multisets:
+    the canonical children of s equal the canonical children of
+    canonicalize(s) — the exact law symmetry-reduced solves rely on."""
+    game = _canon_game(spec)
+    rng = np.random.default_rng(seed)
+    frontier = np.asarray([game.initial_state()], dtype=game.state_dtype)
+    seen = [frontier]
+    for _ in range(5):
+        prim = np.asarray(game.primitive(jnp.asarray(frontier)))
+        frontier = frontier[prim == UNDECIDED]
+        if frontier.size == 0:
+            break
+        kids, mask = game.expand(jnp.asarray(frontier))
+        legal = np.unique(np.asarray(kids)[np.asarray(mask)])
+        if legal.size == 0:
+            break
+        frontier = rng.choice(
+            legal, size=min(legal.size, 8), replace=False
+        ).astype(game.state_dtype)
+        seen.append(frontier)
+    states = np.unique(np.concatenate(seen)).astype(game.state_dtype)
+
+    canon = np.asarray(game.canonicalize(jnp.asarray(states)))
+    # Projection: applying twice changes nothing.
+    assert (
+        np.asarray(game.canonicalize(jnp.asarray(canon))) == canon
+    ).all()
+    # Class invariants: level and primitive value are symmetry-blind.
+    assert (
+        np.asarray(game.level_of(jnp.asarray(canon)))
+        == np.asarray(game.level_of(jnp.asarray(states)))
+    ).all()
+    assert (
+        np.asarray(game.primitive(jnp.asarray(canon)))
+        == np.asarray(game.primitive(jnp.asarray(states)))
+    ).all()
+    # Automorphism projection: child classes match representative's.
+    assert _canon_child_multisets(game, states) == _canon_child_multisets(
+        game, canon
+    )

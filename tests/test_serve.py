@@ -121,8 +121,18 @@ def test_concurrent_queries_match_oracle_and_batch(nim_db):
 
         # JSON counters moved to /metrics.json (Prometheus text owns
         # /metrics; negotiation is covered in test_obs.py).
-        status, metrics = _get(base + "/metrics.json")
-        assert status == 200
+        # http_requests counts on request COMPLETION (the finally in
+        # do_POST), so the last handler threads may not have counted
+        # themselves by the time their clients have the response — give
+        # the counter a moment to settle before asserting.
+        deadline = time.monotonic() + 5.0
+        while True:
+            status, metrics = _get(base + "/metrics.json")
+            assert status == 200
+            if (metrics["http_requests"] >= 2 * n_threads
+                    or time.monotonic() > deadline):
+                break
+            time.sleep(0.02)
         assert metrics["batches"] >= 1
         assert metrics["mean_batch_size"] > 1  # coalescing happened
         assert metrics["cache_hits"] >= len(positions)
