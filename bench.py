@@ -315,7 +315,7 @@ def _multiprocess_smoke() -> dict | None:
     return artifact
 
 
-def _launch_fleet(db: str, workers: int):
+def _launch_fleet(db: str, workers: int, env: dict | None = None):
     """Launch `cli serve --workers N` on ephemeral ports and wait until
     the fleet reports ready — the subprocess choreography _serve_bench
     and _db_compress_bench share (bounded banner read: a supervisor that
@@ -335,6 +335,7 @@ def _launch_fleet(db: str, workers: int):
          "--port", "0", "--workers", str(workers),
          "--control-port", "0"],
         stdout=subprocess.PIPE, text=True,
+        env=dict(os.environ, **env) if env else None,
     )
     try:
         got: list = []
@@ -507,6 +508,9 @@ def _serve_bench() -> dict | None:
             proc.send_signal(signal.SIGTERM)
             proc.wait(timeout=60)
             proc = None
+            ab = _serve_trace_ab(db, workers, conc, positions)
+            if ab is not None:
+                artifact["trace_ab"] = ab
     except Exception as e:  # noqa: BLE001 - the bench must survive this
         artifact["error"] = f"{type(e).__name__}: {e}"
     finally:
@@ -523,6 +527,64 @@ def _serve_bench() -> dict | None:
             print(f"serve bench: cannot write {out_path}: {e}",
                   file=sys.stderr)
     return artifact
+
+
+def _serve_trace_ab(db: str, workers: int, conc: int,
+                    positions) -> dict | None:
+    """BENCH_SERVE_TRACE_AB=1 (default on under BENCH_SERVE): the
+    tracing-overhead A/B arm (ISSUE 17).
+
+    Two fresh chaos-free fleets over the already-exported DB — one with
+    query tracing on (the default), one with GAMESMAN_TRACE=0 in the
+    fleet's environment — each driven by the same load shape. The gate
+    (checked by tools/bench_compare.py): tracing-on p99 must stay within
+    BENCH_SERVE_TRACE_MAX_PCT (5%) of tracing-off, with
+    BENCH_SERVE_TRACE_SLACK_MS (2 ms) of absolute slack so a
+    sub-millisecond p99 doesn't fail the ratio on scheduler noise.
+    Sampling is tail-based, so the on-arm cost is span bookkeeping on
+    every request — exactly what this arm bounds.
+    """
+    if os.environ.get("BENCH_SERVE_TRACE_AB", "1") in ("0", "", "off"):
+        return None
+    import signal
+
+    from tools.load_gen import run_load
+
+    secs = _env_float("BENCH_SERVE_AB_SECS", 5.0)
+    max_pct = _env_float("BENCH_SERVE_TRACE_MAX_PCT", 5.0)
+    slack_ms = _env_float("BENCH_SERVE_TRACE_SLACK_MS", 2.0)
+    ab: dict = {"max_delta_pct": max_pct, "slack_ms": slack_ms,
+                "secs": secs, "ok": False}
+    arms: dict = {}
+    for arm, env in (("on", {"GAMESMAN_TRACE": "1"}),
+                     ("off", {"GAMESMAN_TRACE": "0"})):
+        fleet = _launch_fleet(db, workers, env=env)
+        proc = fleet.get("proc")
+        try:
+            if "error" in fleet:
+                ab["error"] = f"{arm} arm: {fleet['error']}"
+                return ab
+            load = run_load(
+                f"http://127.0.0.1:{fleet['port']}", positions,
+                duration=secs, concurrency=conc,
+            )
+            arms[arm] = {
+                "p50_ms": load["p50_ms"], "p99_ms": load["p99_ms"],
+                "qps": load["qps"], "requests": load["requests"],
+                "errors": load["errors"], "dropped": load["dropped"],
+            }
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=60)
+            proc = None
+        finally:
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait()
+    ab.update(arms)
+    on, off = arms["on"]["p99_ms"], arms["off"]["p99_ms"]
+    ab["delta_pct"] = round((on - off) / max(off, 1e-9) * 100.0, 2)
+    ab["ok"] = bool(on <= off * (1.0 + max_pct / 100.0) + slack_ms)
+    return ab
 
 
 def _store_bench() -> dict | None:
@@ -1539,6 +1601,12 @@ def main() -> int:
              "worker_restarts", "recovered_secs", "error")
             if k in sv
         }
+        if "trace_ab" in sv:
+            record["serve"]["trace_ab"] = {
+                k: sv["trace_ab"].get(k)
+                for k in ("ok", "delta_pct", "max_delta_pct", "error")
+                if k in sv["trace_ab"]
+            }
     print(json.dumps(record))
     return 0
 

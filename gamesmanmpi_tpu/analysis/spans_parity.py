@@ -37,8 +37,12 @@ from gamesmanmpi_tpu.analysis.project import (
     module_string_consts,
 )
 
-#: Call names that start a span (last dotted component).
-_SPAN_CALLS = {"Span", "trace_span"}
+#: Call names that start a span (last dotted component). ``qspan`` and
+#: ``add_span`` are the query-trace twins (obs/qtrace.py): different
+#: sink (per-request trace ring, not the span histogram), same registry
+#: contract — a span name an operator meets in ``GET /traces`` must be
+#: documented like every other.
+_SPAN_CALLS = {"Span", "trace_span", "qspan", "add_span"}
 
 _SECTION_RE = re.compile(r"^#+\s.*span name registry", re.IGNORECASE)
 _ROW_RE = re.compile(r"^\|\s*`([a-z_][a-z0-9_]*)`\s*\|")
@@ -88,7 +92,9 @@ def check(project: Project) -> List[Diagnostic]:
     diags: List[Diagnostic] = []
     used: Dict[str, Tuple[str, int]] = {}  # name -> first (file, line)
     for src in project.files:
-        if src.tree is None or src.rel.endswith("obs/tracing.py"):
+        if src.tree is None or src.rel.endswith(
+            ("obs/tracing.py", "obs/qtrace.py")
+        ):
             continue
         consts = module_string_consts(src.tree)
         for node in ast.walk(src.tree):

@@ -1026,6 +1026,15 @@ def _db_parser() -> argparse.ArgumentParser:
     )
     ps.add_argument("--jsonl", default=None,
                     help="write per-batch serving metrics to this JSONL file")
+    ps.add_argument(
+        "--no-trace",
+        action="store_true",
+        help="disable query-path tracing + tail sampling (sets "
+        "GAMESMAN_TRACE=0 for this process and, in fleet mode, every "
+        "worker; GET /traces then serves an empty ring). Tracing is on "
+        "by default — its off-path cost is one attribute fetch per span "
+        "site (docs/OBSERVABILITY.md \"Query tracing & SLOs\")",
+    )
     ps.add_argument("-v", "--verbose", action="store_true")
 
     pq = sub.add_parser("query", help="probe a DB offline (no server)")
@@ -1188,6 +1197,11 @@ def _cmd_serve(args) -> int:
         env_int("GAMESMAN_SERVE_WORKERS", 0)
         if args.workers is None else args.workers
     )
+    if args.no_trace:
+        # Env, not a constructor knob: workers (fork AND exec spawn
+        # modes) inherit the environment, so one setting covers the
+        # whole fleet and every TraceRing/SloEngine built under it.
+        os.environ["GAMESMAN_TRACE"] = "0"
     if args.db is None and not args.fleet_manifest:
         print("error: serve needs a DB directory (or --fleet-manifest)",
               file=sys.stderr)
@@ -1224,7 +1238,7 @@ def _cmd_serve(args) -> int:
         print(
             f"serving {reader.game.name} ({reader.num_positions} positions) "
             f"on http://{args.host}:{server.port} "
-            f"(POST /query, GET /healthz, GET /metrics)",
+            f"(POST /query, GET /healthz, GET /metrics, GET /traces)",
             flush=True,  # a supervisor tailing the pipe needs the banner NOW
         )
         # Graceful shutdown: SIGINT/SIGTERM flip /healthz to "draining"
@@ -1320,7 +1334,7 @@ def _cmd_serve_fleet(args, workers: int) -> int:
             f"http://{args.host}:{supervisor.port} with {workers} "
             f"worker(s) "
             f"(control http://{args.host}:{supervisor.control_port} — "
-            "GET /healthz, GET /metrics, POST /reload)",
+            "GET /healthz, GET /metrics, GET /traces, POST /reload)",
             flush=True,  # a harness tailing the pipe needs the banner NOW
         )
         previous = {}

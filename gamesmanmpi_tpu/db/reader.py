@@ -38,6 +38,7 @@ from gamesmanmpi_tpu.db.format import (
     read_manifest,
 )
 from gamesmanmpi_tpu.obs import default_registry
+from gamesmanmpi_tpu.obs.qtrace import qspan
 from gamesmanmpi_tpu.ops.padding import bucket_size, pad_to
 from gamesmanmpi_tpu.resilience import faults
 from gamesmanmpi_tpu.solve.engine import get_kernel, undecided_mask
@@ -312,9 +313,10 @@ class DbReader:
     def _canon_levels(self, q: np.ndarray):
         """Batched canonicalize + level_of: [K] -> (canon [K], levels [K])."""
         cap = bucket_size(q.shape[0], _MIN_QUERY_BUCKET)
-        c, lv = self._cpu_kernel(
-            "dbcanon", cap, _canon_builder, pad_to(q, cap)
-        )
+        with qspan("canonicalize", queries=int(q.shape[0])):
+            c, lv = self._cpu_kernel(
+                "dbcanon", cap, _canon_builder, pad_to(q, cap)
+            )
         n = q.shape[0]
         return (
             np.asarray(c)[:n].astype(self.game.state_dtype),
@@ -365,7 +367,9 @@ class DbReader:
                 )
                 continue
             keys, cells = self._level_arrays(int(lv))
-            idx, hit = probe_sorted_np(keys, canon[sel])
+            with qspan("searchsorted", level=int(lv),
+                       queries=int(sel.size)):
+                idx, hit = probe_sorted_np(keys, canon[sel])
             hsel = sel[hit]
             if hsel.size:
                 v, r = unpack_cells_np(np.asarray(cells[idx[hit]]))
@@ -400,10 +404,12 @@ class DbReader:
         # below the level's first key clip to block 0, where the
         # equality confirm rejects them (same sentinel-free argument as
         # probe_sorted_np).
-        bids = np.searchsorted(
-            bl.first_keys, q.astype(np.uint64, copy=False), side="right"
-        ) - 1
-        np.clip(bids, 0, bl.num_blocks - 1, out=bids)
+        with qspan("searchsorted", level=int(lv), queries=int(sel.size)):
+            bids = np.searchsorted(
+                bl.first_keys, q.astype(np.uint64, copy=False),
+                side="right",
+            ) - 1
+            np.clip(bids, 0, bl.num_blocks - 1, out=bids)
         for b in np.unique(bids):
             # Shared-store read: keyed by the stream's inode identity
             # (see SealedBlockStream.ident), so every reader/route of
@@ -412,7 +418,14 @@ class DbReader:
             def _decode(bl=bl, b=int(b), lv=lv):
                 t0 = time.perf_counter()
                 try:
-                    pair = bl.read_block(b)
+                    with qspan("block_decode", level=int(lv),
+                               block=int(b)):
+                        # The fault fires INSIDE the span: an injected
+                        # delay here is the slow-decode shape, and the
+                        # resulting trace must attribute it to decode.
+                        faults.fire("serve.block_decode",
+                                    level=int(lv), block=int(b))
+                        pair = bl.read_block(b)
                 except (BlockCorruptError, OSError) as e:
                     raise DbFormatError(
                         f"{self.dir}: level {lv} block {b} "

@@ -30,6 +30,13 @@ Connect-Four solve, arXiv:2507.05267). Three pieces, one subsystem:
   ring of recent spans/levels/retries/faults/store events dumped as
   ``flightrec_<rank>.json`` on every abnormal exit — the post-mortem
   that used to need a rerun under instrumentation.
+* ``QueryTrace`` / ``qspan`` / ``TraceRing`` (qtrace.py, ISSUE 17):
+  per-request distributed tracing for the serving fleet — W3C
+  ``traceparent`` at ingress, queue/probe/decode/store spans, a
+  tail-sampled per-worker ring behind ``GET /traces``.
+* ``SloEngine`` (slo.py, ISSUE 17): declared availability + p99-latency
+  objectives per route with multi-window burn rates; fast-burn folds
+  into ``/healthz`` as ``degraded``.
 
 docs/OBSERVABILITY.md is the operator guide.
 """
@@ -48,6 +55,18 @@ from gamesmanmpi_tpu.obs.tracing import (
 )
 from gamesmanmpi_tpu.obs.heartbeat import Heartbeat
 from gamesmanmpi_tpu.obs.flightrec import FlightRecorder, default_recorder
+from gamesmanmpi_tpu.obs.qtrace import (
+    QueryTrace,
+    TraceRing,
+    activate,
+    active_traces,
+    format_traceparent,
+    mint_trace_ids,
+    parse_traceparent,
+    qspan,
+    trace_enabled,
+)
+from gamesmanmpi_tpu.obs.slo import SloEngine
 from gamesmanmpi_tpu.obs.status import (
     SolveStatusTracker,
     StatusServer,
@@ -69,4 +88,14 @@ __all__ = [
     "SolveStatusTracker",
     "StatusServer",
     "maybe_status_server",
+    "QueryTrace",
+    "TraceRing",
+    "activate",
+    "active_traces",
+    "format_traceparent",
+    "mint_trace_ids",
+    "parse_traceparent",
+    "qspan",
+    "trace_enabled",
+    "SloEngine",
 ]

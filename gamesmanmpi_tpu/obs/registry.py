@@ -138,7 +138,8 @@ class Histogram(_Child):
     format requires one boundary set per family); ``observe`` finds the
     first bucket whose inclusive upper bound holds the sample."""
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[dict] = None) -> None:
         fam = self._family
         value = float(value)
         with fam.registry._lock:
@@ -152,6 +153,19 @@ class Histogram(_Child):
                     counts[i] += 1
                     break
             fam.values[self._labels] = (counts, total + value, count + 1)
+            if exemplar:
+                # OpenMetrics-style exemplar: last-write-wins per child
+                # (the serving path attaches the trace id of the most
+                # recent slow observation, which is exactly the one an
+                # operator wants to chase). Rides snapshot() and the
+                # openmetrics render; the default v0.0.4 exposition is
+                # untouched.
+                fam.exemplars[self._labels] = {
+                    "labels": {str(k): str(v)
+                               for k, v in exemplar.items()},
+                    "value": value,
+                    "ts": fam.registry._clock(),
+                }
 
     @property
     def count(self) -> int:
@@ -168,7 +182,7 @@ class Histogram(_Child):
 
 class _Family:
     __slots__ = ("registry", "name", "help", "kind", "buckets", "values",
-                 "children")
+                 "children", "exemplars")
 
     def __init__(self, registry, name, help_, kind, buckets=None):
         self.registry = registry
@@ -179,6 +193,8 @@ class _Family:
         self.buckets = buckets
         self.values: dict = {}  # guarded-by: _lock (the registry's)
         self.children: dict = {}  # guarded-by: _lock (the registry's)
+        #: label-key -> last exemplar dict; histograms only
+        self.exemplars: dict = {}  # guarded-by: _lock (the registry's)
 
 
 class MetricsRegistry:
@@ -313,6 +329,9 @@ class MetricsRegistry:
                                 ).items()
                             },
                         })
+                        ex = fam.exemplars.get(key)
+                        if ex is not None:
+                            rows[-1]["exemplar"] = dict(ex)
                     else:
                         rows.append(
                             {"labels": labels, "value": fam.values[key]}
@@ -348,6 +367,51 @@ class MetricsRegistry:
                     else:
                         lines.append(_sample(name, key, fam.values[key]))
         return "\n".join(lines) + ("\n" if lines else "")
+
+    def render_openmetrics(self) -> str:
+        """OpenMetrics-style exposition: the v0.0.4 body plus histogram
+        bucket exemplars (``# {trace_id="..."} value ts`` on the first
+        bucket whose boundary holds the exemplar value) and the
+        mandatory ``# EOF`` trailer. Served from ``GET /metrics`` only
+        under ``Accept: application/openmetrics-text`` — the default
+        exposition stays byte-identical to before exemplars existed."""
+        lines: list[str] = []
+        with self._lock:
+            for name, fam in sorted(self._families.items()):
+                if fam.help:
+                    lines.append(f"# HELP {name} {_escape_help(fam.help)}")
+                lines.append(f"# TYPE {name} {fam.kind}")
+                for key in sorted(fam.values):
+                    if fam.kind == "histogram":
+                        counts, total, count = fam.values[key]
+                        ex = fam.exemplars.get(key)
+                        cum = 0
+                        for b, c in zip(fam.buckets, counts):
+                            cum += c
+                            line = _sample(
+                                name + "_bucket",
+                                key + (("le", _format_le(b)),),
+                                cum,
+                            )
+                            if ex is not None and ex["value"] <= b:
+                                line += " # {%s} %s %s" % (
+                                    ",".join(
+                                        f'{k}="{_escape_label_value(v)}"'
+                                        for k, v in sorted(
+                                            ex["labels"].items()
+                                        )
+                                    ),
+                                    _format_value(ex["value"]),
+                                    repr(float(ex["ts"])),
+                                )
+                                ex = None
+                            lines.append(line)
+                        lines.append(_sample(name + "_sum", key, total))
+                        lines.append(_sample(name + "_count", key, count))
+                    else:
+                        lines.append(_sample(name, key, fam.values[key]))
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
 
 
 #: Quantiles every histogram snapshot estimates (p50/p95/p99 — the

@@ -105,6 +105,10 @@ KNOWN_POINTS = {
                          "shape; resume must treat the unsealed stray "
                          "as absent)",
     "serve.flush": "Batcher worker: before the coalesced reader probe",
+    "serve.block_decode": "DbReader: inside the per-block decode loader, "
+                          "before read_block (a delay here is the "
+                          "slow-decode shape query tracing must "
+                          "attribute to the decode span)",
     "serve.worker_spawn": "fleet worker: at process start, before the "
                           "warm-start verify/self-probe gate",
     "serve.heartbeat": "fleet worker: each heartbeat-pipe beat (a delay "

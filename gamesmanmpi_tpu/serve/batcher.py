@@ -45,6 +45,7 @@ from collections import OrderedDict
 import numpy as np
 
 from gamesmanmpi_tpu.obs import default_registry
+from gamesmanmpi_tpu.obs.qtrace import activate as _activate_traces
 from gamesmanmpi_tpu.obs.registry import DEFAULT_SIZE_BUCKETS
 from gamesmanmpi_tpu.resilience import faults
 from gamesmanmpi_tpu.utils.env import env_float as _env_float
@@ -86,13 +87,18 @@ class BatcherTripped(BatcherUnavailable):
 class _Request:
     """One submitter's slice of a coalesced batch."""
 
-    __slots__ = ("states", "event", "out", "error")
+    __slots__ = ("states", "event", "out", "error", "trace", "enq")
 
-    def __init__(self, states: np.ndarray):
+    def __init__(self, states: np.ndarray, trace=None):
         self.states = states
         self.event = threading.Event()
         self.out = None
         self.error = None
+        #: obs.qtrace.QueryTrace of the submitting request (or None).
+        #: The flush attributes its queue wait and the coalesced probe's
+        #: spans to every member trace.
+        self.trace = trace
+        self.enq = time.perf_counter()
 
 
 class Batcher:
@@ -206,7 +212,7 @@ class Batcher:
             return self._breaker
 
     def submit(self, positions,
-               timeout: float | None = None,
+               timeout: float | None = None, trace=None,
                ) -> list[tuple[int, int, bool, int | None]]:
         """Resolve a request's positions; blocks until the batch flushes
         or the deadline (``timeout``, default the batcher's
@@ -245,7 +251,8 @@ class Batcher:
         if not miss_idx:
             return results
         req = _Request(
-            np.asarray(miss_pos, dtype=self.reader.game.state_dtype)
+            np.asarray(miss_pos, dtype=self.reader.game.state_dtype),
+            trace=trace,
         )
         with self._cond:
             if self._closed:  # close() may have landed since the cache pass
@@ -448,13 +455,28 @@ class Batcher:
                         return
                 continue
             t0 = time.perf_counter()
+            # Queue-wait span per member request: enqueue to flush start
+            # (explicit timing — the wait already happened). Then the
+            # coalesced probe runs with ALL member traces active, so the
+            # reader/store spans below attribute one shared decode to
+            # every request it served.
+            traces = [r.trace for r in batch if r.trace is not None]
+            for r in batch:
+                if r.trace is not None:
+                    r.trace.add_span(
+                        "queue_wait", r.enq - r.trace._t0, t0 - r.enq,
+                        batch=len(batch),
+                    )
             try:
                 # Everything that can fail lives inside this try: an escape
                 # would kill the worker and leave every parked submitter
                 # (and all future ones) blocked on events nobody will set.
                 faults.fire("serve.flush", batch=len(batch))
                 states = np.concatenate([r.states for r in batch])
-                values, rem, found, best = self.reader.lookup_best(states)
+                with _activate_traces(traces):
+                    values, rem, found, best = self.reader.lookup_best(
+                        states
+                    )
             except Exception as e:  # noqa: BLE001 - must unblock submitters
                 for r in batch:
                     r.error = e

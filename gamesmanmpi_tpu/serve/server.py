@@ -45,7 +45,17 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from gamesmanmpi_tpu.core.values import value_name
 from gamesmanmpi_tpu.db.format import parse_position
 from gamesmanmpi_tpu.obs import default_registry
-from gamesmanmpi_tpu.serve.batcher import Batcher, BatcherUnavailable
+from gamesmanmpi_tpu.obs.qtrace import (
+    QueryTrace,
+    TraceRing,
+    format_traceparent,
+)
+from gamesmanmpi_tpu.obs.slo import SloEngine
+from gamesmanmpi_tpu.serve.batcher import (
+    Batcher,
+    BatcherTripped,
+    BatcherUnavailable,
+)
 
 #: Socket errors a disconnecting client inflicts on the handler's write
 #: path. Counted (http_client_aborts), never a thread traceback: a
@@ -54,6 +64,12 @@ CLIENT_ABORT_ERRORS = (BrokenPipeError, ConnectionResetError)
 
 #: The exposition format version the /metrics endpoint speaks.
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+#: Served from /metrics only when the client's Accept names it — carries
+#: histogram exemplars (trace ids of slow observations) + "# EOF".
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
 
 # Refuse absurd request bodies before json.loads allocates for them.
 _MAX_BODY_BYTES = 16 << 20
@@ -112,6 +128,16 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_response(code)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            trace = getattr(self, "_qtrace", None)
+            if trace is not None:
+                # Echo the (possibly freshly minted) context so a client
+                # that sent none can still join its record to the
+                # server-side trace; rides a header, never the body —
+                # response shapes are a compatibility surface.
+                self.send_header(
+                    "traceparent",
+                    format_traceparent(trace.trace_id, trace.root_id),
+                )
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
             if self.close_connection:
@@ -148,6 +174,14 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             if self._wants_json():
                 self._send_json(200, srv.metrics())
+            elif "application/openmetrics-text" in (
+                self.headers.get("Accept", "").lower()
+            ):
+                self._send_text(
+                    200,
+                    srv.registry.render_openmetrics(),
+                    OPENMETRICS_CONTENT_TYPE,
+                )
             else:
                 self._send_text(
                     200,
@@ -156,6 +190,8 @@ class _Handler(BaseHTTPRequestHandler):
                 )
         elif self.path == "/metrics.json":
             self._send_json(200, srv.metrics())
+        elif self.path == "/traces":
+            self._send_json(200, srv.trace_ring.snapshot())
         else:
             self._send_json(404, {"error": f"no such path {self.path!r}"})
 
@@ -165,12 +201,38 @@ class _Handler(BaseHTTPRequestHandler):
         # busy, and http_errors makes the reject rate derivable.
         t0 = time.perf_counter()
         code = 500
-        self.server.note_inflight(+1, self.connection)
+        srv = self.server
+        # One trace per POST: accept the client's traceparent or mint a
+        # root. The handler instance persists across keep-alive
+        # requests, so the attrs are (re)set per request and cleared in
+        # the finally (do_GET responses must never echo a stale trace).
+        self._qtrace = (
+            QueryTrace(
+                traceparent=self.headers.get("traceparent"),
+                worker=srv.worker_id,
+            )
+            if srv.trace_ring.enabled else None
+        )
+        self._route_name = ""
+        self._shed_status = None  # "shed" | "tripped" when a 503 path
+        srv.note_inflight(+1, self.connection)
         try:
             code = self._handle_post()
         finally:
-            self.server.note_inflight(-1, self.connection)
-            self.server.note_request(time.perf_counter() - t0, code)
+            srv.note_inflight(-1, self.connection)
+            secs = time.perf_counter() - t0
+            trace = self._qtrace
+            self._qtrace = None
+            if trace is not None:
+                status = (self._shed_status if self._shed_status
+                          else ("error" if code >= 500 else "ok"))
+                trace.route = self._route_name
+                trace.finish(status=status, code=code)
+                srv.trace_ring.offer(trace)
+            srv.note_request(
+                secs, code, route=self._route_name,
+                shed=self._shed_status is not None, trace=trace,
+            )
 
     def _resolve_route(self):
         """Route a POST path: "/query" is the default route (single-DB
@@ -190,11 +252,14 @@ class _Handler(BaseHTTPRequestHandler):
             # Graceful shutdown: finish what is in flight, refuse new
             # work loudly so clients fail over instead of timing out.
             self.close_connection = True
+            self._shed_status = "shed"
             return self._send_json(
                 503, {"error": "server is draining"},
                 headers={"Retry-After": "1"},
             )
         route = self._resolve_route()
+        if route is not None:
+            self._route_name = route.name or "default"
         if route is None:
             # The body (if any) is never read on this branch; its bytes
             # would desync the keep-alive socket (same guard as below).
@@ -246,11 +311,14 @@ class _Handler(BaseHTTPRequestHandler):
                 parsed.append((p, f"invalid position ({e})"))
         states = [s for _, s in parsed if isinstance(s, int)]
         try:
-            answers = iter(route.batcher.submit(states))
+            answers = iter(route.batcher.submit(states, trace=self._qtrace))
         except BatcherUnavailable as e:
             # Genuinely transient (shutdown, deadline, shed, breaker):
             # 503 + Retry-After so a well-behaved client backs off
             # instead of hammering a recovering server.
+            self._shed_status = (
+                "tripped" if isinstance(e, BatcherTripped) else "shed"
+            )
             return self._send_json(
                 503, {"error": str(e)},
                 headers={"Retry-After": str(e.retry_after)},
@@ -347,6 +415,11 @@ class _QueryHTTPServer(ThreadingHTTPServer):
             "responses abandoned by a disconnecting client "
             "(BrokenPipe/ConnectionReset on the write path)",
         )
+        #: Tail-sampled per-worker query traces (GET /traces) and the
+        #: declared availability/latency objectives. Both read their
+        #: knobs from GAMESMAN_TRACE_* / GAMESMAN_SLO_* env.
+        self.trace_ring = TraceRing(registry=self.registry)
+        self.slo = SloEngine(registry=self.registry)
 
     # Single-DB back-compat aliases: most callers (tests, the batcher's
     # half-open probe wiring) speak "the reader"/"the batcher".
@@ -366,6 +439,12 @@ class _QueryHTTPServer(ThreadingHTTPServer):
         for route in self.routes.values():
             if route.batcher is not None and route.batcher.state != "ok":
                 return "degraded"
+        if self.slo.fast_burning():
+            # An SLO fast-burn is pre-emptive degradation: the error
+            # budget is being spent ~14x faster than sustainable, so go
+            # amber BEFORE it is gone. The fleet supervisor already
+            # propagates a degraded worker beat into fleet /status.
+            return "degraded"
         return "ok"
 
     def healthz(self) -> dict:
@@ -386,7 +465,11 @@ class _QueryHTTPServer(ThreadingHTTPServer):
                 "breaker": route.batcher.state
                 if route.batcher is not None else "ok",
             }
-        payload = {"status": self.health_status(), "games": games}
+        payload = {
+            "status": self.health_status(),
+            "games": games,
+            "slo": self.slo.snapshot(),
+        }
         if self.worker_id is not None:
             payload["worker"] = self.worker_id
         if self.default_route is not None:
@@ -454,7 +537,8 @@ class _QueryHTTPServer(ThreadingHTTPServer):
             return
         super().handle_error(request, client_address)
 
-    def note_request(self, secs: float, code: int) -> None:
+    def note_request(self, secs: float, code: int, *, route: str = "",
+                     shed: bool = False, trace=None) -> None:
         with self._stats_lock:
             self._http_requests += 1
             if code >= 400:
@@ -464,7 +548,20 @@ class _QueryHTTPServer(ThreadingHTTPServer):
         self._m_requests.inc()
         if code >= 400:
             self._m_errors.inc()
-        self._m_latency.observe(secs)
+        # Exemplar: the trace id of the last SLOW observation rides the
+        # histogram (OpenMetrics style) so a scrape's p99 bucket links
+        # straight to a concrete kept trace.
+        exemplar = None
+        if trace is not None and secs * 1e3 >= self.trace_ring.slow_ms:
+            exemplar = {"trace_id": trace.trace_id}
+        self._m_latency.observe(secs, exemplar=exemplar)
+        if route:
+            self.registry.histogram(
+                "gamesman_http_route_request_seconds",
+                "wall seconds per POST request by route",
+                route=route,
+            ).observe(secs, exemplar=exemplar)
+            self.slo.observe(route, secs, code, shed=shed)
 
     def metrics(self) -> dict:
         with self._stats_lock:
@@ -564,6 +661,14 @@ class QueryServer:
         return self._httpd.inflight
 
     @property
+    def trace_ring(self):
+        return self._httpd.trace_ring
+
+    @property
+    def slo(self):
+        return self._httpd.slo
+
+    @property
     def host(self) -> str:
         return self._httpd.server_address[0]
 
@@ -611,6 +716,37 @@ class QueryServer:
         shutdown; stop() completes it."""
         self._httpd.draining = True
 
+    def serve_stats(self) -> dict:
+        """One summary record (phase ``serve_stats``): per-route
+        estimated latency quantiles from the route histogram plus the
+        SLO burn snapshot — the JSONL twin of /status, logged once at
+        stop() and folded by tools/obs_report.py into the per-route
+        serving table."""
+        fam = self.registry.snapshot().get(
+            "gamesman_http_route_request_seconds", {}
+        )
+        routes = {}
+        for row in fam.get("values", ()):
+            q = row.get("quantiles", {})
+            routes[row["labels"].get("route", "default")] = {
+                "count": row.get("count", 0),
+                **{
+                    f"{k}_ms": round(q[k] * 1e3, 3)
+                    for k in ("p50", "p95", "p99")
+                    if q.get(k) is not None
+                },
+            }
+        slo = self.slo.snapshot()
+        return {
+            "phase": "serve_stats",
+            "routes": routes,
+            "slo": {
+                "fast_burn": slo["fast_burn"],
+                "p99_ms": slo["p99_ms"],
+                "routes": slo["routes"],
+            },
+        }
+
     def stop(self) -> None:
         # Stop ACCEPTING first: a connection this server never accepted
         # is someone else's to answer (a fleet sibling's via the shared
@@ -640,6 +776,10 @@ class QueryServer:
         # Requests still coalescing get one final flush (drain=True).
         for route in self._httpd.routes.values():
             route.batcher.close(drain=True)
+        if self.logger is not None:
+            # After the final flush so every answered request's latency
+            # observation is in the histogram the quantiles summarize.
+            self.logger.log(self.serve_stats())
         # Handler threads are daemons ThreadingHTTPServer never joins: a
         # process exit right after this call would kill them mid-write,
         # truncating the very responses the drain flushed. Two-step

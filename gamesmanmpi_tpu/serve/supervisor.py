@@ -34,6 +34,7 @@ edge-triggered off the pipes, so the idle supervisor costs zero CPU.
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import selectors
@@ -126,6 +127,7 @@ class _Slot:
         self.next_spawn_at = 0.0  # monotonic; None = no spawn scheduled
         self.drain_deadline = None
         self.last_error = None
+        self.slo: dict = {}  # latest burn-rate snapshot off the beat
 
 
 class ServeSupervisor:
@@ -228,6 +230,14 @@ class ServeSupervisor:
         self._roll_backup = None  # pre-roll (entries, readers); guarded-by: _lock
         self._rolling_back = False  # guarded-by: _lock
         self._reloads_done = 0  # guarded-by: _lock
+        # Fleet-wide trace ring: workers tail-sample per-request traces
+        # (obs/qtrace.py) and ship newly kept ones on heartbeat beats —
+        # the only per-worker channel, since all workers share one
+        # accept queue and cannot be HTTP-addressed individually. The
+        # control port serves the aggregate at GET /traces.
+        self._fleet_traces: collections.deque = collections.deque(
+            maxlen=max(1, env_int("GAMESMAN_TRACE_FLEET_RING", 2048))
+        )  # guarded-by: _lock
         self._thread = None
         self._control = None
         self._control_thread = None
@@ -380,6 +390,7 @@ class ServeSupervisor:
                     "last_error": s.last_error,
                     "verified": s.ready_info.get("verified"),
                     "warmup_secs": s.ready_info.get("warmup_secs"),
+                    "slo": s.slo or None,
                 }
             degraded = any(
                 s.state == "ready" and s.health not in ("ok", "unknown")
@@ -406,7 +417,18 @@ class ServeSupervisor:
                 "reloads_done": self._reloads_done,
                 "last_reload_error": self._last_reload_error,
                 "spawn_mode": self._spawn_mode,
+                "slo_fast_burn": any(
+                    s.slo.get("fast_burn") for s in self._slots
+                ),
             }
+
+    def traces(self) -> dict:
+        """Fleet-wide sampled-trace snapshot (the control /traces
+        payload): every tail-kept query trace workers shipped on their
+        beats, oldest first, bounded by GAMESMAN_TRACE_FLEET_RING."""
+        with self._lock:
+            recs = list(self._fleet_traces)
+        return {"kind": "qtrace_fleet", "count": len(recs), "traces": recs}
 
     def start(self):
         """Run the scheduler in a background thread (tests, benches)."""
@@ -599,13 +621,27 @@ class ServeSupervisor:
                        "pid": slot.pid,
                        "warmup_secs": msg.get("warmup_secs")})
         elif kind == "beat":
+            sampled = msg.get("traces") or ()
             with self._lock:
                 slot.health = msg.get("status", "ok")
+                slo = msg.get("slo")
+                if isinstance(slo, dict):
+                    slot.slo = slo
+                for rec in sampled:
+                    if isinstance(rec, dict):
+                        rec.setdefault("worker", slot.idx)
+                        self._fleet_traces.append(rec)
             self.registry.counter(
                 "gamesman_serve_heartbeats_total",
                 "worker heartbeats received by the supervisor",
                 worker=str(slot.idx),
             ).inc()
+            if sampled:
+                self.registry.counter(
+                    "gamesman_serve_traces_ingested_total",
+                    "sampled query traces received on worker beats",
+                    worker=str(slot.idx),
+                ).inc(len(sampled))
         elif kind == "failed":
             with self._lock:
                 slot.last_error = msg.get("error")
@@ -996,6 +1032,8 @@ class _ControlHandler(BaseHTTPRequestHandler):
                 200, sup.registry.render_prometheus().encode(),
                 PROMETHEUS_CONTENT_TYPE,
             )
+        elif self.path == "/traces":
+            self._send_json(200, sup.traces())
         else:
             self._send_json(404, {"error": f"no such path {self.path!r}"})
 

@@ -237,11 +237,24 @@ def run_worker(cfg: dict, listen_sock, pipe_fd: int) -> int:
             # supervisor's deadline turns the silent hang into a
             # SIGKILL + restart — exactly what a wedged worker gets.
             faults.fire("serve.heartbeat", worker=wid)
-            if not pipe.send(
-                type="beat",
-                status=server.healthz()["status"],
-                inflight=server.inflight,
-            ):
+            beat = {
+                "type": "beat",
+                "status": server.healthz()["status"],
+                "inflight": server.inflight,
+                # The burn-rate snapshot rides every beat so the control
+                # port's /status can show WHY a worker is degraded (which
+                # route/objective is past fast-burn), not just that it is.
+                "slo": server.slo.snapshot(),
+            }
+            # Newly tail-sampled traces ride the beat (bounded batch):
+            # the workers share one accept queue, so the supervisor
+            # cannot HTTP-address THIS worker's /traces — the heartbeat
+            # pipe is the only per-worker channel, and it aggregates the
+            # fleet ring the control port serves.
+            sampled = server.trace_ring.drain_outbox(8)
+            if sampled:
+                beat["traces"] = sampled
+            if not pipe.send(**beat):
                 drain.set()  # supervisor gone: drain and exit
     except Exception as e:  # noqa: BLE001 - a faulted beat is a crash
         pipe.send(type="failed", error=f"{type(e).__name__}: {e}"[:500])

@@ -66,6 +66,7 @@ import time
 
 from gamesmanmpi_tpu.obs import default_registry
 from gamesmanmpi_tpu.obs import flightrec
+from gamesmanmpi_tpu.obs.qtrace import qspan
 from gamesmanmpi_tpu.resilience import faults
 from gamesmanmpi_tpu.store.cache import TieredCache
 from gamesmanmpi_tpu.utils.env import env_bool, env_int
@@ -240,7 +241,18 @@ class BlockStore:
 
         ``nbytes`` sizes the cache entry; None derives it from the
         value's ``.nbytes`` fields (arrays or tuples/dicts of arrays).
+
+        When a query trace is active (the serving path, obs/qtrace.py)
+        the read records a ``store_read`` span carrying which path
+        answered — ``hit`` (cache), ``wait`` (in-flight prefetch), or
+        ``sync`` (the loader ran on this thread); the solve path pays
+        one no-op tuple check.
         """
+        with qspan("store_read") as sp:
+            value, hit = self._read_ex_traced(key, loader, nbytes, sp)
+        return value, hit
+
+    def _read_ex_traced(self, key, loader, nbytes, sp):
         entry = None
         if key is not None:
             with self._lock:
@@ -252,6 +264,8 @@ class BlockStore:
                 with self._lock:
                     self._prefetch_hits += 1
                 self._m_pf_hits.inc()
+                if sp is not None:
+                    sp["path"] = "hit"
                 return value, True
             with self._lock:
                 entry = self._inflight.get(key)
@@ -271,12 +285,16 @@ class BlockStore:
                 with self._lock:
                     self._prefetch_hits += 1
                 self._m_pf_hits.inc()
+                if sp is not None:
+                    sp["path"] = "wait"
                 return entry.value, True
         with self._lock:
             if key is None:
                 self._reads += 1
             self._prefetch_misses += 1
         self._m_pf_misses.inc()
+        if sp is not None:
+            sp["path"] = "sync"
         t0 = time.perf_counter()
         try:
             value = loader()

@@ -1,0 +1,554 @@
+"""obs/qtrace + obs/slo: query-path tracing and SLO burn rates.
+
+Acceptance axis (ISSUE 17): a traceparent survives the whole query path
+(HTTP ingress -> batcher -> reader probe -> response header), the tail
+sampler keeps exactly the traces an operator wants (errors, sheds,
+slow, 1-in-N baseline) under concurrent offers with bounded memory, the
+SLO engine's fast-window burn rate rises past its threshold during a
+bad minute AND recovers after it, exemplars join metrics to traces
+without disturbing the default exposition, and the reporting tools
+(load_gen --out-jsonl, obs_report, bench_compare) speak the new record
+shapes.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gamesmanmpi_tpu.db import DbReader, export_result
+from gamesmanmpi_tpu.games import get_game
+from gamesmanmpi_tpu.obs import MetricsRegistry
+from gamesmanmpi_tpu.obs.qtrace import (
+    QueryTrace,
+    TraceRing,
+    activate,
+    active_traces,
+    format_traceparent,
+    mint_trace_ids,
+    parse_traceparent,
+    qspan,
+)
+from gamesmanmpi_tpu.obs.slo import (
+    SLO_FAST_BURN_TRIPS,
+    SloEngine,
+)
+from gamesmanmpi_tpu.solve import Solver
+
+from helpers import REPO, load_module
+
+
+# ------------------------------------------------------ traceparent wire
+
+
+def test_traceparent_mint_format_parse_roundtrip():
+    tid, sid = mint_trace_ids()
+    assert len(tid) == 32 and len(sid) == 16
+    header = format_traceparent(tid, sid)
+    assert header == f"00-{tid}-{sid}-01"
+    assert parse_traceparent(header) == (tid, sid)
+    # Case-insensitive per W3C: an uppercase header still parses.
+    assert parse_traceparent(header.upper()) == (tid, sid)
+
+
+@pytest.mark.parametrize("header", [
+    None,
+    "",
+    "garbage",
+    "00-abc-def-01",  # wrong field widths
+    "00-" + "g" * 32 + "-" + "1" * 16 + "-01",  # non-hex
+    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # all-zero trace id
+    "00-" + "1" * 32 + "-" + "0" * 16 + "-01",  # all-zero span id
+    "00-" + "1" * 32 + "-" + "2" * 16,  # missing flags
+])
+def test_traceparent_malformed_is_rejected_not_fatal(header):
+    assert parse_traceparent(header) is None
+    # A server handed a malformed header mints a fresh root instead of
+    # failing the request.
+    trace = QueryTrace(traceparent=header)
+    assert len(trace.trace_id) == 32 and trace.parent_id is None
+
+
+def test_query_trace_adopts_client_context():
+    tid, sid = mint_trace_ids()
+    trace = QueryTrace(traceparent=format_traceparent(tid, sid),
+                       route="nim", worker=3)
+    assert trace.trace_id == tid
+    assert trace.parent_id == sid
+    assert trace.duration_ms is None  # not finished yet
+    trace.add_span("queue_wait", 0.001, 0.002, batch=4)
+    secs = trace.finish(status="ok", code=200)
+    # finish is idempotent: a second call must not restart the clock.
+    assert trace.finish(status="ok", code=200) == secs
+    rec = trace.to_dict()
+    assert rec["trace_id"] == tid and rec["parent_id"] == sid
+    assert rec["route"] == "nim" and rec["worker"] == 3
+    assert rec["dur_ms"] == pytest.approx(secs * 1e3, rel=1e-6, abs=1e-3)
+    (span,) = rec["spans"]
+    assert span["name"] == "queue_wait"
+    assert span["start_ms"] == 1.0 and span["dur_ms"] == 2.0
+    assert span["batch"] == 4
+
+
+def test_query_trace_span_fields_are_json_safe():
+    trace = QueryTrace()
+    trace.add_span("store_read", 0.0, 0.0, path="hit", level=2,
+                   weird=object())
+    span = trace.to_dict()["spans"][0]
+    assert span["path"] == "hit" and span["level"] == 2
+    assert isinstance(span["weird"], str)  # coerced, not a crash
+    json.dumps(trace.to_dict())  # the whole record must serialize
+
+
+# ------------------------------------------------- activation and qspan
+
+
+def test_qspan_attributes_to_every_active_trace():
+    a, b = QueryTrace(), QueryTrace()
+    assert active_traces() == ()
+    with activate([a, None, b]):  # None entries (untraced peers) skipped
+        assert active_traces() == (a, b)
+        with qspan("block_decode", level=1, block=7) as extra:
+            extra["path"] = "sync"
+    assert active_traces() == ()
+    for tr in (a, b):
+        (span,) = tr.to_dict()["spans"]
+        assert span["name"] == "block_decode"
+        assert span["level"] == 1 and span["block"] == 7
+        assert span["path"] == "sync"  # extra fields merged at exit
+
+
+def test_qspan_without_active_trace_is_a_noop():
+    with qspan("canonicalize", queries=5) as handle:
+        assert handle is None  # fast path: no clock, no span dict
+
+
+def test_activation_is_thread_local():
+    trace = QueryTrace()
+    seen = []
+
+    def other():
+        seen.append(active_traces())
+
+    with activate([trace]):
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+    assert seen == [()]  # the other thread never saw our binding
+
+
+# --------------------------------------------------- tail-based sampling
+
+
+def _finished(status="ok", dur_ms=1.0, code=200):
+    """A trace finished with an exact duration via injected clocks."""
+    trace = QueryTrace(clock=lambda: 0.0)
+    trace.finish(status=status, code=code, clock=lambda: dur_ms / 1e3)
+    return trace
+
+
+def test_tail_sampler_keeps_errors_sheds_and_slow():
+    ring = TraceRing(capacity=16, slow_ms=50.0, head_n=1000,
+                     enabled=True, registry=MetricsRegistry())
+    assert ring.offer(_finished(status="error", code=500)) == "error"
+    assert ring.offer(_finished(status="shed", code=503)) == "shed"
+    assert ring.offer(_finished(status="tripped", code=503)) == "tripped"
+    assert ring.offer(_finished(dur_ms=120.0)) == "slow"
+    # Fast + ok + not the head slot -> dropped.
+    assert ring.offer(_finished(dur_ms=1.0)) is None
+    snap = ring.snapshot()
+    assert snap["seen"] == 5
+    assert snap["kept"] == 4 and snap["dropped"] == 1
+    assert snap["seen"] == snap["kept"] + snap["dropped"]
+    reasons = [t["keep"] for t in snap["traces"]]
+    assert reasons == ["error", "shed", "tripped", "slow"]
+
+
+def test_tail_sampler_head_keeps_one_in_n():
+    ring = TraceRing(capacity=64, slow_ms=1e9, head_n=10,
+                     enabled=True, registry=MetricsRegistry())
+    reasons = [ring.offer(_finished(dur_ms=1.0)) for _ in range(30)]
+    assert [r for r in reasons if r] == ["head"] * 3  # offers 1, 11, 21
+    assert reasons[0] == "head" and reasons[10] == "head"
+
+
+def test_tail_sampler_disabled_drops_everything():
+    ring = TraceRing(capacity=16, slow_ms=0.0, head_n=1,
+                     enabled=False, registry=MetricsRegistry())
+    assert ring.offer(_finished(status="error", code=500)) is None
+    snap = ring.snapshot()
+    assert not snap["enabled"]
+    assert snap["seen"] == 0 and snap["traces"] == []
+
+
+def test_trace_ring_find_and_snapshot_limit():
+    ring = TraceRing(capacity=8, slow_ms=0.0, head_n=1,
+                     enabled=True, registry=MetricsRegistry())
+    traces = [_finished(dur_ms=5.0) for _ in range(5)]
+    for tr in traces:
+        ring.offer(tr)
+    assert ring.find(traces[2].trace_id)["trace_id"] == traces[2].trace_id
+    assert ring.find("f" * 32) is None
+    limited = ring.snapshot(limit=2)["traces"]
+    assert [t["trace_id"] for t in limited] == \
+        [traces[-2].trace_id, traces[-1].trace_id]  # newest-last
+
+
+def test_trace_ring_concurrent_hammer_bounded_and_race_free():
+    """Many threads offering finished traces while one drains the
+    outbox: counters stay consistent (seen == kept + dropped), the ring
+    never exceeds capacity, and drained traces never re-ship."""
+    ring = TraceRing(capacity=32, slow_ms=50.0, head_n=7,
+                     enabled=True, registry=MetricsRegistry())
+    threads, offered = 8, 200
+    statuses = ["ok", "ok", "ok", "error", "shed", "ok", "tripped", "ok"]
+    drained: list = []
+    stop = threading.Event()
+
+    def offerer(i):
+        for j in range(offered):
+            dur = 120.0 if (i + j) % 5 == 0 else 1.0
+            ring.offer(_finished(status=statuses[(i + j) % len(statuses)],
+                                 dur_ms=dur))
+
+    def drainer():
+        while not stop.is_set():
+            drained.extend(ring.drain_outbox(8))
+        drained.extend(ring.drain_outbox(10**6))  # final sweep
+
+    workers = [threading.Thread(target=offerer, args=(i,))
+               for i in range(threads)]
+    d = threading.Thread(target=drainer)
+    d.start()
+    for t in workers:
+        t.start()
+    for t in workers:
+        t.join()
+    stop.set()
+    d.join()
+    snap = ring.snapshot()
+    assert snap["seen"] == threads * offered
+    assert snap["seen"] == snap["kept"] + snap["dropped"]
+    assert len(snap["traces"]) <= 32  # ring bounded by capacity
+    assert snap["kept"] >= len(snap["traces"])
+    # Every drained record was kept exactly once (no re-shipping), and
+    # the outbox never exceeds its own bound between drains.
+    assert len(drained) <= snap["kept"]
+    ids = [id(rec) for rec in drained]
+    assert len(ids) == len(set(ids))
+    assert ring.drain_outbox() == []  # fully drained stays drained
+
+
+# ------------------------------------------------------ SLO burn engine
+
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_slo_burn_rises_trips_and_recovers():
+    clock = _FakeClock()
+    reg = MetricsRegistry()
+    eng = SloEngine(p99_ms=100.0, avail_target=0.9, latency_target=0.9,
+                    fast_window=5.0, slow_window=10.0, fast_burn=2.0,
+                    min_requests=10, registry=reg, clock=clock)
+    # A healthy second: fast requests, 200s -> burn 0, no trip.
+    for _ in range(10):
+        eng.observe("default", 0.001, 200)
+    snap = eng.snapshot()
+    avail = snap["routes"]["default"]["availability"]
+    assert avail["burn_fast"] == 0.0 and not avail["fast_burn"]
+    assert not snap["fast_burn"]
+    # The bad second: every request errors AND blows the latency
+    # objective. bad_frac 20/30 over budget 0.1 -> burn ~6.7 > 2.0.
+    clock.t += 1.0
+    for _ in range(20):
+        eng.observe("default", 0.5, 500)
+    snap = eng.snapshot()
+    avail = snap["routes"]["default"]["availability"]
+    lat = snap["routes"]["default"]["latency"]
+    assert avail["burn_fast"] > 2.0 and avail["fast_burn"]
+    assert lat["burn_fast"] > 2.0 and lat["fast_burn"]
+    assert snap["fast_burn"] and eng.fast_burning()
+    # Edge-triggered trips: a second snapshot while still burning must
+    # not count a second crossing.
+    eng.snapshot()
+    trips = sum(row["value"]
+                for row in reg.snapshot()[SLO_FAST_BURN_TRIPS]["values"])
+    assert trips == 2  # availability + latency, once each
+    # The bad minute ends: advance past the fast window and the burn
+    # rate recovers without any new traffic.
+    clock.t += 20.0
+    snap = eng.snapshot()
+    assert not snap["fast_burn"]
+    assert snap["routes"]["default"]["availability"]["burn_fast"] == 0.0
+    # A fresh bad burst after recovery IS a new crossing.
+    for _ in range(20):
+        eng.observe("default", 0.5, 500)
+    eng.snapshot()
+    trips = sum(row["value"]
+                for row in reg.snapshot()[SLO_FAST_BURN_TRIPS]["values"])
+    assert trips == 4
+
+
+def test_slo_volume_gate_blocks_meaningless_trips():
+    clock = _FakeClock()
+    eng = SloEngine(p99_ms=100.0, avail_target=0.999,
+                    fast_window=5.0, slow_window=10.0, fast_burn=2.0,
+                    min_requests=100, registry=MetricsRegistry(),
+                    clock=clock)
+    # One bad request among five: burn rate is astronomically over
+    # threshold but the window holds far fewer than min_requests.
+    eng.observe("default", 0.001, 500)
+    for _ in range(4):
+        eng.observe("default", 0.001, 200)
+    snap = eng.snapshot()
+    avail = snap["routes"]["default"]["availability"]
+    assert avail["burn_fast"] > 2.0  # reported honestly...
+    assert not avail["fast_burn"]  # ...but not tripped
+    assert not snap["fast_burn"]
+
+
+def test_slo_shed_counts_against_availability():
+    clock = _FakeClock()
+    eng = SloEngine(p99_ms=1e9, avail_target=0.9, fast_window=5.0,
+                    slow_window=10.0, fast_burn=1.0, min_requests=1,
+                    registry=MetricsRegistry(), clock=clock)
+    eng.observe("default", 0.001, 503, shed=True)
+    snap = eng.snapshot()
+    assert snap["routes"]["default"]["availability"]["burn_fast"] > 1.0
+    # An intentional 503 is still perfectly fast.
+    assert snap["routes"]["default"]["latency"]["burn_fast"] == 0.0
+
+
+# ---------------------------------------------------- metrics exemplars
+
+
+def test_exemplar_rides_openmetrics_not_the_default_exposition():
+    def build(with_exemplar):
+        reg = MetricsRegistry()
+        h = reg.histogram("gamesman_http_request_seconds",
+                          "wall seconds per POST request")
+        h.observe(0.3, exemplar={"trace_id": "ab" * 16}
+                  if with_exemplar else None)
+        return reg
+
+    plain, tagged = build(False), build(True)
+    # The v0.0.4 exposition every existing scraper parses is
+    # byte-identical whether or not an exemplar was attached.
+    assert plain.render_prometheus() == tagged.render_prometheus()
+    om = tagged.render_openmetrics()
+    assert '# {trace_id="' + "ab" * 16 + '"}' in om
+    assert om.rstrip().endswith("# EOF")
+    assert '# {' not in plain.render_openmetrics()
+    # The snapshot carries it too (the /metrics.json join path).
+    rows = tagged.snapshot()["gamesman_http_request_seconds"]["values"]
+    assert rows[0]["exemplar"]["labels"] == {"trace_id": "ab" * 16}
+
+
+# ----------------------------------------------- end-to-end over HTTP
+
+
+@pytest.fixture(scope="module")
+def sub_reader(tmp_path_factory):
+    spec = "subtract:total=15,moves=1-2"
+    d = tmp_path_factory.mktemp("qtracedb")
+    export_result(Solver(get_game(spec)).solve(), d, spec)
+    with DbReader(d) as reader:
+        yield reader
+
+
+def _post_with_headers(url, payload, headers=None, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, dict(resp.headers), json.loads(resp.read())
+
+
+def _get(url, headers=None, timeout=30):
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, dict(resp.headers), resp.read().decode()
+
+
+def test_server_traces_query_end_to_end(sub_reader, monkeypatch):
+    """POST with a client traceparent -> the response echoes the trace
+    id, GET /traces holds the sampled trace with probe spans, /healthz
+    carries the SLO snapshot, and /metrics negotiates OpenMetrics."""
+    from gamesmanmpi_tpu.serve import QueryServer
+
+    monkeypatch.setenv("GAMESMAN_TRACE_HEAD_N", "1")  # keep everything
+    pos = int(sub_reader.game.initial_state())
+    with QueryServer(sub_reader, window=0.001,
+                     registry=MetricsRegistry()) as server:
+        base = f"http://127.0.0.1:{server.port}"
+        tid, sid = mint_trace_ids()
+        status, headers, body = _post_with_headers(
+            base + "/query", {"positions": [pos]},
+            headers={"traceparent": format_traceparent(tid, sid)},
+        )
+        assert status == 200 and body["results"][0]["found"]
+        # The response joins client to server: same trace id, a server
+        # span id (never an echo of the client's).
+        echoed = parse_traceparent(headers.get("traceparent"))
+        assert echoed is not None
+        assert echoed[0] == tid and echoed[1] != sid
+        # The sampled trace is queryable by the client's id.
+        _, _, raw = _get(base + "/traces")
+        snap = json.loads(raw)
+        assert snap["kind"] == "qtrace_ring" and snap["enabled"]
+        rec = next(t for t in snap["traces"] if t["trace_id"] == tid)
+        assert rec["parent_id"] == sid
+        assert rec["status"] == "ok" and rec["code"] == 200
+        names = {s["name"] for s in rec["spans"]}
+        assert {"queue_wait", "canonicalize", "searchsorted"} <= names
+        # Span timing is consistent: every span fits inside the trace.
+        for s in rec["spans"]:
+            assert s["start_ms"] + s["dur_ms"] <= rec["dur_ms"] + 1.0
+        # /healthz carries the SLO burn snapshot.
+        health = json.loads(_get(base + "/healthz")[2])
+        assert health["status"] == "ok"
+        assert "latency" in health["slo"]["routes"]["default"]
+        # Content negotiation: OpenMetrics on request, v0.0.4 default.
+        _, h, om = _get(base + "/metrics",
+                        headers={"Accept": "application/openmetrics-text"})
+        assert "openmetrics-text" in h.get("Content-Type", "")
+        assert om.rstrip().endswith("# EOF")
+        _, h, _ = _get(base + "/metrics")
+        assert "openmetrics" not in h.get("Content-Type", "")
+
+
+def test_server_no_trace_disables_ring_and_header(sub_reader,
+                                                  monkeypatch):
+    from gamesmanmpi_tpu.serve import QueryServer
+
+    monkeypatch.setenv("GAMESMAN_TRACE", "0")
+    pos = int(sub_reader.game.initial_state())
+    with QueryServer(sub_reader, window=0.001,
+                     registry=MetricsRegistry()) as server:
+        base = f"http://127.0.0.1:{server.port}"
+        status, headers, body = _post_with_headers(
+            base + "/query", {"positions": [pos]},
+        )
+        assert status == 200 and body["results"][0]["found"]
+        assert headers.get("traceparent") is None
+        snap = json.loads(_get(base + "/traces")[2])
+        assert not snap["enabled"] and snap["traces"] == []
+
+
+def test_serve_stats_record_and_obs_report_folding(sub_reader,
+                                                   monkeypatch):
+    """QueryServer.serve_stats() emits the per-route quantile + SLO
+    record obs_report folds into its serving table."""
+    from gamesmanmpi_tpu.serve import QueryServer
+
+    monkeypatch.setenv("GAMESMAN_TRACE_HEAD_N", "1")
+    pos = int(sub_reader.game.initial_state())
+    with QueryServer(sub_reader, window=0.001,
+                     registry=MetricsRegistry()) as server:
+        base = f"http://127.0.0.1:{server.port}"
+        for _ in range(8):
+            _post_with_headers(base + "/query", {"positions": [pos]})
+        # note_request lands in the handler's finally, which can run a
+        # hair after the last response hit the wire — poll briefly.
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            rec = server.serve_stats()
+            if rec["routes"].get("default", {}).get("count", 0) >= 8:
+                break
+            time.sleep(0.01)
+    assert rec["phase"] == "serve_stats"
+    route = rec["routes"]["default"]
+    assert route["count"] >= 8
+    assert any(k in route for k in ("p50_ms", "p95_ms", "p99_ms"))
+    assert rec["slo"]["fast_burn"] is False
+    assert "availability" in rec["slo"]["routes"]["default"]
+    json.dumps(rec)  # must be JSONL-safe
+
+    obs_report = load_module(REPO / "tools" / "obs_report.py")
+    records = [
+        {"phase": "serve_batch", "worker": 0, "requests": 8,
+         "batch_size": 8, "secs": 0.01},
+        dict(rec, worker=0),
+    ]
+    rows = obs_report.serving_summary(records)
+    assert rows[0]["routes"]["default"]["count"] >= 8
+    assert rows[0]["slo"]["p99_ms"] == rec["slo"]["p99_ms"]
+    lines = obs_report.summarize_serving(records)
+    assert any("route[default]:" in ln and "p99_ms=" in ln
+               for ln in lines)
+    assert any("slo: fast_burn=ok" in ln for ln in lines)
+
+
+# ----------------------------------------------------- reporting tools
+
+
+def test_load_gen_out_jsonl_records_join_by_trace_id(sub_reader,
+                                                     tmp_path,
+                                                     monkeypatch):
+    from gamesmanmpi_tpu.serve import QueryServer
+
+    monkeypatch.setenv("GAMESMAN_TRACE_HEAD_N", "1")
+    load_gen = load_module(REPO / "tools" / "load_gen.py")
+    pos = int(sub_reader.game.initial_state())
+    out = tmp_path / "requests.jsonl"
+    with QueryServer(sub_reader, window=0.001,
+                     registry=MetricsRegistry()) as server:
+        base = f"http://127.0.0.1:{server.port}"
+        stats = load_gen.run_load(
+            base, [pos], duration=0.5, concurrency=2,
+            chunk_size=1, out_jsonl=str(out),
+        )
+        snap = json.loads(_get(base + "/traces")[2])
+    assert stats["requests"] > 0
+    records = [json.loads(ln) for ln in out.read_text().splitlines()]
+    assert len(records) == stats["requests"]
+    server_ids = {t["trace_id"] for t in snap["traces"]}
+    joined = 0
+    for rec in records:
+        assert set(rec) == {"trace_id", "kind", "code", "latency_ms",
+                            "mismatch"}
+        assert len(rec["trace_id"]) == 32
+        assert rec["kind"] == "ok" and rec["mismatch"] is False
+        assert rec["latency_ms"] > 0
+        joined += rec["trace_id"] in server_ids
+    # head_n=1 keeps every trace, so client records join server traces
+    # by id (modulo ring-capacity eviction under longer runs).
+    assert joined > 0
+
+
+def test_bench_compare_gates_trace_ab():
+    bench_compare = load_module(REPO / "tools" / "bench_compare.py")
+    ok, lines = bench_compare.check_trace_ab({"metric": "x"})
+    assert ok and lines == []  # no arm -> nothing to gate
+    ok, lines = bench_compare.check_trace_ab(
+        {"serve": {"trace_ab": {"ok": True, "delta_pct": 1.2,
+                                "max_delta_pct": 5.0}}})
+    assert ok and "trace_ab" in lines[0]
+    ok, lines = bench_compare.check_trace_ab(
+        {"serve": {"trace_ab": {"ok": False, "delta_pct": 9.9,
+                                "max_delta_pct": 5.0}}})
+    assert not ok
+    assert any("TRACING OVERHEAD REGRESSION" in ln for ln in lines)
+    ok, lines = bench_compare.check_trace_ab(
+        {"trace_ab": {"error": "fleet never became healthy"}})
+    assert not ok and any("TRACE A/B BROKEN" in ln for ln in lines)
+    # The full gate: a record passing the ratio check still fails on a
+    # busted A/B arm.
+    new = {"metric": "m", "device": "cpu", "value": 100.0,
+           "serve": {"trace_ab": {"ok": False, "delta_pct": 9.9,
+                                  "max_delta_pct": 5.0}}}
+    ref = {"metric": "m", "device": "cpu", "value": 100.0}
+    ok, lines = bench_compare.compare(new, [("BENCH_ref.json", ref)],
+                                      0.6)
+    assert not ok

@@ -524,6 +524,102 @@ def test_request_deadline_times_out_as_503(nim_reader):
         assert server.metrics()["timeouts"] >= 1
 
 
+def test_chaos_slow_block_decode_dominates_trace_and_burns_slo(
+        tmp_path_factory, monkeypatch):
+    """ISSUE 17 chaos acceptance, single-server sized: arm a
+    ``serve.block_decode`` delay fault on a compressed (v2) DB and (1)
+    the sampled trace — joined by the CLIENT's minted trace id — must
+    attribute the latency to the decode span, and (2) the latency SLO's
+    fast-window burn rate must cross fast-burn (healthz 'degraded')
+    during the fault and recover after it without a restart."""
+    from gamesmanmpi_tpu.db import DbReader, export_result
+    from gamesmanmpi_tpu.obs.qtrace import (
+        format_traceparent,
+        mint_trace_ids,
+    )
+    from gamesmanmpi_tpu.serve import QueryServer
+
+    spec = "subtract:total=21,moves=1-2-3"
+    d = tmp_path_factory.mktemp("chaosv2")
+    export_result(Solver(get_game(spec)).solve(), d, spec, compress=True)
+    # Keep-everything head sampling is NOT needed: the delayed queries
+    # are kept as "slow". Shrink the SLO windows/volume gate so a
+    # handful of slow requests trips fast-burn and a few seconds of
+    # health recovers it (BUCKET_SECS=1 makes that honest).
+    monkeypatch.setenv("GAMESMAN_TRACE_SLOW_MS", "60")
+    monkeypatch.setenv("GAMESMAN_SLO_P99_MS", "60")
+    monkeypatch.setenv("GAMESMAN_SLO_MIN_REQUESTS", "4")
+    monkeypatch.setenv("GAMESMAN_SLO_FAST_WINDOW_SECS", "4")
+    # One slow request among seven is a ~14x burn on the 1% latency
+    # budget — just under the 14.4 default, so declare the paging
+    # threshold this test means to cross.
+    monkeypatch.setenv("GAMESMAN_SLO_FAST_BURN", "5")
+    delay_ms = 150.0
+    # Positions in DISTINCT solve levels: each level is its own v2
+    # block stream, so every query forces a fresh (delayed) decode —
+    # same-level repeats would hit the decoded-block cache and be fast.
+    positions = [20, 17, 14, 11, 8, 5, 2]
+    with DbReader(d) as reader, QueryServer(
+        reader, window=0.001, cache_size=0, request_timeout=10.0,
+    ) as server:
+        base = f"http://127.0.0.1:{server.port}"
+        faults.configure(f"serve.block_decode:delay={delay_ms / 1e3}"
+                         ":always")
+        tid, sid = mint_trace_ids()
+        req = urllib.request.Request(
+            base + "/query",
+            data=json.dumps({"positions": [positions[0]]}).encode(),
+            headers={"Content-Type": "application/json",
+                     "traceparent": format_traceparent(tid, sid)},
+            method="POST",
+        )
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.status == 200
+            body = json.loads(resp.read())
+        client_ms = (time.perf_counter() - t0) * 1e3
+        assert body["results"][0]["found"]
+        assert client_ms >= delay_ms  # the fault really ran
+
+        # Join the server-side sampled trace by the client's trace id.
+        rec = next(t for t in _get(base + "/traces")[1]["traces"]
+                   if t["trace_id"] == tid)
+        assert rec["keep"] == "slow" and rec["parent_id"] == sid
+        decode_ms = sum(s["dur_ms"] for s in rec["spans"]
+                        if s["name"] == "block_decode")
+        assert decode_ms >= delay_ms * 0.9
+        # The decode span dominates the trace, and the traced duration
+        # accounts for the client-observed latency (within the HTTP +
+        # loopback overhead).
+        assert decode_ms >= 0.5 * rec["dur_ms"]
+        assert rec["dur_ms"] <= client_ms
+
+        # Burn the latency budget: every remaining cold-level query
+        # eats the decode delay, all inside the 2s fast window.
+        for pos in positions[1:]:
+            status, body = _post(base + "/query", {"positions": [pos]})
+            assert status == 200 and body["results"][0]["found"]
+        health = _get(base + "/healthz")[1]
+        lat = health["slo"]["routes"]["default"]["latency"]
+        assert lat["fast_burn"] and lat["burn_fast"] > 5.0
+        assert health["status"] == "degraded"  # pre-emptive amber
+
+        # The fault ends; the decoded blocks are cached, traffic is
+        # fast again, and the fast window forgets the bad second.
+        faults.clear()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            for pos in positions:
+                _post(base + "/query", {"positions": [pos]})
+            health = _get(base + "/healthz")[1]
+            if health["status"] == "ok":
+                break
+            time.sleep(0.25)
+        assert health["status"] == "ok"
+        assert not health["slo"]["routes"]["default"]["latency"][
+            "fast_burn"]
+
+
 def test_drain_flips_healthz_and_refuses_new_queries(nim_reader):
     from gamesmanmpi_tpu.serve import QueryServer
 

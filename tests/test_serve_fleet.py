@@ -565,6 +565,163 @@ def test_supervisor_exec_mode_serves_and_recovers(sub_db, monkeypatch):
         sup.stop()
 
 
+def _post_with_headers(url, payload, headers=None, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, dict(resp.headers), json.loads(resp.read())
+
+
+def test_cli_fleet_fork_mode_propagates_traceparent(sub_db, tmp_path):
+    """ISSUE 17 e2e, fork mode: a client traceparent survives the
+    shared-socket fleet — the answering worker echoes the trace id on
+    the response header, keeps the trace in its ring (head sampling
+    pinned to keep-everything via env, which fork workers inherit), and
+    ships it on a heartbeat beat to the supervisor, where the control
+    port serves it fleet-wide (GET /traces) stamped with the worker
+    index."""
+    from gamesmanmpi_tpu.obs.qtrace import (
+        format_traceparent,
+        mint_trace_ids,
+        parse_traceparent,
+    )
+
+    env = dict(os.environ)
+    env["GAMESMAN_PLATFORM"] = "cpu"
+    env["GAMESMAN_SERVE_RESTART_BASE_SECS"] = "0.1"
+    env["GAMESMAN_SERVE_HEARTBEAT_SECS"] = "0.2"
+    env["GAMESMAN_TRACE_HEAD_N"] = "1"
+    env.pop("GAMESMAN_FAULTS", None)
+    proc = subprocess.Popen(
+        _CLI + ["serve", str(sub_db), "--port", "0", "--workers", "2",
+                "--control-port", "0"],
+        stdout=subprocess.PIPE, text=True, env=env, cwd=str(REPO),
+    )
+    try:
+        banner = proc.stdout.readline()
+        assert "serving fleet" in banner, banner
+        port = int(banner.split("http://127.0.0.1:")[1].split(" ")[0])
+        cport = int(banner.split("http://127.0.0.1:")[2].split(" ")[0])
+        base, control = (f"http://127.0.0.1:{port}",
+                         f"http://127.0.0.1:{cport}")
+        st = _wait_for(
+            lambda: (s := _get(control + "/healthz")[1])["status"] == "ok"
+            and s,
+            timeout=120, what="fleet ready",
+        )
+        assert st["spawn_mode"] == "fork"
+
+        tids = []
+        # Distinct NON-initial positions: the worker's startup
+        # self-probe warmed the answer cache for the initial position,
+        # and a pure cache hit records no batcher/reader spans.
+        for pos in (9, 8, 7, 6, 5, 4):
+            tid, sid = mint_trace_ids()
+            status, headers, body = _post_with_headers(
+                base + "/query", {"positions": [pos]},
+                headers={"traceparent": format_traceparent(tid, sid)},
+            )
+            assert status == 200 and body["results"][0]["found"]
+            echoed = parse_traceparent(headers.get("traceparent"))
+            assert echoed is not None and echoed[0] == tid
+            assert echoed[1] != sid  # the server's own span id
+            tids.append(tid)
+
+        # Kept traces ride heartbeat beats into the supervisor's
+        # fleet-wide ring; the control port serves the aggregate.
+        def _ours():
+            snap = _get(control + "/traces")[1]
+            assert snap["kind"] == "qtrace_fleet"
+            got = [t for t in snap["traces"]
+                   if t.get("trace_id") in tids]
+            return got or None
+
+        got = _wait_for(_ours, timeout=60,
+                        what="client traces on the control port")
+        for rec in got:
+            assert rec["status"] == "ok" and rec["code"] == 200
+            assert rec["worker"] in (0, 1)  # supervisor-stamped slot
+            assert rec["keep"] in ("head", "slow")
+            names = {s["name"] for s in rec["spans"]}
+            assert "queue_wait" in names
+
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def test_supervisor_exec_mode_propagates_traceparent(sub_db,
+                                                     monkeypatch):
+    """ISSUE 17 e2e, exec mode: the same traceparent contract when the
+    worker was re-exec'd (env-inherited trace knobs, beat-shipped
+    traces) — asserted through ServeSupervisor.traces(), the object
+    backing control GET /traces."""
+    from gamesmanmpi_tpu.obs.qtrace import (
+        format_traceparent,
+        mint_trace_ids,
+        parse_traceparent,
+    )
+
+    monkeypatch.setenv("GAMESMAN_PLATFORM", "cpu")
+    # Exec workers inherit os.environ (subprocess.Popen without env=):
+    # this knob must reach the child or nothing below samples.
+    monkeypatch.setenv("GAMESMAN_TRACE_HEAD_N", "1")
+    sup = ServeSupervisor(
+        single_db_entries(sub_db), workers=1, control_port=None,
+        restart_base=0.1, heartbeat_secs=0.2, heartbeat_timeout=30.0,
+    ).start()
+    try:
+        assert sup.status()["spawn_mode"] == "exec"
+        _wait_for(
+            lambda: sup.status()["status"] == "ok",
+            timeout=180, what="exec worker ready",
+        )
+        base = f"http://127.0.0.1:{sup.port}"
+        tid, sid = mint_trace_ids()
+        # Non-initial position: the self-probe warmed the answer cache
+        # for the initial one, and a cache hit records no spans.
+        status, headers, body = _post_with_headers(
+            base + "/query", {"positions": [7]},
+            headers={"traceparent": format_traceparent(tid, sid)},
+        )
+        assert status == 200 and body["results"][0]["found"]
+        echoed = parse_traceparent(headers.get("traceparent"))
+        assert echoed is not None and echoed[0] == tid
+
+        def _ours():
+            snap = sup.traces()
+            got = [t for t in snap["traces"]
+                   if t.get("trace_id") == tid]
+            return got or None
+
+        (rec,) = _wait_for(_ours, timeout=60,
+                           what="trace shipped over the exec beat")
+        assert rec["parent_id"] == sid
+        assert rec["worker"] == 0
+        assert {s["name"] for s in rec["spans"]} >= {"queue_wait"}
+
+        # The burn-rate snapshot rides the same beat: control /status
+        # (sup.status()) shows the per-worker SLO view, not just the
+        # degraded/ok flip it induces.
+        def _slo_on_status():
+            st = sup.status()
+            slo = st["workers"]["0"].get("slo")
+            return st if isinstance(slo, dict) and "routes" in slo else None
+
+        st = _wait_for(_slo_on_status, timeout=30,
+                       what="slo snapshot on the beat")
+        assert st["slo_fast_burn"] is False
+        assert "p99_ms" in st["workers"]["0"]["slo"]
+    finally:
+        sup.stop()
+
+
 def test_workers_never_outlive_a_sigkilled_supervisor(sub_db, tmp_path):
     """No orphans: a worker wedged in WARM START (nothing written on
     the heartbeat pipe yet, so EPIPE can never tell it the supervisor

@@ -132,6 +132,8 @@ def compare(new: dict, trajectory, min_ratio: float) -> tuple:
         nrf.get("dispatch_overhead_frac"),
         brf.get("dispatch_overhead_frac"),
     ))
+    ab_ok, ab_lines = check_trace_ab(new)
+    lines.extend(ab_lines)
     if ratio < min_ratio:
         lines.append(
             f"REGRESSION: new value is {ratio:.2f}x the trajectory best "
@@ -139,8 +141,45 @@ def compare(new: dict, trajectory, min_ratio: float) -> tuple:
             "committing this record"
         )
         return False, lines
+    if not ab_ok:
+        return False, lines
     lines.append("ok")
     return True, lines
+
+
+def check_trace_ab(new: dict) -> tuple:
+    """-> (ok, lines): the query-tracing overhead gate (ISSUE 17).
+
+    A record carrying a serve trace A/B arm (bench.py's
+    ``serve.trace_ab`` summary, or a BENCH_serve artifact's top-level
+    ``trace_ab``) must show tracing-on p99 within the arm's recorded
+    threshold of tracing-off — ``ok`` is computed by bench.py at
+    measurement time; this gate makes CI refuse a record where sampling
+    overhead crossed it. Records without the arm pass untouched.
+    """
+    ab = (new.get("serve") or {}).get("trace_ab") or new.get("trace_ab")
+    if not isinstance(ab, dict):
+        return True, []
+    if "error" in ab:
+        return False, [
+            f"TRACE A/B BROKEN: {ab['error']} — the overhead arm never "
+            "measured; rerun before committing this record"
+        ]
+    delta = ab.get("delta_pct")
+    limit = ab.get("max_delta_pct")
+    line = (
+        f"trace_ab: tracing-on p99 delta {delta}% "
+        f"(limit {limit}% + slack) -> "
+        + ("ok" if ab.get("ok") else "OVER BUDGET")
+    )
+    if not ab.get("ok"):
+        return False, [
+            line,
+            "TRACING OVERHEAD REGRESSION: tail-sampled query tracing "
+            "costs more than the recorded p99 budget — investigate "
+            "before committing this record",
+        ]
+    return True, [line]
 
 
 def main(argv=None) -> int:

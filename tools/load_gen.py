@@ -41,11 +41,25 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import threading
 import time
 import urllib.error
 import urllib.request
+
+
+def _mint_traceparent() -> tuple:
+    """(trace_id, W3C traceparent header) minted client-side.
+
+    Inlined (not imported from obs/qtrace.py) on purpose: this module's
+    contract is stdlib-only so bench.py's jax-free parent can import it.
+    Sending the header makes the CLIENT the trace root — a client-side
+    p99 outlier in --out-jsonl joins its server-side sampled trace
+    (worker GET /traces, fleet control GET /traces) by this id.
+    """
+    tid = os.urandom(16).hex()
+    return tid, f"00-{tid}-{os.urandom(8).hex()}-01"
 
 
 def percentile(sorted_vals: list, q: float) -> float:
@@ -59,7 +73,7 @@ def percentile(sorted_vals: list, q: float) -> float:
 class _Stats:
     """Shared accumulator; one lock, touched once per request."""
 
-    def __init__(self):
+    def __init__(self, keep_records: bool = False):
         self.lock = threading.Lock()
         self.latencies = []  # guarded-by: lock
         self.ok = 0  # guarded-by: lock
@@ -69,14 +83,17 @@ class _Stats:
         self.codes = {}  # guarded-by: lock
         self.answers = {}  # guarded-by: lock
         self.mismatches = 0  # guarded-by: lock
+        self.keep_records = keep_records
+        self.records = []  # guarded-by: lock (per-request, --out-jsonl)
 
     def note(self, kind: str, code, secs: float | None,
-             results=None) -> None:
+             results=None, trace_id: str | None = None) -> None:
         with self.lock:
             if secs is not None:
                 self.latencies.append(secs)
             self.codes[str(code)] = self.codes.get(str(code), 0) + 1
             setattr(self, kind, getattr(self, kind) + 1)
+            mismatch = False
             for rec in results or ():
                 pos = rec.get("position")
                 ans = (rec.get("value"), rec.get("remoteness"),
@@ -84,7 +101,17 @@ class _Stats:
                 old = self.answers.get(pos)
                 if old is not None and old != ans:
                     self.mismatches += 1
+                    mismatch = True
                 self.answers[pos] = ans
+            if self.keep_records:
+                self.records.append({
+                    "trace_id": trace_id,
+                    "kind": kind,
+                    "code": code if isinstance(code, int) else str(code),
+                    "latency_ms": round(secs * 1e3, 3)
+                    if secs is not None else None,
+                    "mismatch": mismatch,
+                })
 
 
 def _worker_loop(url: str, chunks: list, stats: _Stats, stop: threading.Event,
@@ -94,10 +121,12 @@ def _worker_loop(url: str, chunks: list, stats: _Stats, stop: threading.Event,
         chunk = chunks[i % len(chunks)]
         i += 1
         body = json.dumps({"positions": chunk}).encode()
+        trace_id, traceparent = _mint_traceparent()
         req = urllib.request.Request(
             f"{url}/query", data=body,
             headers={"Content-Type": "application/json",
-                     "Connection": "close"},
+                     "Connection": "close",
+                     "traceparent": traceparent},
             method="POST",
         )
         t0 = time.perf_counter()
@@ -110,23 +139,30 @@ def _worker_loop(url: str, chunks: list, stats: _Stats, stop: threading.Event,
                 r.get("found") and "error" not in r for r in results
             ) and len(results) == len(chunk)
             stats.note("ok" if clean else "errors", 200, secs,
-                       results if clean else None)
+                       results if clean else None, trace_id=trace_id)
         except urllib.error.HTTPError as e:
             secs = time.perf_counter() - t0
-            stats.note("shed" if e.code == 503 else "errors", e.code, secs)
+            stats.note("shed" if e.code == 503 else "errors", e.code, secs,
+                       trace_id=trace_id)
         except Exception:  # noqa: BLE001 - URLError/socket/timeout: dropped
-            stats.note("dropped", "conn", None)
+            stats.note("dropped", "conn", None, trace_id=trace_id)
 
 
 def run_load(url: str, positions: list, *, duration: float = 5.0,
              concurrency: int = 4, chunk_size: int = 8,
-             timeout: float = 10.0, stop_event=None) -> dict:
+             timeout: float = 10.0, stop_event=None,
+             out_jsonl: str | None = None) -> dict:
     """Drive load; returns the stats record (see module docstring).
 
     positions: ints (or hex strings) assumed PRESENT in the served DB —
     a miss counts as an error by design. Each thread cycles through
     round-robin chunks at its own offset so concurrent threads overlap
     on hot positions (cache hits) AND spread over the whole set.
+
+    out_jsonl: when set, one JSON line per request is written there —
+    {trace_id, kind, code, latency_ms, mismatch} — so an outlier seen
+    from the CLIENT side can be joined to its server-side sampled trace
+    by trace_id (docs/SERVING.md "Debugging a slow query").
     """
     url = url.rstrip("/")
     positions = [int(p, 0) if isinstance(p, str) else int(p)
@@ -136,7 +172,7 @@ def run_load(url: str, positions: list, *, duration: float = 5.0,
         positions[i:i + chunk_size]
         for i in range(0, len(positions), chunk_size)
     ] or [[0]]
-    stats = _Stats()
+    stats = _Stats(keep_records=out_jsonl is not None)
     stop = stop_event or threading.Event()
     threads = [
         threading.Thread(
@@ -178,6 +214,11 @@ def run_load(url: str, positions: list, *, duration: float = 5.0,
                 str(pos): ans for pos, ans in stats.answers.items()
             },
         }
+        records = list(stats.records)
+    if out_jsonl:
+        with open(out_jsonl, "w") as fh:
+            for r in records:
+                fh.write(json.dumps(r) + "\n")
     return record
 
 
@@ -213,6 +254,12 @@ def main(argv=None) -> int:
                    "(connection failures) than this budget")
     p.add_argument("--json", default=None, metavar="OUT",
                    help="also write the full record to this file")
+    p.add_argument("--out-jsonl", default=None, metavar="OUT",
+                   help="write one JSON line per request: {trace_id, "
+                   "kind, code, latency_ms, mismatch} — the trace_id is "
+                   "the one sent as the W3C traceparent header, so a "
+                   "client-observed outlier joins its server-side "
+                   "sampled trace (GET /traces)")
     args = p.parse_args(argv)
     try:
         positions = _read_positions(args.positions_file)
@@ -225,7 +272,7 @@ def main(argv=None) -> int:
     record = run_load(
         args.url, positions, duration=args.duration,
         concurrency=args.concurrency, chunk_size=args.chunk_size,
-        timeout=args.timeout,
+        timeout=args.timeout, out_jsonl=args.out_jsonl,
     )
     gates_ok = True
     if args.slo_p99_ms is not None and record["p99_ms"] > args.slo_p99_ms:

@@ -186,14 +186,27 @@ def serving_summary(records: list[dict]) -> list[dict]:
     """Machine-readable per-worker serving rows (the --json form;
     ``summarize_serving`` renders them as text)."""
     by_worker: dict = {}
+
+    def _row(worker):
+        return by_worker.setdefault(
+            worker,
+            {"batches": 0, "requests": 0, "queries": 0, "secs": 0.0,
+             "db_cache": {}, "routes": {}, "slo": None},
+        )
+
     for rec in records:
+        if rec.get("phase") == "serve_stats":
+            # End-of-life summary a QueryServer logs at stop(): per-route
+            # estimated latency quantiles (registry histogram snapshot —
+            # the one estimate_quantiles derivation) + the SLO burn
+            # snapshot. Cumulative, so the last record per worker wins.
+            srow = _row(rec.get("worker"))
+            srow["routes"] = rec.get("routes", {}) or {}
+            srow["slo"] = rec.get("slo")
+            continue
         if rec.get("phase") != "serve_batch":
             continue
-        row = by_worker.setdefault(
-            rec.get("worker"),
-            {"batches": 0, "requests": 0, "queries": 0, "secs": 0.0,
-             "db_cache": {}},
-        )
+        row = _row(rec.get("worker"))
         row["batches"] += 1
         row["requests"] += int(rec.get("requests", 0))
         row["queries"] += int(rec.get("batch_size", 0))
@@ -229,6 +242,8 @@ def serving_summary(records: list[dict]) -> list[dict]:
                 }
                 for dbk, (hits, misses) in row["db_cache"].items()
             },
+            "routes": row["routes"],
+            "slo": row["slo"],
         })
     return rows
 
@@ -263,6 +278,31 @@ def summarize_serving(records: list[dict]) -> list[str]:
                 f"db_cache_hit_rate{tag}={cell['hit_rate']:.3f}"
             )
         lines.append(line)
+        # Estimated per-route latency quantiles (serve_stats record —
+        # registry-histogram interpolation, not raw samples) + SLO burn.
+        for route in sorted(row["routes"]):
+            cell = row["routes"][route]
+            qcols = " ".join(
+                f"{k}={cell[k]:.3f}"
+                for k in ("p50_ms", "p95_ms", "p99_ms") if k in cell
+            )
+            lines.append(
+                f"{label} route[{route}]: count={cell.get('count', 0)}"
+                + (f" {qcols}" if qcols else "")
+            )
+        slo = row.get("slo")
+        if slo:
+            burns = " ".join(
+                f"{route}/{obj}={objs[obj]['burn_fast']:.2f}"
+                for route, objs in sorted(slo.get("routes", {}).items())
+                for obj in sorted(objs)
+            )
+            lines.append(
+                f"{label} slo: fast_burn="
+                f"{'FIRING' if slo.get('fast_burn') else 'ok'} "
+                f"p99_target_ms={slo.get('p99_ms')}"
+                + (f" burn[{burns}]" if burns else "")
+            )
     return lines
 
 
@@ -461,7 +501,7 @@ def _aux_counts(records: list[dict]) -> dict:
         # retries column; a retry without a level (serving) still lands
         # here. serve_batch has its own per-worker summary lines.
         if phase not in ("forward", "backward", "backward_edges", "done",
-                         "serve_batch") \
+                         "serve_batch", "serve_stats") \
                 and phase not in _CAMPAIGN_PHASES \
                 and not (phase in ("retry", "ckpt_degraded")
                          and "level" in rec):
