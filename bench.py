@@ -315,7 +315,8 @@ def _multiprocess_smoke() -> dict | None:
     return artifact
 
 
-def _launch_fleet(db: str, workers: int, env: dict | None = None):
+def _launch_fleet(db: str, workers: int, env: dict | None = None,
+                  extra_args: list | None = None):
     """Launch `cli serve --workers N` on ephemeral ports and wait until
     the fleet reports ready — the subprocess choreography _serve_bench
     and _db_compress_bench share (bounded banner read: a supervisor that
@@ -333,7 +334,7 @@ def _launch_fleet(db: str, workers: int, env: dict | None = None):
     proc = subprocess.Popen(
         [sys.executable, "-m", "gamesmanmpi_tpu.cli", "serve", db,
          "--port", "0", "--workers", str(workers),
-         "--control-port", "0"],
+         "--control-port", "0", *(extra_args or [])],
         stdout=subprocess.PIPE, text=True,
         env=dict(os.environ, **env) if env else None,
     )
@@ -585,6 +586,224 @@ def _serve_trace_ab(db: str, workers: int, conc: int,
     ab["delta_pct"] = round((on - off) / max(off, 1e-9) * 100.0, 2)
     ab["ok"] = bool(on <= off * (1.0 + max_pct / 100.0) + slack_ms)
     return ab
+
+
+def _serve_hot_bench() -> dict | None:
+    """BENCH_SERVE_HOT=1: the serving hot-path A/B (ISSUE 18).
+
+    One compressed DB with a sealed opening book, two fresh fork-mode
+    fleets over it, the same deterministic zipf request stream on each:
+
+    * **baseline** — ``GAMESMAN_SHM_CACHE_MB=0`` +
+      ``GAMESMAN_SERVE_BOOK=0``: every query runs the full path
+      (canonicalize, private-cache block decode);
+    * **hot** — the defaults: book hits answered from resident arrays,
+      block decodes published to the cross-worker shared-memory cache,
+      batcher dedup collapsing the zipf head.
+
+    Both arms squeeze the PRIVATE decoded-block cache
+    (``GAMESMAN_DB_CACHE_MB``) so the DB does not fit one worker's
+    RAM — the deployment the shared tier exists for. Gates: zero
+    errors/dropped/mismatches on both arms, hot qps >= baseline AND
+    hot p99 <= baseline, book hits > 0, shm hits > 0, and a
+    conditional-GET pass on the hot arm revalidating (304) with zero
+    errors. Artifact -> BENCH_SERVE_HOT_OUT (BENCH_serve_hot.json),
+    gated by tools/bench_compare.py's check_serve_hot. Runs in the
+    PARENT (subprocess + stdlib load_gen only; never touches jax).
+    """
+    if os.environ.get("BENCH_SERVE_HOT", "0") in ("0", "", "off"):
+        return None
+    import signal
+    import tempfile
+    import urllib.request
+
+    from tools.load_gen import run_load
+
+    # The board must produce a DB that does NOT fit the squeezed
+    # private cache — a toy DB is resident everywhere, probes cost
+    # nothing, and the hot tiers read as pure overhead.
+    spec = os.environ.get("BENCH_SERVE_HOT_GAME", "connect4:w=5,h=4")
+    workers = int(_env_float("BENCH_SERVE_HOT_WORKERS", 2))
+    secs = _env_float("BENCH_SERVE_HOT_SECS", 8.0)
+    conc = int(_env_float("BENCH_SERVE_HOT_CONC", 8))
+    zipf_s = _env_float("BENCH_SERVE_HOT_ZIPF_S", 1.1)
+    plies = int(_env_float("BENCH_SERVE_HOT_BOOK_PLIES", 4))
+    cache_mb = os.environ.get("BENCH_SERVE_HOT_DB_CACHE_MB", "1")
+    # Both arms get a bounded answer LRU: on a toy bench DB the default
+    # 65536-entry cache would swallow the whole sampled position set,
+    # hiding the probe path the hot tiers exist to accelerate (a real
+    # DB's query space dwarfs any per-worker answer cache).
+    lru = os.environ.get("BENCH_SERVE_HOT_CACHE_SIZE", "256")
+    # Single-position requests: the interactive regime the hot path
+    # targets. A request is only exempt from the batcher window when
+    # EVERY position in it is book-answered, so multi-position chunks
+    # would re-impose the window wait on the whole zipf head.
+    chunk = int(_env_float("BENCH_SERVE_HOT_CHUNK", 1))
+    # Tight coalescing window, both arms: at interactive chunk=1 depth a
+    # wide window makes every request's latency mostly *waiting for
+    # strangers*, drowning the probe costs the A/B exists to compare.
+    window_ms = _env_float("BENCH_SERVE_HOT_WINDOW_MS", 0.5)
+    out_path = os.environ.get("BENCH_SERVE_HOT_OUT", "BENCH_serve_hot.json")
+    deadline = _env_float("GAMESMAN_BENCH_DEADLINE", 3000.0)
+    dist = f"zipf:{zipf_s:g}"
+    hot: dict = {
+        "bench": "serve_hot_ab", "game": spec, "workers": workers,
+        "concurrency": conc, "dist": dist, "book_plies": plies,
+        "db_cache_mb": cache_mb, "cache_size": lru, "chunk": chunk,
+        "window_ms": window_ms, "secs": secs, "ok": False,
+    }
+    artifact = {
+        "metric": "serve_hot_qps", "value": 0.0,
+        "device": os.environ.get("GAMESMAN_PLATFORM", "cpu"),
+        "serve_hot": hot,
+    }
+    counters_wanted = (
+        "gamesman_book_hits_total", "gamesman_shm_hits_total",
+        "gamesman_shm_misses_total", "gamesman_shm_stores_total",
+        "gamesman_shm_evictions_total", "gamesman_batch_dup_hits_total",
+    )
+
+    def _scrape_counters(url: str) -> dict:
+        """Max-over-scrapes of the hot-path counters: each /metrics GET
+        lands on whichever worker accepts it (registries are
+        per-process), so repeated one-shot connections sample the fleet
+        and the max proves at least one worker crossed zero."""
+        best = {n: 0.0 for n in counters_wanted}
+        for _ in range(max(4, workers * 4)):
+            req = urllib.request.Request(
+                url + "/metrics", headers={"Connection": "close"}
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    text = resp.read().decode()
+            except OSError:
+                continue
+            cur = {n: 0.0 for n in counters_wanted}
+            for line in text.splitlines():
+                if line.startswith("#"):
+                    continue
+                for n in counters_wanted:
+                    if line.startswith(n + "{") or line.startswith(n + " "):
+                        try:
+                            cur[n] += float(line.rsplit(" ", 1)[1])
+                        except ValueError:
+                            pass
+            for n in counters_wanted:
+                best[n] = max(best[n], cur[n])
+        return best
+
+    t0 = time.perf_counter()
+    try:
+        with tempfile.TemporaryDirectory(prefix="bench_serve_hot_") as td:
+            db = os.path.join(td, "db")
+            export = subprocess.run(
+                [sys.executable, "-m", "gamesmanmpi_tpu.cli", "export-db",
+                 spec, "--out", db, "--compress",
+                 "--book-plies", str(plies)],
+                timeout=deadline, capture_output=True, text=True,
+            )
+            if export.returncode != 0:
+                hot["error"] = "export-db failed: " + export.stderr[-1000:]
+                return artifact
+            # A wide sample (all blocks, thousands of positions): the
+            # zipf head must overflow the bounded answer LRU and the
+            # tail must overflow the squeezed private block cache, or
+            # neither arm ever probes after warmup and the A/B measures
+            # nothing but fixed HTTP overhead.
+            positions = _db_sample_positions(db, per_level=256, cap=4096)
+            if not positions:
+                hot["error"] = "no positions sampled from the DB"
+                return artifact
+            shared_env = {"GAMESMAN_DB_CACHE_MB": cache_mb}
+            arm_envs = {
+                "baseline": dict(shared_env, GAMESMAN_SHM_CACHE_MB="0",
+                                 GAMESMAN_SERVE_BOOK="0"),
+                "hot": shared_env,
+            }
+            for arm, env in arm_envs.items():
+                fleet = _launch_fleet(
+                    db, workers, env=env,
+                    extra_args=["--cache-size", lru,
+                                "--batch-window-ms", f"{window_ms:g}"],
+                )
+                proc = fleet.get("proc")
+                try:
+                    if "error" in fleet:
+                        hot["error"] = f"{arm} arm: {fleet['error']}"
+                        return artifact
+                    hot.setdefault(
+                        "spawn_mode", fleet["status"].get("spawn_mode")
+                    )
+                    url = f"http://127.0.0.1:{fleet['port']}"
+                    load = run_load(
+                        url, positions, duration=secs, concurrency=conc,
+                        chunk_size=chunk, dist=dist, seed=18,
+                    )
+                    hot[arm] = {
+                        k: load[k] for k in
+                        ("qps", "p50_ms", "p95_ms", "p99_ms", "requests",
+                         "ok", "shed", "errors", "dropped", "mismatches")
+                    }
+                    if arm == "hot":
+                        # Same fleet, same zipf stream, conditional GETs:
+                        # the edge-cacheable form must revalidate (304)
+                        # without a single wrong or failed answer.
+                        get = run_load(
+                            url, positions, concurrency=conc,
+                            duration=max(2.0, secs / 2), dist=dist,
+                            mode="get", seed=18,
+                        )
+                        hot["get"] = {
+                            k: get[k] for k in
+                            ("qps", "p99_ms", "requests", "ok",
+                             "not_modified", "errors", "dropped",
+                             "mismatches")
+                        }
+                        hot["counters"] = _scrape_counters(url)
+                    proc.send_signal(signal.SIGTERM)
+                    proc.wait(timeout=60)
+                    proc = None
+                finally:
+                    if proc is not None and proc.poll() is None:
+                        proc.kill()
+                        proc.wait()
+            base, hotarm = hot["baseline"], hot["hot"]
+            ctr, get = hot["counters"], hot["get"]
+            hot["clean"] = all(
+                a["errors"] == 0 and a["dropped"] == 0
+                and a["mismatches"] == 0 for a in (base, hotarm)
+            )
+            hot["perf_ok"] = bool(
+                hotarm["qps"] >= base["qps"]
+                and hotarm["p99_ms"] <= base["p99_ms"]
+            )
+            hot["book_hits"] = ctr["gamesman_book_hits_total"]
+            hot["shm_hits"] = ctr["gamesman_shm_hits_total"]
+            hot["hits_ok"] = bool(
+                hot["book_hits"] > 0 and hot["shm_hits"] > 0
+            )
+            hot["get_ok"] = bool(
+                get["errors"] == 0 and get["dropped"] == 0
+                and get["mismatches"] == 0 and get["not_modified"] > 0
+            )
+            hot["ok"] = bool(
+                hot["clean"] and hot["perf_ok"] and hot["hits_ok"]
+                and hot["get_ok"]
+            )
+            artifact["value"] = hotarm["qps"]
+    except Exception as e:  # noqa: BLE001 - the bench must survive this
+        hot["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        hot.setdefault("secs_wall", round(time.perf_counter() - t0, 3))
+        try:
+            with open(out_path, "w") as fh:
+                json.dump(artifact, fh, indent=1)
+            print(f"serve hot bench: wrote {out_path} (ok={hot['ok']})",
+                  file=sys.stderr)
+        except OSError as e:
+            print(f"serve hot bench: cannot write {out_path}: {e}",
+                  file=sys.stderr)
+    return artifact
 
 
 def _store_bench() -> dict | None:
@@ -1436,8 +1655,15 @@ def _db_sample_positions(db: str, per_level: int = 64,
         positions.extend(int(k) for k in keys[::step][:per_level])
     if not positions:
         # Format v2 (block-compressed) directory: no .npy key files to
-        # mmap, but the manifest's per-block first_keys are real
-        # positions and already resident — sample those.
+        # mmap. Decode the key frames in a child (the real codec path
+        # lives behind the package __init__, which imports jax — and
+        # this parent never touches jax).
+        positions = _db_sample_positions_v2(db, per_level)
+    if not positions:
+        # Last resort: the manifest's per-block first_keys are real
+        # positions and already resident. A coarse sample (one position
+        # per block) — fine for smoke, too small for cache-pressure
+        # benches.
         try:
             with open(os.path.join(db, "manifest.json")) as fh:
                 manifest = json.load(fh)
@@ -1453,6 +1679,53 @@ def _db_sample_positions(db: str, per_level: int = 64,
         step = len(positions) // cap
         positions = positions[::step][:cap]
     return positions
+
+
+#: Runs in a short-lived child: decode every level's key frames with the
+#: real block codec and print a level-ordered stride sample. argv:
+#: db_dir per_level.
+_SAMPLE_V2_CHILD = """
+import os, sys
+import numpy as np
+from gamesmanmpi_tpu.db.format import read_manifest
+from gamesmanmpi_tpu.compress.blocks import decode_block
+db, per_level = sys.argv[1], int(sys.argv[2])
+m = read_manifest(db)
+out = []
+for lvl in sorted(m.get("levels", {}), key=int):
+    rec = m["levels"][lvl]
+    idx = rec.get("keys_blocks")
+    if not idx:
+        continue
+    with open(os.path.join(db, rec["keys"]), "rb") as fh:
+        stream = fh.read()
+    offs = np.concatenate(([0], np.cumsum(idx["lengths"], dtype=np.int64)))
+    nblocks = len(idx["lengths"])
+    per_block = max(1, per_level // nblocks)
+    for b in range(nblocks):
+        arr = decode_block(idx, b, stream[offs[b]:offs[b + 1]])
+        step = max(1, arr.shape[0] // per_block)
+        out.extend(int(k) for k in arr[::step][:per_block])
+print(" ".join(map(str, out)))
+"""
+
+
+def _db_sample_positions_v2(db: str, per_level: int) -> list:
+    """Sample real keys from a v2 (block-compressed) DB, spread across
+    every block of every level — so a zipf stream over the sample
+    actually exercises block residency, not just each block's first key.
+    Returns [] on any failure (caller falls back to first_keys)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _SAMPLE_V2_CHILD, db, str(per_level)],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        if proc.returncode != 0:
+            return []
+        return [int(tok) for tok in proc.stdout.split()]
+    except (OSError, subprocess.SubprocessError, ValueError):
+        return []
 
 
 def main() -> int:
@@ -1607,6 +1880,22 @@ def main() -> int:
                 for k in ("ok", "delta_pct", "max_delta_pct", "error")
                 if k in sv["trace_ab"]
             }
+    sh = _serve_hot_bench()
+    if sh is not None:
+        # Summary only — arm details live in the artifact file
+        # (BENCH_SERVE_HOT_OUT); the one-line record stays one line.
+        shs = sh.get("serve_hot") or {}
+        record["serve_hot"] = {
+            k: shs.get(k) for k in
+            ("ok", "clean", "perf_ok", "hits_ok", "get_ok",
+             "book_hits", "shm_hits", "error")
+            if k in shs
+        }
+        for arm in ("baseline", "hot"):
+            if arm in shs:
+                record["serve_hot"][arm] = {
+                    k: shs[arm].get(k) for k in ("qps", "p99_ms")
+                }
     print(json.dumps(record))
     return 0
 

@@ -956,6 +956,14 @@ def _db_parser() -> argparse.ArgumentParser:
         "probed blocks through a hot-block cache). Default from "
         "GAMESMAN_DB_COMPRESS; v1 DBs stay readable forever",
     )
+    pe.add_argument(
+        "--book-plies", type=int, default=None, metavar="N",
+        help="also build the resident opening book: every position "
+        "within N plies of the initial position, scored through the "
+        "finished DB and sealed as book.gmb in the manifest — the "
+        "serving hot path answers book hits from RAM (docs/SERVING.md "
+        "\"Hot path\"). Default from GAMESMAN_BOOK_PLIES; 0 = no book",
+    )
     pe.add_argument("--jsonl", default=None,
                     help="write per-level export metrics to this JSONL file")
     pe.add_argument("-v", "--verbose", action="store_true",
@@ -1094,7 +1102,7 @@ def _obs_scope(args):
 def _cmd_export_db(args) -> int:
     from gamesmanmpi_tpu.db import DbFormatError, DbWriter, export_checkpoint
     from gamesmanmpi_tpu.games import get_game
-    from gamesmanmpi_tpu.utils.env import env_bool
+    from gamesmanmpi_tpu.utils.env import env_bool, env_int
 
     if args.spec is not None:
         if args.game is not None:
@@ -1170,10 +1178,27 @@ def _cmd_export_db(args) -> int:
             # file deleted) — a usage-visible input problem, not a crash.
             print(f"error: {e}", file=sys.stderr)
             return 2
+        book_plies = (
+            env_int("GAMESMAN_BOOK_PLIES", 0)
+            if args.book_plies is None else int(args.book_plies)
+        )
+        if book_plies > 0:
+            # After finalize on purpose: the book is scored through a
+            # real reader over the sealed DB, and sealing it rewrites
+            # the manifest (new DB epoch) exactly once more.
+            from gamesmanmpi_tpu.db.book import build_book
+
+            manifest["book"] = build_book(args.out, book_plies, game=game)
     print(f"database written: {args.out}")
     print(f"game: {manifest['game']}")
     print(f"levels: {len(manifest['levels'])}")
     print(f"positions: {manifest['num_positions']}")
+    book_rec = manifest.get("book")
+    if book_rec:
+        print(
+            f"opening book: {book_rec['count']} entries to "
+            f"{book_rec['plies']} plies"
+        )
     comp = manifest.get("compression")
     if comp:
         ratio = comp["raw_bytes"] / max(comp["stored_bytes"], 1)

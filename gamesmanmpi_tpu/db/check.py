@@ -19,6 +19,11 @@ serving process ever mmaps it:
   block-by-block in O(one block) memory (the same invariant set as v1;
   the storage changed, the contract did not, and the gate must run on
   replica nodes sized for the compressed artifact)
+* a manifest-recorded opening book (``book.gmb``) exists, matches its
+  sha256 seal, parses, and holds sorted-unique decided entries — the
+  structural half only; the answer-level re-probe (every entry vs the
+  reader's slow path) needs game kernels and lives in
+  db/book.py ``verify_book``, which tools/check_db.py runs
 
 ``db_stats`` folds the per-level size/ratio table (tools/check_db.py,
 bench BENCH_DB_COMPRESS); ``db_equal`` proves two DBs logically
@@ -136,6 +141,37 @@ def check_db(directory, verbose=None) -> list[str]:
         problems.append(
             f"manifest num_positions {declared} != shard total {total}"
         )
+    problems += _check_book(directory, manifest, verbose)
+    return problems
+
+
+def _check_book(directory, manifest, verbose=None) -> list[str]:
+    """Structural opening-book check — still game-free: seal (sha256),
+    magic/header parse, entry count vs manifest, sorted-unique
+    positions, decided cells. OpeningBook.load does the seal+parse
+    (raising DbFormatError exactly like a worker warm start would)."""
+    rec = manifest.get("book")
+    if not rec:
+        return []
+    from gamesmanmpi_tpu.db.book import OpeningBook
+    try:
+        book = OpeningBook.load(directory, manifest, verify=True)
+    except (DbFormatError, KeyError, ValueError, OSError) as e:
+        return [f"book: {e}"]
+    problems: list[str] = []
+    if len(book) != int(rec.get("count", -1)):
+        problems.append(
+            f"book: {len(book)} entries, manifest says {rec.get('count')}"
+        )
+    pos = np.asarray(book.positions)
+    if pos.size and not np.all(pos[1:] > pos[:-1]):
+        problems.append("book: positions not strictly ascending")
+    values, _ = unpack_cells_np(np.asarray(book.cells))
+    undecided = int(np.count_nonzero(values == UNDECIDED))
+    if undecided:
+        problems.append(f"book: {undecided} UNDECIDED entries")
+    if verbose is not None and not problems:
+        verbose(f"book: {len(book)} entries OK (plies {rec.get('plies')})")
     return problems
 
 

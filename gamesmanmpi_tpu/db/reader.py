@@ -32,7 +32,9 @@ from gamesmanmpi_tpu.compress import BlockCorruptError
 from gamesmanmpi_tpu.core.codec import unpack_cells_np
 from gamesmanmpi_tpu.core.values import LOSE, TIE, UNDECIDED, WIN
 from gamesmanmpi_tpu.db.format import (
+    MANIFEST_NAME,
     DbFormatError,
+    file_sha256,
     level_is_blocked,
     probe_sorted_np,
     read_manifest,
@@ -49,7 +51,7 @@ from gamesmanmpi_tpu.store import (
     default_store,
     open_npy_mmap,
 )
-from gamesmanmpi_tpu.utils.env import env_int, env_opt
+from gamesmanmpi_tpu.utils.env import env_bool, env_int, env_opt
 
 # Smallest query-kernel capacity: batches are tiny next to frontiers, and
 # every distinct capacity is a compiled program.
@@ -88,9 +90,16 @@ class DbReader:
     """Read-only handle on a finalized solved-position database."""
 
     def __init__(self, directory, game=None, *, verify: bool = False,
-                 registry=None):
+                 registry=None, shm=None):
         self.dir = pathlib.Path(directory)
         self.manifest = read_manifest(self.dir)
+        #: DB epoch — the manifest sha. THE invalidation token of every
+        #: fast path layered over this reader (ISSUE 18): shared-memory
+        #: block slots are stamped with it, the server's ETag embeds
+        #: it, and the opening book implicitly carries it (building a
+        #: book rewrites the manifest). A reload that changes the DB
+        #: changes the epoch, and everything stale becomes a miss.
+        self.epoch = file_sha256(self.dir / MANIFEST_NAME)
         reg = registry or default_registry()
         self._m_probe_secs = reg.histogram(
             "gamesman_db_probe_seconds",
@@ -150,6 +159,7 @@ class DbReader:
         }
         self._arrays: dict = {}
         self._blocked: dict = {}
+        self._shm = shm  # cross-worker decoded-block tier (store/shm.py)
         self._store = None
         self._private_store = False
         self._m_decode_secs = None
@@ -201,6 +211,17 @@ class DbReader:
                 "on the probe path (cache misses only)",
                 db=self.dir.name,
             )
+        # The resident opening book (db/book.py) rides the reader so
+        # every consumer — fleet worker, single-process server, CLI —
+        # gets the short path for free when the manifest seals one.
+        # Loading re-hashes the seal; a corrupt book refuses the reader
+        # (never a wrong fast answer). GAMESMAN_SERVE_BOOK=0 opts out.
+        self.book = None
+        if self.manifest.get("book") and env_bool("GAMESMAN_SERVE_BOOK",
+                                                  True):
+            from gamesmanmpi_tpu.db.book import OpeningBook
+
+            self.book = OpeningBook.load(self.dir, self.manifest)
         if verify:
             from gamesmanmpi_tpu.db.check import check_db
 
@@ -434,7 +455,23 @@ class DbReader:
                 self._m_decode_secs.observe(time.perf_counter() - t0)
                 return pair
 
-            pair, hit = self._store.read_ex((bl.ident, int(b)), _decode)
+            loader = _decode
+            if self._shm is not None:
+                # The cross-worker tier sits UNDER the private store:
+                # private miss -> shm probe (a sibling worker's decode,
+                # one memcpy) -> real pread+decode, which is then
+                # published for the rest of the fleet. Epoch-stamped:
+                # a reloaded DB's slots read as misses, never as wrong
+                # blocks (store/shm.py).
+                def loader(bl=bl, b=int(b), decode=_decode):
+                    key = (bl.ident[0], bl.ident[1], int(b))
+                    pair = self._shm.get(key, self.epoch)
+                    if pair is None:
+                        pair = decode()
+                        self._shm.put(key, self.epoch, pair[0], pair[1])
+                    return pair
+
+            pair, hit = self._store.read_ex((bl.ident, int(b)), loader)
             with self._stats_lock:
                 if hit:
                     self._hits += 1

@@ -160,6 +160,7 @@ class Batcher:
             "batch_secs_total": 0.0,
             "timeouts": 0,
             "shed": 0,
+            "dup_hits": 0,
             "reader_faults": 0,
             "breaker_opens": 0,
         }
@@ -188,6 +189,10 @@ class Batcher:
         self._m_shed = reg.counter(
             "gamesman_requests_shed_total",
             "submits refused by load shedding or an open breaker",
+        )
+        self._m_dup_hits = reg.counter(
+            "gamesman_batch_dup_hits_total",
+            "positions coalesced away by in-flight dedup before the probe",
         )
         self._m_reader_faults = reg.counter(
             "gamesman_reader_faults_total",
@@ -473,10 +478,19 @@ class Batcher:
                 # (and all future ones) blocked on events nobody will set.
                 faults.fire("serve.flush", batch=len(batch))
                 states = np.concatenate([r.states for r in batch])
+                # In-flight dedup: a hot (zipf) workload coalesces many
+                # requests for the SAME position into one window — probe
+                # each distinct state once and fan the answer back out.
+                uniq, inverse = np.unique(states, return_inverse=True)
+                dup_hits = int(states.shape[0] - uniq.shape[0])
                 with _activate_traces(traces):
                     values, rem, found, best = self.reader.lookup_best(
-                        states
+                        uniq
                     )
+                values = values[inverse]
+                rem = rem[inverse]
+                found = found[inverse]
+                best = best[inverse]
             except Exception as e:  # noqa: BLE001 - must unblock submitters
                 for r in batch:
                     r.error = e
@@ -493,6 +507,9 @@ class Batcher:
                     self.counters["max_batch_size"], int(states.shape[0])
                 )
                 self.counters["batch_secs_total"] += secs
+                self.counters["dup_hits"] += dup_hits
+            if dup_hits:
+                self._m_dup_hits.inc(dup_hits)
             self._m_batch_size.observe(int(states.shape[0]))
             self._m_batch_secs.observe(secs)
             if self.logger is not None:
@@ -500,6 +517,7 @@ class Batcher:
                     "phase": "serve_batch",
                     "batch_size": int(states.shape[0]),
                     "requests": len(batch),
+                    "dup_hits": dup_hits,
                     "secs": secs,
                 }
                 # getattr: chaos/unit tests drive the batcher with stub

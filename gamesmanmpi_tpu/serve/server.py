@@ -41,6 +41,9 @@ import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
+
+import numpy as np
 
 from gamesmanmpi_tpu.core.values import value_name
 from gamesmanmpi_tpu.db.format import parse_position
@@ -48,8 +51,11 @@ from gamesmanmpi_tpu.obs import default_registry
 from gamesmanmpi_tpu.obs.qtrace import (
     QueryTrace,
     TraceRing,
+    activate,
     format_traceparent,
+    qspan,
 )
+from gamesmanmpi_tpu.utils.env import env_int
 from gamesmanmpi_tpu.obs.slo import SloEngine
 from gamesmanmpi_tpu.serve.batcher import (
     Batcher,
@@ -167,8 +173,35 @@ class _Handler(BaseHTTPRequestHandler):
         accept = self.headers.get("Accept", "")
         return "application/json" in accept.lower()
 
+    def _send_status(self, code: int, headers=None) -> int:
+        """Header-only response (304: no body by definition)."""
+        try:
+            self.send_response(code)
+            trace = getattr(self, "_qtrace", None)
+            if trace is not None:
+                self.send_header(
+                    "traceparent",
+                    format_traceparent(trace.trace_id, trace.root_id),
+                )
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            if self.close_connection:
+                self.send_header("Connection", "close")
+            self.end_headers()
+        except CLIENT_ABORT_ERRORS:
+            self.server.note_client_abort()
+            self.close_connection = True
+        return code
+
     def do_GET(self):  # noqa: N802 - http.server API
         srv = self.server
+        parts = urlsplit(self.path)
+        if parts.path == "/query" or parts.path.startswith("/query/"):
+            # The idempotent, edge-cacheable query form (ISSUE 18):
+            # same trace/metrics/SLO bookkeeping as a POST — a CDN miss
+            # that lands here is serving load like any other request.
+            self._run_traced(lambda: self._handle_get_query(parts))
+            return
         if self.path == "/healthz":
             self._send_json(200, srv.healthz())
         elif self.path == "/metrics":
@@ -199,13 +232,19 @@ class _Handler(BaseHTTPRequestHandler):
         # Every POST counts in /metrics, rejects included — an operator
         # watching the counters must see a server busy answering 400s as
         # busy, and http_errors makes the reject rate derivable.
+        self._run_traced(self._handle_post)
+
+    def _run_traced(self, handle) -> None:
+        """The per-query-request bookkeeping shared by POST /query and
+        GET /query: one trace per request (accept the client's
+        traceparent or mint a root), inflight accounting, and the
+        latency/SLO observation. The handler instance persists across
+        keep-alive requests, so the attrs are (re)set per request and
+        cleared in the finally (plain do_GET responses must never echo
+        a stale trace)."""
         t0 = time.perf_counter()
         code = 500
         srv = self.server
-        # One trace per POST: accept the client's traceparent or mint a
-        # root. The handler instance persists across keep-alive
-        # requests, so the attrs are (re)set per request and cleared in
-        # the finally (do_GET responses must never echo a stale trace).
         self._qtrace = (
             QueryTrace(
                 traceparent=self.headers.get("traceparent"),
@@ -217,7 +256,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._shed_status = None  # "shed" | "tripped" when a 503 path
         srv.note_inflight(+1, self.connection)
         try:
-            code = self._handle_post()
+            code = handle()
         finally:
             srv.note_inflight(-1, self.connection)
             secs = time.perf_counter() - t0
@@ -234,17 +273,108 @@ class _Handler(BaseHTTPRequestHandler):
                 shed=self._shed_status is not None, trace=trace,
             )
 
-    def _resolve_route(self):
-        """Route a POST path: "/query" is the default route (single-DB
+    def _resolve_route(self, path=None):
+        """Route a query path: "/query" is the default route (single-DB
         servers and one-game fleets), "/query/<name>" a fleet game."""
         srv = self.server
-        if self.path == "/query":
+        if path is None:
+            path = self.path
+        if path == "/query":
             if srv.default_route is not None:
                 return srv.default_route
             return None
-        if self.path.startswith("/query/"):
-            return srv.routes.get(self.path[len("/query/"):])
+        if path.startswith("/query/"):
+            return srv.routes.get(path[len("/query/"):])
         return None
+
+    def _handle_get_query(self, parts) -> int:
+        """GET /query[/<name>]?p=<pos>: one position, idempotent, with
+        the edge-cache contract — ``ETag: "<epoch16>-<pos-hex>"`` +
+        ``Cache-Control: public, max-age=...`` on every answer, and
+        ``If-None-Match`` revalidation answered 304 with no lookup work
+        at all. The ETag embeds the DB epoch (the manifest sha), so a
+        rolling reload that swaps the DB flips every ETag at once: a
+        CDN's cached body revalidates as stale and refetches — the
+        response is immutable WHILE the epoch holds, never across it.
+        """
+        srv = self.server
+        if srv.draining:
+            self.close_connection = True
+            self._shed_status = "shed"
+            return self._send_json(
+                503, {"error": "server is draining"},
+                headers={"Retry-After": "1"},
+            )
+        route = self._resolve_route(parts.path)
+        if route is not None:
+            self._route_name = route.name or "default"
+        if route is None:
+            return self._send_json(
+                404,
+                {"error": f"no such path {parts.path!r}",
+                 "games": sorted(n for n in srv.routes if n)},
+            )
+        raw = parse_qs(parts.query).get("p")
+        if not raw or len(raw) != 1:
+            return self._send_json(
+                400, {"error": "GET /query needs exactly one "
+                               "?p=<position>"},
+            )
+        reader = route.reader
+        try:
+            state = parse_position(reader.game, raw[0])
+        except (ValueError, TypeError) as e:
+            return self._send_json(400,
+                                   {"error": f"invalid position ({e})"})
+        # The validator: epoch prefix + the position in its one
+        # canonical hex spelling (?p=12 and ?p=0xc revalidate the same
+        # entry; distinct URLS may still cache distinct copies — the
+        # body is identical, correctness never depends on the URL).
+        etag = f'"{reader.epoch[:16]}-{state:x}"'
+        cache_headers = {
+            "ETag": etag,
+            "Cache-Control": f"public, max-age={srv.query_max_age}",
+        }
+        inm = self.headers.get("If-None-Match", "")
+        if inm.strip() == "*" or etag in inm:
+            # Same epoch, same position: the client's copy is current.
+            return self._send_status(304, cache_headers)
+        answer = None
+        with activate((self._qtrace,)):
+            hit = srv.book_lookup(route, [state])
+        if hit is not None and bool(hit[2][0]):
+            bbest = int(hit[3][0])
+            answer = (
+                int(hit[0][0]), int(hit[1][0]), True,
+                None if bbest == int(reader.game.sentinel) else bbest,
+            )
+        if answer is None:
+            try:
+                answer = route.batcher.submit(
+                    [state], trace=self._qtrace
+                )[0]
+            except BatcherUnavailable as e:
+                self._shed_status = (
+                    "tripped" if isinstance(e, BatcherTripped) else "shed"
+                )
+                return self._send_json(
+                    503, {"error": str(e)},
+                    headers={"Retry-After": str(e.retry_after)},
+                )
+            except Exception as e:  # noqa: BLE001 - reader faults: 500,
+                # uncached (no validator on an error body).
+                return self._send_json(500,
+                                       {"error": f"lookup failed: {e}"})
+        value, rem, found, best = answer
+        rec = {"position": hex(state), "found": bool(found)}
+        if found:
+            rec["value"] = value_name(value)
+            rec["remoteness"] = int(rem)
+            rec["best"] = None if best is None else hex(best)
+        return self._send_json(
+            200, {"game": reader.game.name, "results": [rec]},
+            headers=cache_headers,
+        )
 
     def _handle_post(self) -> int:
         srv = self.server
@@ -310,8 +440,18 @@ class _Handler(BaseHTTPRequestHandler):
             except (ValueError, TypeError) as e:
                 parsed.append((p, f"invalid position ({e})"))
         states = [s for _, s in parsed if isinstance(s, int)]
+        # Resident-book short path: positions the opening book answers
+        # never reach the batcher (no coalescing wait, no canonicalize,
+        # no block decode); only the remainder is submitted.
+        with activate((self._qtrace,)):
+            book = srv.book_lookup(route, states)
+        if book is not None:
+            pending = [s for i, s in enumerate(states) if not book[2][i]]
+        else:
+            pending = states
         try:
-            answers = iter(route.batcher.submit(states, trace=self._qtrace))
+            answers = iter(route.batcher.submit(pending,
+                                                trace=self._qtrace))
         except BatcherUnavailable as e:
             # Genuinely transient (shutdown, deadline, shed, breaker):
             # 503 + Retry-After so a well-behaved client backs off
@@ -327,12 +467,22 @@ class _Handler(BaseHTTPRequestHandler):
             # submit (a truncated shard, an unreadable mmap): answer 500
             # rather than dropping the connection mid-response.
             return self._send_json(500, {"error": f"lookup failed: {e}"})
+        sentinel = int(reader.game.sentinel)
         results = []
+        j = 0  # index into states (and the book arrays)
         for echo, s in parsed:
             if not isinstance(s, int):
                 results.append({"position": echo, "error": s})
                 continue
-            value, rem, found, best = next(answers)
+            if book is not None and book[2][j]:
+                value, rem, found = (
+                    int(book[0][j]), int(book[1][j]), True,
+                )
+                best = int(book[3][j])
+                best = None if best == sentinel else best
+            else:
+                value, rem, found, best = next(answers)
+            j += 1
             rec = {"position": hex(s), "found": found}
             if found:
                 rec["value"] = value_name(value)
@@ -420,6 +570,45 @@ class _QueryHTTPServer(ThreadingHTTPServer):
         #: knobs from GAMESMAN_TRACE_* / GAMESMAN_SLO_* env.
         self.trace_ring = TraceRing(registry=self.registry)
         self.slo = SloEngine(registry=self.registry)
+        #: max-age of the GET /query edge-cache contract; the ETag's
+        #: epoch prefix is what actually bounds staleness across a
+        #: reload (docs/SERVING.md "Hot path").
+        self.query_max_age = env_int("GAMESMAN_QUERY_MAX_AGE_SECS", 3600)
+        #: route name -> gamesman_book_hits_total counter. Registry
+        #: lookups validate the metric name per call; the book path is
+        #: hot enough that we resolve each route's counter once.
+        self._book_counters = {}
+
+    def _book_counter(self, route):
+        counter = self._book_counters.get(route.name)
+        if counter is None:
+            counter = self.registry.counter(
+                "gamesman_book_hits_total",
+                "queries answered from the resident opening book "
+                "(no batcher, no canonicalize, no block decode)",
+                route=route.name or "default",
+            )
+            self._book_counters[route.name] = counter
+        return counter
+
+    def book_lookup(self, route, states):
+        """Probe a route's resident opening book (db/book.py) -> the
+        (values, remoteness, found, best) arrays, or None when the
+        route serves no book. Counted per route; the ``book`` span
+        lands on whatever trace the caller has activated."""
+        book = getattr(route.reader, "book", None)
+        if book is None or not states:
+            return None
+        with qspan("book", queries=len(states)) as sp:
+            out = book.lookup(np.asarray(
+                states, dtype=route.reader.game.state_dtype
+            ))
+            hits = int(out[2].sum())
+            if sp is not None:
+                sp["hits"] = hits
+        if hits:
+            self._book_counter(route).inc(hits)
+        return out
 
     # Single-DB back-compat aliases: most callers (tests, the batcher's
     # half-open probe wiring) speak "the reader"/"the batcher".

@@ -46,6 +46,8 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+import numpy as np
+
 from gamesmanmpi_tpu.obs import default_registry
 from gamesmanmpi_tpu.resilience import faults
 from gamesmanmpi_tpu.serve.manifest import FleetEntry, load_fleet_manifest
@@ -209,6 +211,16 @@ class ServeSupervisor:
         # The parent never probes them (a probe would initialize a jax
         # backend and forbid fork).
         self.readers = self._open_readers(self.entries)
+        # Cross-worker decoded-block cache (store/shm.py, ISSUE 18):
+        # the supervisor owns segment lifecycle — created here, name
+        # handed to every worker cfg, swapped on a manifest reload
+        # (stale epochs already read as misses; the swap just drops the
+        # dead weight), unlinked at shutdown. None when disabled
+        # (GAMESMAN_SHM_CACHE_MB=0) or no fleet DB has blocked levels
+        # (v1 DBs mmap — there is nothing decoded to share).
+        self._shm_seq = 0
+        self._shm_backup = None  # pre-roll segment; guarded-by: _lock
+        self._shm = self._create_shm()
         self._spawn = spawn or self._default_spawn
         self._spawn_mode = "fork" if self._use_fork() else "exec"
         self._sel = selectors.DefaultSelector()
@@ -269,6 +281,56 @@ class ServeSupervisor:
             raise
         return readers
 
+    def _create_shm(self):
+        """Create the fleet's shared decoded-block segment, sized from
+        the manifests: one slot holds the largest decoded (keys, cells)
+        block pair any routed DB can produce, and the
+        ``GAMESMAN_SHM_CACHE_MB`` budget caps the whole segment. A
+        creation failure (exhausted /dev/shm, tiny budget) degrades to
+        per-worker private caches — never a refusal to serve."""
+        budget_mb = env_int("GAMESMAN_SHM_CACHE_MB", 256)
+        if budget_mb <= 0:
+            return None
+        from gamesmanmpi_tpu.db.format import level_is_blocked
+
+        slot_bytes = 0
+        for reader in self.readers.values():
+            for rec in reader.manifest["levels"].values():
+                if not level_is_blocked(rec):
+                    continue
+                nbytes = sum(
+                    int(idx["block_positions"])
+                    * np.dtype(idx["dtype"]).itemsize
+                    for idx in (rec["keys_blocks"], rec["cells_blocks"])
+                )
+                slot_bytes = max(slot_bytes, nbytes)
+        if slot_bytes == 0:
+            return None
+        from gamesmanmpi_tpu.store import ShmBlockCache
+
+        self._shm_seq += 1
+        name = f"gmshm-{os.getpid()}-{self._shm_seq}"
+        try:
+            shm = ShmBlockCache.create(
+                name, slot_bytes=slot_bytes,
+                budget_bytes=budget_mb << 20, registry=self.registry,
+            )
+        except (ValueError, OSError) as e:
+            self._log({"phase": "serve_shm_disabled",
+                       "error": f"{type(e).__name__}: {e}"[:300]})
+            return None
+        self._log({"phase": "serve_shm_created", "segment": name,
+                   "nslots": shm.nslots, "slot_bytes": shm.slot_bytes})
+        return shm
+
+    @staticmethod
+    def _unlink_shm(shm) -> None:
+        if shm is not None:
+            try:
+                shm.unlink()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+
     @staticmethod
     def _use_fork() -> bool:
         """Fork only while this process has never initialized a jax
@@ -297,6 +359,8 @@ class ServeSupervisor:
         }
         if self.jsonl:
             cfg["jsonl"] = _worker_path(self.jsonl, slot.idx)
+        if self._shm is not None:
+            cfg["shm_segment"] = self._shm.name
         return cfg
 
     def _default_spawn(self, slot_idx: int, cfg: dict):
@@ -818,6 +882,14 @@ class ServeSupervisor:
                 readers = self._open_readers(entries)
                 self.entries = entries
                 self.readers = readers
+                # New fleet config -> new shared segment (sized for the
+                # new DBs); the old one keeps serving the old-gen
+                # workers until the roll finishes ("done" unlinks it).
+                # Correctness never depends on this swap — a reloaded
+                # DB's epoch turns every old slot into a miss.
+                with self._lock:
+                    self._shm_backup, self._shm = self._shm, None
+                self._shm = self._create_shm()
         except Exception as e:  # noqa: BLE001 - a failed reload must not
             # take the fleet down: report it and keep serving as-is.
             with self._lock:
@@ -868,6 +940,11 @@ class ServeSupervisor:
             with self._lock:
                 self._rolling_back = False
                 backup, self._roll_backup = self._roll_backup, None
+                shm_old, self._shm_backup = self._shm_backup, None
+            if shm_old is not None and shm_old is not self._shm:
+                # Every worker is on the new generation now — nothing
+                # can still be attached to the pre-roll segment.
+                self._unlink_shm(shm_old)
             if backup is not None and backup[1] is not self.readers:
                 # A manifest roll replaced the fleet config: the
                 # pre-roll readers are dead weight now — close them
@@ -911,6 +988,15 @@ class ServeSupervisor:
                     if self._roll_backup[1] is not self.readers:
                         dropped = self.readers  # the failed new config's
                     self.entries, self.readers = self._roll_backup
+                if self._shm_backup is not None:
+                    # Rolling back to the old config: the old segment
+                    # (still warm with the old epoch's blocks) becomes
+                    # current again; the failed config's segment dies.
+                    dropped_shm = self._shm
+                    self._shm, self._shm_backup = self._shm_backup, None
+                    if dropped_shm is not None \
+                            and dropped_shm is not self._shm:
+                        self._unlink_shm(dropped_shm)
                 self._gen += 1
                 self._roll_queue = [s.idx for s in self._slots]
                 self._rolling_back = True
@@ -975,6 +1061,11 @@ class ServeSupervisor:
         _close_readers(self.readers)
         with self._lock:
             backup, self._roll_backup = self._roll_backup, None
+            shm, self._shm = self._shm, None
+            shm_backup, self._shm_backup = self._shm_backup, None
+        self._unlink_shm(shm)
+        if shm_backup is not None and shm_backup is not shm:
+            self._unlink_shm(shm_backup)  # stop() arrived mid-roll
         if backup is not None and backup[1] is not self.readers:
             _close_readers(backup[1])  # stop() arrived mid-roll
         self._log({"phase": "serve_supervisor_stopped"})

@@ -81,8 +81,24 @@ def _build_server(cfg: dict, listen_sock, registry):
     from gamesmanmpi_tpu.db import DbReader
     from gamesmanmpi_tpu.serve.server import QueryServer
 
+    # The supervisor-owned cross-worker decoded-block segment: attach
+    # by name (works identically for fork and exec spawns — nothing fd
+    # shaped to inherit). Attach failure degrades to the private cache:
+    # a missing/raced segment must never refuse a warm start.
+    shm = None
+    if cfg.get("shm_segment"):
+        from gamesmanmpi_tpu.store import ShmBlockCache
+
+        try:
+            shm = ShmBlockCache.attach(cfg["shm_segment"],
+                                       registry=registry)
+        except (FileNotFoundError, ValueError, OSError) as e:
+            sys.stderr.write(
+                f"[worker {cfg['worker_id']}] shm attach failed "
+                f"({type(e).__name__}: {e}); using private cache only\n"
+            )
     readers = {
-        name: DbReader(db, registry=registry)
+        name: DbReader(db, registry=registry, shm=shm)
         for name, db in cfg["entries"]
     }
     return QueryServer(

@@ -18,6 +18,7 @@ from gamesmanmpi_tpu.store.blockstore import (  # noqa: F401
     file_key,
 )
 from gamesmanmpi_tpu.store.cache import TieredCache  # noqa: F401
+from gamesmanmpi_tpu.store.shm import ShmBlockCache  # noqa: F401
 from gamesmanmpi_tpu.store.sealed import (  # noqa: F401
     BLOCKS_META_MEMBER,
     BlockedNpzView,

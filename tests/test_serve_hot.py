@@ -1,0 +1,445 @@
+"""Serving hot path (ISSUE 18): shared block cache, book, edge GETs.
+
+Acceptance axes:
+
+* cross-worker shared block cache — a ``ShmBlockCache`` segment
+  hammered by forked writer/reader processes never returns a torn or
+  foreign payload (a stale slot is a MISS, never a wrong answer); a
+  late attacher (the killed-and-restarted worker) reads blocks its
+  siblings decoded without decoding them itself; an epoch bump (the
+  rolling-reload signature) invalidates every slot at once; memory is
+  bounded by construction (collisions evict, the segment never grows);
+* resident opening book — ``build_book`` seals a table whose every
+  answer byte-matches ``DbReader.lookup_best``; the sealed file is
+  tamper-evident (sha over content, deep re-probe via check_db);
+* edge-cacheable GETs — ``GET /query?p=`` carries the epoch-prefixed
+  ETag + Cache-Control contract, answers If-None-Match revalidation
+  with 304 and NO lookup work, and a rolling reload onto a different
+  DB flips the ETag so a stale cached body can never be confirmed.
+"""
+
+import json
+import multiprocessing
+import os
+import shutil
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gamesmanmpi_tpu.db import DbReader, export_result
+from gamesmanmpi_tpu.db.book import OpeningBook, build_book, verify_book
+from gamesmanmpi_tpu.db.format import DbFormatError, read_manifest
+from gamesmanmpi_tpu.games import get_game
+from gamesmanmpi_tpu.serve import QueryServer
+from gamesmanmpi_tpu.solve import Solver
+from gamesmanmpi_tpu.store.shm import ShmBlockCache
+
+from helpers import REPO
+
+_CLI = [sys.executable, "-m", "gamesmanmpi_tpu.cli"]
+
+
+def _get_raw(url, headers=None, timeout=30):
+    req = urllib.request.Request(url, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def _wait_for(pred, timeout=60.0, interval=0.05, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = pred()
+        if out:
+            return out
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture(scope="module")
+def book_db(tmp_path_factory):
+    """Subtract DB with a sealed 3-ply opening book."""
+    spec = "subtract:total=10,moves=1-2"
+    d = tmp_path_factory.mktemp("hotdb") / "sub"
+    export_result(Solver(get_game(spec)).solve(), d, spec)
+    rec = build_book(d, 3)
+    return d, rec
+
+
+# ------------------------------------------------- shared block cache
+
+
+def _payload_for(key: tuple, salt: int = 0):
+    """Deterministic (keys, cells) pair derived from the block key —
+    the hammer's torn-read oracle: any hit must reproduce it exactly."""
+    dev, ino, block = key
+    base = (dev * 1000003 + ino * 101 + block * 7 + salt) % (1 << 31)
+    keys = (np.arange(16, dtype=np.uint64) + np.uint64(base))
+    cells = (np.arange(16, dtype=np.uint32) * np.uint32(3)
+             + np.uint32(base % 97))
+    return keys, cells
+
+
+def test_shm_roundtrip_epoch_and_eviction():
+    cache = ShmBlockCache.create(
+        f"gmtest-{os.getpid()}-rt", slot_bytes=4096, budget_bytes=1 << 20,
+    )
+    try:
+        key = (5, 42, 7)
+        keys, cells = _payload_for(key)
+        assert cache.get(key, "epochA") is None  # cold
+        assert cache.put(key, "epochA", keys, cells) is True
+        hit = cache.get(key, "epochA")
+        assert hit is not None
+        np.testing.assert_array_equal(hit[0], keys)
+        np.testing.assert_array_equal(hit[1], cells)
+        # Same block re-published under the same epoch: a no-op (a
+        # sibling already paid the decode).
+        assert cache.put(key, "epochA", keys, cells) is False
+        # Epoch mismatch — the rolling-reload signature — is a miss,
+        # and the slot is recyclable under the new epoch.
+        assert cache.get(key, "epochB") is None
+        assert cache.put(key, "epochB", keys, cells) is True
+        assert cache.get(key, "epochA") is None
+        # Oversized payloads are refused, not truncated.
+        big = np.zeros(4096, dtype=np.uint64)
+        assert cache.put((1, 1, 1), "epochA", big, big) is False
+        st = cache.stats()
+        assert st["stores"] == 2 and st["hits"] == 1
+        assert st["evictions"] >= 1  # the epochB overwrite
+    finally:
+        cache.unlink()
+
+
+def test_shm_attach_rejects_foreign_segment():
+    from multiprocessing import shared_memory
+
+    shm = shared_memory.SharedMemory(
+        name=f"gmtest-{os.getpid()}-junk", create=True, size=8192,
+    )
+    try:
+        shm.buf[:8] = b"NOTGMSHM"
+        with pytest.raises(ValueError):
+            ShmBlockCache(shm, owner=True)
+    finally:
+        shm.close()
+        shm.unlink()
+
+
+def test_shm_budget_too_small_raises():
+    with pytest.raises(ValueError):
+        ShmBlockCache.create("gmtest-tiny", slot_bytes=1 << 20,
+                             budget_bytes=4096)
+
+
+def _hammer_child(name: str, epoch: str, nkeys: int, rounds: int,
+                  seed: int, q) -> None:
+    """get/put storm over a shared key set; any hit must byte-match the
+    deterministic payload (a torn or foreign read is a test failure)."""
+    try:
+        cache = ShmBlockCache.attach(name)
+        rng = np.random.default_rng(seed)
+        hits = 0
+        for _ in range(rounds):
+            k = int(rng.integers(nkeys))
+            key = (1, 2, k)
+            keys, cells = _payload_for(key)
+            got = cache.get(key, epoch)
+            if got is not None:
+                hits += 1
+                np.testing.assert_array_equal(got[0], keys)
+                np.testing.assert_array_equal(got[1], cells)
+            else:
+                cache.put(key, epoch, keys, cells)
+        cache.close()
+        q.put(("ok", hits))
+    except BaseException as e:  # noqa: BLE001 - shipped to the parent
+        q.put(("fail", f"{type(e).__name__}: {e}"))
+
+
+def test_shm_multiprocess_hammer_and_restart_reattach():
+    """Forked workers hammer one segment: no torn/foreign payload ever
+    surfaces; a worker attaching AFTER the storm (the restart path)
+    reads sibling-decoded blocks without decoding; an epoch bump then
+    invalidates everything; memory stays bounded (nslots < nkeys forces
+    evictions rather than growth)."""
+    ctx = multiprocessing.get_context("fork")
+    nkeys, nprocs, rounds = 48, 4, 300
+    sup = ShmBlockCache.create(
+        f"gmtest-{os.getpid()}-hammer", slot_bytes=1024,
+        budget_bytes=4096 + 32 * (1024 + 128),  # ~32 slots < 48 keys
+    )
+    try:
+        q = ctx.Queue()
+        procs = [
+            ctx.Process(target=_hammer_child,
+                        args=(sup.name, "epochA", nkeys, rounds, i, q))
+            for i in range(nprocs)
+        ]
+        for p in procs:
+            p.start()
+        outs = [q.get(timeout=120) for _ in procs]
+        for p in procs:
+            p.join(timeout=60)
+        failures = [detail for status, detail in outs if status != "ok"]
+        assert not failures, failures
+        assert sum(hits for _, hits in outs) > 0, "storm never hit"
+
+        # Restart path: a FRESH attacher (new pid) inherits the warm
+        # set — sibling-decoded blocks are hits, not re-decodes.
+        q2 = ctx.Queue()
+        late = ctx.Process(target=_hammer_child,
+                           args=(sup.name, "epochA", nkeys, rounds, 99, q2))
+        late.start()
+        status, hits = q2.get(timeout=120)
+        late.join(timeout=60)
+        assert status == "ok", hits
+        assert hits > 0, "restarted worker re-decoded everything"
+
+        # Epoch bump (rolling reload): every surviving slot is stale
+        # at once — all misses, no wrong answers, no touch needed.
+        assert all(
+            sup.get((1, 2, k), "epochB") is None for k in range(nkeys)
+        )
+        st = sup.stats()
+        assert st["nslots"] < nkeys  # collisions were real
+    finally:
+        sup.unlink()
+
+
+# ------------------------------------------------------- opening book
+
+
+def test_book_build_lookup_parity(book_db):
+    db, rec = book_db
+    assert rec["plies"] == 3 and rec["count"] == len(
+        OpeningBook.load(db)
+    ) > 0
+    manifest = read_manifest(db)
+    assert manifest["book"]["sha256"] == rec["sha256"]
+    book = OpeningBook.load(db)
+    with DbReader(db) as reader:
+        # The reader attached the book itself (GAMESMAN_SERVE_BOOK
+        # defaults on) and its epoch covers the sealed manifest.
+        assert reader.book is not None
+        assert len(reader.book) == rec["count"]
+        probe = np.concatenate([
+            book.positions,
+            np.asarray([10 ** 6 + 7], dtype=book.positions.dtype),
+        ])
+        bv, br, bf, bb = book.lookup(probe)
+        rv, rr, rf, rb = reader.lookup_best(probe)
+        assert bool(bf[-1]) is False  # alien position: a miss
+        np.testing.assert_array_equal(bf[:-1],
+                                      np.ones(len(book), dtype=bool))
+        np.testing.assert_array_equal(bv[bf], rv[bf])
+        np.testing.assert_array_equal(br[bf], rr[bf])
+        np.testing.assert_array_equal(bb[bf], rb[bf])
+    assert verify_book(db) == []
+
+
+def test_book_env_gate(book_db, monkeypatch):
+    db, _ = book_db
+    monkeypatch.setenv("GAMESMAN_SERVE_BOOK", "0")
+    with DbReader(db) as reader:
+        assert reader.book is None
+
+
+def test_book_tamper_is_caught(book_db, tmp_path):
+    """A flipped byte in the sealed book fails the load-time sha; if an
+    attacker ALSO reseals the manifest, the deep re-probe (check_db's
+    book gate) still catches the wrong answer."""
+    db, _ = book_db
+    rotted = tmp_path / "rot"
+    shutil.copytree(db, rotted)
+    path = rotted / "book.gmb"
+    blob = bytearray(path.read_bytes())
+    blob[-1] ^= 0x01
+    path.write_bytes(bytes(blob))
+    with pytest.raises(DbFormatError):
+        OpeningBook.load(rotted)
+    # Reseal: structural checks now pass, the deep probe must not.
+    import hashlib
+
+    manifest = read_manifest(rotted)
+    manifest["book"]["sha256"] = hashlib.sha256(
+        path.read_bytes()
+    ).hexdigest()
+    from gamesmanmpi_tpu.db.format import write_manifest
+
+    write_manifest(rotted, manifest)
+    assert OpeningBook.load(rotted) is not None  # seal matches again
+    problems = verify_book(rotted)
+    assert problems and "book" in problems[0]
+
+
+# ------------------------------------------- edge GETs (ETag contract)
+
+
+def test_get_query_etag_304_and_book_counter(book_db):
+    db, _ = book_db
+    with DbReader(db) as reader:
+        epoch16 = reader.epoch[:16]
+        with QueryServer(reader) as server:
+            base = f"http://127.0.0.1:{server.port}"
+            # Full answer with the edge-cache contract.
+            code, headers, body = _get_raw(base + "/query?p=10")
+            assert code == 200
+            etag = headers["ETag"]
+            assert etag == f'"{epoch16}-a"'  # 10 == 0xa
+            assert "public" in headers["Cache-Control"]
+            assert "max-age=" in headers["Cache-Control"]
+            rec = json.loads(body)["results"][0]
+            assert rec["found"] is True
+            # Hex and decimal spellings of one position share the ETag.
+            code2, headers2, _ = _get_raw(base + "/query?p=0xa")
+            assert code2 == 200 and headers2["ETag"] == etag
+            # Revalidation: 304, empty body, contract headers intact.
+            code, headers, body = _get_raw(
+                base + "/query?p=10", headers={"If-None-Match": etag},
+            )
+            assert (code, body) == (304, b"")
+            assert headers["ETag"] == etag
+            code, _, _ = _get_raw(
+                base + "/query?p=10", headers={"If-None-Match": "*"},
+            )
+            assert code == 304
+            # A different position is a different resource.
+            code, headers, _ = _get_raw(
+                base + "/query?p=9", headers={"If-None-Match": etag},
+            )
+            assert code == 200 and headers["ETag"] != etag
+            # Malformed/missing p: client errors, never a 500.
+            assert _get_raw(base + "/query?p=zzz")[0] == 400
+            assert _get_raw(base + "/query")[0] == 400
+            assert _get_raw(base + "/query/nope?p=1")[0] == 404
+            # The book answered at least one of those GETs from RAM.
+            code, _, text = _get_raw(base + "/metrics")
+            assert code == 200
+            line = next(
+                ln for ln in text.decode().splitlines()
+                if ln.startswith("gamesman_book_hits_total")
+            )
+            assert float(line.rsplit(" ", 1)[1]) > 0
+
+
+def test_batcher_inflight_dedup_counter(book_db):
+    db, _ = book_db
+    os.environ.pop("GAMESMAN_FAULTS", None)
+    with DbReader(db) as reader:
+        with QueryServer(reader) as server:
+            base = f"http://127.0.0.1:{server.port}"
+            # 8 copies of a fresh NON-book position in one request: one
+            # flush, one probed row, 7 coalesced away. (A book position
+            # would never reach the batcher; a cached one never flushes.
+            # 3 is 4 plies from the initial 10 — past the 3-ply book.)
+            req = urllib.request.Request(
+                base + "/query",
+                data=json.dumps({"positions": [3] * 8}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                body = json.loads(resp.read())
+            assert all(r["found"] for r in body["results"])
+            assert len({json.dumps(r) for r in body["results"]}) == 1
+            counters = server.batcher.counters
+            assert counters["dup_hits"] >= 7
+
+
+# ------------------------------------- rolling reload flips the epoch
+
+
+def test_fleet_reload_flips_etag_and_book(book_db, tmp_path):
+    """E2E freshness gate: a fork-mode fleet serves epoch-stamped GETs;
+    a rolling reload onto a DIFFERENT DB (different rules => different
+    answers) flips the ETag, so a cache holding the old body gets a
+    full 200 + new ETag instead of a confirming 304 — the stale book
+    and blocks can never be served across the reload."""
+    db1, _ = book_db
+    spec2 = "subtract:total=10,moves=1-3"
+    db2 = tmp_path / "sub2"
+    export_result(Solver(get_game(spec2)).solve(), db2, spec2)
+    build_book(db2, 2)
+    with DbReader(db2) as r2:
+        want = r2.lookup_best(
+            np.asarray([10], dtype=r2.game.state_dtype)
+        )
+        want_rem = int(want[1][0])
+
+    manifest = tmp_path / "fleet.json"
+    manifest.write_text(json.dumps({
+        "version": 1, "games": [{"name": "sub", "db": str(db1)}],
+    }))
+    env = dict(os.environ)
+    env["GAMESMAN_PLATFORM"] = "cpu"
+    env["GAMESMAN_SERVE_RESTART_BASE_SECS"] = "0.1"
+    env.pop("GAMESMAN_FAULTS", None)
+    proc = subprocess.Popen(
+        _CLI + ["serve", "--fleet-manifest", str(manifest), "--port", "0",
+                "--workers", "2", "--control-port", "0"],
+        stdout=subprocess.PIPE, text=True, env=env, cwd=str(REPO),
+    )
+    try:
+        banner = proc.stdout.readline()
+        assert "serving fleet" in banner, banner
+        port = int(banner.split("http://127.0.0.1:")[1].split(" ")[0])
+        cport = int(banner.split("http://127.0.0.1:")[2].split(" ")[0])
+        base, control = (f"http://127.0.0.1:{port}",
+                         f"http://127.0.0.1:{cport}")
+        _wait_for(
+            lambda: _get_raw(control + "/healthz")[0] == 200
+            and json.loads(_get_raw(control + "/healthz")[2])
+            ["status"] == "ok",
+            timeout=120, what="fleet ready",
+        )
+        code, headers, body = _get_raw(base + "/query/sub?p=10")
+        assert code == 200
+        etag1 = headers["ETag"]
+        rem1 = json.loads(body)["results"][0]["remoteness"]
+        # Both workers answer 304 for the current epoch (the shared
+        # accept queue spreads these across the fleet).
+        for _ in range(8):
+            code, _, _ = _get_raw(
+                base + "/query/sub?p=10",
+                headers={"If-None-Match": etag1},
+            )
+            assert code == 304
+
+        manifest.write_text(json.dumps({
+            "version": 1, "games": [{"name": "sub", "db": str(db2)}],
+        }))
+        urllib.request.urlopen(urllib.request.Request(
+            control + "/reload", method="POST", data=b""), timeout=10)
+        _wait_for(
+            lambda: json.loads(_get_raw(control + "/healthz")[2])
+            .get("reloads_done", 0) >= 1
+            and json.loads(_get_raw(control + "/healthz")[2])
+            ["status"] == "ok",
+            timeout=120, what="rolling reload done",
+        )
+        # The old ETag is NEVER confirmed post-reload: full 200, new
+        # ETag, and the answer is the NEW rules' answer on every worker.
+        for _ in range(8):
+            code, headers, body = _get_raw(
+                base + "/query/sub?p=10",
+                headers={"If-None-Match": etag1},
+            )
+            assert code == 200
+            assert headers["ETag"] != etag1
+            rec = json.loads(body)["results"][0]
+            assert rec["remoteness"] == want_rem
+        assert rem1 != want_rem  # the rules change was observable
+        proc.send_signal(__import__("signal").SIGTERM)
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()

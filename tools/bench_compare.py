@@ -134,6 +134,8 @@ def compare(new: dict, trajectory, min_ratio: float) -> tuple:
     ))
     ab_ok, ab_lines = check_trace_ab(new)
     lines.extend(ab_lines)
+    hot_ok, hot_lines = check_serve_hot(new)
+    lines.extend(hot_lines)
     if ratio < min_ratio:
         lines.append(
             f"REGRESSION: new value is {ratio:.2f}x the trajectory best "
@@ -141,7 +143,7 @@ def compare(new: dict, trajectory, min_ratio: float) -> tuple:
             "committing this record"
         )
         return False, lines
-    if not ab_ok:
+    if not ab_ok or not hot_ok:
         return False, lines
     lines.append("ok")
     return True, lines
@@ -178,6 +180,51 @@ def check_trace_ab(new: dict) -> tuple:
             "TRACING OVERHEAD REGRESSION: tail-sampled query tracing "
             "costs more than the recorded p99 budget — investigate "
             "before committing this record",
+        ]
+    return True, [line]
+
+
+def check_serve_hot(new: dict) -> tuple:
+    """-> (ok, lines): the serving hot-path A/B gate (ISSUE 18).
+
+    A record carrying a serve hot-path arm (bench.py's ``serve_hot``
+    summary, or a BENCH_serve_hot artifact's top-level ``serve_hot``)
+    must show the hot arm (opening book + cross-worker shared block
+    cache + batcher dedup) beating the cold baseline on BOTH qps and
+    p99 under the same zipf stream, with zero errors/mismatches on
+    either arm, book AND shm hit counters above zero, and the
+    conditional-GET pass revalidating clean — ``ok`` is computed by
+    bench.py at measurement time; this gate makes CI refuse a record
+    where the hot path stopped paying for itself (or stopped being
+    exercised at all). Records without the arm pass untouched.
+    """
+    hot = new.get("serve_hot")
+    if not isinstance(hot, dict):
+        return True, []
+    if "error" in hot:
+        return False, [
+            f"SERVE HOT A/B BROKEN: {hot['error']} — the hot-path arm "
+            "never measured; rerun before committing this record"
+        ]
+    base_arm = hot.get("baseline") or {}
+    hot_arm = hot.get("hot") or {}
+    line = (
+        f"serve_hot: hot {hot_arm.get('qps')} qps / "
+        f"{hot_arm.get('p99_ms')} ms p99 vs baseline "
+        f"{base_arm.get('qps')} qps / {base_arm.get('p99_ms')} ms p99, "
+        f"book_hits={hot.get('book_hits')} shm_hits={hot.get('shm_hits')}"
+        " -> " + ("ok" if hot.get("ok") else "FAILED")
+    )
+    if not hot.get("ok"):
+        detail = ", ".join(
+            g for g in ("clean", "perf_ok", "hits_ok", "get_ok")
+            if not hot.get(g, True)
+        ) or "gate flags missing"
+        return False, [
+            line,
+            f"SERVE HOT PATH REGRESSION ({detail}): the book/shm/dedup "
+            "stack no longer beats the cold baseline (or went "
+            "unexercised) — investigate before committing this record",
         ]
     return True, [line]
 

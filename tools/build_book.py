@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Build (or rebuild) the resident opening book of a finalized DB.
+
+    python tools/build_book.py DB_DIR --plies N [--verify]
+
+Enumerates every raw position within N plies of the game's initial
+position (BFS through the reader's expand kernel), scores each through
+``DbReader.lookup_best`` against the finished DB, and seals the table
+as ``book.gmb`` recorded in the manifest (file + sha256) — see
+gamesmanmpi_tpu/db/book.py and docs/SERVING.md "Hot path". The serving
+fleet answers book hits entirely from resident arrays: no batcher
+wait, no canonicalize, no block decode.
+
+Sealing rewrites the manifest atomically, which bumps the DB epoch:
+run this BEFORE pointing a fleet at the directory (or follow with
+``POST /reload`` — the rolling reload swaps reader + book together and
+every epoch-derived ETag flips with it). ``gamesman-db export-db
+--book-plies N`` does the same build at export time; this tool exists
+to add or resize a book on an already-exported DB without re-solving.
+
+--verify re-probes EVERY sealed entry through the reader afterwards
+(db/book.py verify_book, the same deep gate tools/check_db.py runs):
+exit 1 on any mismatch. Exit 0 = sealed (and verified when asked),
+1 = verification problems, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # tools/ scripts get sys.path[0]=tools/
+    sys.path.insert(0, _REPO)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("db_dir", help="finalized DB directory (from export-db)")
+    p.add_argument("--plies", type=int, default=None, metavar="N",
+                   help="book depth: every position within N plies of "
+                   "the initial position. Default from GAMESMAN_BOOK_PLIES")
+    p.add_argument("--verify", action="store_true",
+                   help="after sealing, re-probe every book entry "
+                   "through the reader and exit 1 on any mismatch")
+    args = p.parse_args(argv)
+
+    from gamesmanmpi_tpu.db.book import build_book, verify_book
+    from gamesmanmpi_tpu.db.format import DbFormatError
+    from gamesmanmpi_tpu.utils.env import env_int
+
+    plies = (
+        env_int("GAMESMAN_BOOK_PLIES", 0)
+        if args.plies is None else int(args.plies)
+    )
+    if plies <= 0:
+        print("error: --plies N (or GAMESMAN_BOOK_PLIES) must be > 0",
+              file=sys.stderr)
+        return 2
+    try:
+        rec = build_book(args.db_dir, plies)
+    except (DbFormatError, FileNotFoundError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(
+        f"book sealed: {rec['count']} entries to {rec['plies']} plies "
+        f"({rec['file']}, sha256 {rec['sha256'][:12]}…)"
+    )
+    if args.verify:
+        problems = verify_book(args.db_dir)
+        for problem in problems:
+            print(f"PROBLEM: {problem}", file=sys.stderr)
+        if problems:
+            print(f"{args.db_dir}: {len(problems)} problem(s)",
+                  file=sys.stderr)
+            return 1
+        print("book verified: every entry matches the reader")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

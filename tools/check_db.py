@@ -21,10 +21,20 @@ to another directory regardless of storage version — the migration gate
 for a compressed re-export. --stats-json dumps the db_stats record for
 machine consumers (bench.py's BENCH_DB_COMPRESS gate).
 
+When the manifest records an opening book (book.gmb), the structural
+pass checks its seal/parse/sortedness — and then EVERY entry is
+re-probed through a real DbReader (db/book.py verify_book): a book
+answer that disagrees with the slow path it shadows is a wrong answer
+waiting to be served, and exits 1 like any other problem.
+--skip-book-probe keeps the run kernel-free (the structural seal check
+still runs).
+
 Exit 0 = clean, 1 = problems (printed one per line; any block-index or
 cell-count mismatch is a problem), 2 = usage error. Pure numpy file
 reads — no game construction, no kernels, no backend init — so it runs
-in seconds even where accelerator bring-up is expensive or wedged.
+in seconds even where accelerator bring-up is expensive or wedged; the
+one exception is the opening-book deep probe above, which builds the
+game's query kernels because proving answers requires answering.
 """
 
 from __future__ import annotations
@@ -74,10 +84,14 @@ def main(argv=None) -> int:
                    help="additionally require logical equality with "
                    "another DB directory (storage-version-agnostic; "
                    "the v1-vs-compressed migration gate)")
+    p.add_argument("--skip-book-probe", action="store_true",
+                   help="skip the opening-book deep re-probe (the only "
+                   "check that builds game kernels); the structural "
+                   "seal/parse check still runs")
     args = p.parse_args(argv)
 
     from gamesmanmpi_tpu.db.check import check_db, db_equal, db_stats
-    from gamesmanmpi_tpu.db.format import DbFormatError
+    from gamesmanmpi_tpu.db.format import DbFormatError, read_manifest
 
     problems = check_db(
         args.db_dir, verbose=None if args.quiet else print
@@ -87,6 +101,20 @@ def main(argv=None) -> int:
             f"differs from {args.same_as}: {d}"
             for d in db_equal(args.db_dir, args.same_as)
         ]
+    if not problems and not args.skip_book_probe:
+        try:
+            has_book = bool(read_manifest(args.db_dir).get("book"))
+        except DbFormatError:
+            has_book = False
+        if has_book:
+            # Deep half of the book gate: every sealed entry re-probed
+            # through a real reader — a mismatch is a wrong answer the
+            # hot path WOULD have served, so it fails the check outright.
+            from gamesmanmpi_tpu.db.book import verify_book
+            problems += verify_book(args.db_dir)
+            if not args.quiet and not problems:
+                print("book: deep re-probe OK (every entry matches the "
+                      "reader)")
     for problem in problems:
         print(f"PROBLEM: {problem}", file=sys.stderr)
     if problems:

@@ -15,6 +15,10 @@ requests beyond the in-flight shed budget and p99 within the SLO.
 Classification per request:
 
 * ``ok``       — HTTP 200 with every queried position found;
+* ``not_modified`` — HTTP 304 from a conditional GET (``--get``): the
+  client's cached copy revalidated against the server's ETag — cheaper
+  than ok for both sides, and its OWN class so a cache-friendly
+  workload is visible in the record rather than inflating ok;
 * ``shed``     — HTTP 503 (deadline / load shed / breaker / draining):
   the server DEGRADED POLITELY; a well-behaved client retries;
 * ``errors``   — any other HTTP status, or a 200 carrying per-position
@@ -23,6 +27,13 @@ Classification per request:
 * ``dropped``  — connection-level failure (refused, reset mid-flight):
   the only class a crashing worker is allowed to inflict, bounded by
   its in-flight requests at death.
+
+``--dist zipf:<s>`` resamples the position file rank-weighted
+(probability of rank i ∝ 1/i^s) so a small head of hot positions
+dominates — the shape real game traffic has, and the one that exercises
+the serving hot path (opening book, shared block cache, batcher dedup).
+``--get`` switches to single-position conditional GETs with a client-
+side ETag cache, measuring the edge-cacheable form of the same answers.
 
 Answers are accumulated per position (value/remoteness/best of the last
 successful response) and exposed for oracle comparison; ``mismatches``
@@ -42,6 +53,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
 import sys
 import threading
 import time
@@ -62,6 +74,30 @@ def _mint_traceparent() -> tuple:
     return tid, f"00-{tid}-{os.urandom(8).hex()}-01"
 
 
+def zipf_sample(positions: list, s: float, *, n: int | None = None,
+                seed: int = 0) -> list:
+    """Rank-weighted resample: the position at (0-based) rank i is drawn
+    with probability ∝ 1/(i+1)**s. Deterministic for a given seed, so
+    two bench arms replay the IDENTICAL hot-head request stream."""
+    if not positions:
+        return []
+    rng = random.Random(seed)
+    if n is None:
+        n = max(len(positions) * 4, 1024)
+    weights = [1.0 / (i + 1) ** s for i in range(len(positions))]
+    return rng.choices(positions, weights=weights, k=n)
+
+
+def apply_dist(positions: list, dist: str | None, *, seed: int = 0) -> list:
+    """``uniform`` (or None) passes through; ``zipf:<s>`` resamples."""
+    if not dist or dist == "uniform":
+        return positions
+    if dist.startswith("zipf:"):
+        return zipf_sample(positions, float(dist.split(":", 1)[1]),
+                           seed=seed)
+    raise ValueError(f"unknown dist {dist!r} (uniform | zipf:<s>)")
+
+
 def percentile(sorted_vals: list, q: float) -> float:
     """Nearest-rank percentile of an ascending list (0 when empty)."""
     if not sorted_vals:
@@ -77,6 +113,7 @@ class _Stats:
         self.lock = threading.Lock()
         self.latencies = []  # guarded-by: lock
         self.ok = 0  # guarded-by: lock
+        self.not_modified = 0  # guarded-by: lock (conditional-GET 304s)
         self.shed = 0  # guarded-by: lock
         self.errors = 0  # guarded-by: lock
         self.dropped = 0  # guarded-by: lock
@@ -112,6 +149,49 @@ class _Stats:
                     if secs is not None else None,
                     "mismatch": mismatch,
                 })
+
+
+def _get_loop(url: str, chunks: list, stats: _Stats, stop: threading.Event,
+              timeout: float, offset: int, etags: dict) -> None:
+    """Conditional-GET driver: one position per request, client-side
+    ETag cache shared across threads (plain dict — CPython item
+    assignment is atomic, and a lost race just costs one extra 200)."""
+    i = offset
+    while not stop.is_set():
+        pos = chunks[i % len(chunks)][0]
+        i += 1
+        trace_id, traceparent = _mint_traceparent()
+        headers = {"Connection": "close", "traceparent": traceparent}
+        etag = etags.get(pos)
+        if etag:
+            headers["If-None-Match"] = etag
+        req = urllib.request.Request(
+            f"{url}/query?p={pos:#x}", headers=headers, method="GET",
+        )
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                new_etag = resp.headers.get("ETag")
+                payload = json.loads(resp.read())
+            secs = time.perf_counter() - t0
+            results = payload.get("results", [])
+            clean = (
+                len(results) == 1 and results[0].get("found")
+                and "error" not in results[0]
+            )
+            if new_etag:
+                etags[pos] = new_etag
+            stats.note("ok" if clean else "errors", 200, secs,
+                       results if clean else None, trace_id=trace_id)
+        except urllib.error.HTTPError as e:
+            secs = time.perf_counter() - t0
+            if e.code == 304:
+                stats.note("not_modified", 304, secs, trace_id=trace_id)
+            else:
+                stats.note("shed" if e.code == 503 else "errors", e.code,
+                           secs, trace_id=trace_id)
+        except Exception:  # noqa: BLE001 - URLError/socket/timeout: dropped
+            stats.note("dropped", "conn", None, trace_id=trace_id)
 
 
 def _worker_loop(url: str, chunks: list, stats: _Stats, stop: threading.Event,
@@ -151,7 +231,8 @@ def _worker_loop(url: str, chunks: list, stats: _Stats, stop: threading.Event,
 def run_load(url: str, positions: list, *, duration: float = 5.0,
              concurrency: int = 4, chunk_size: int = 8,
              timeout: float = 10.0, stop_event=None,
-             out_jsonl: str | None = None) -> dict:
+             out_jsonl: str | None = None, dist: str | None = None,
+             mode: str = "post", seed: int = 0) -> dict:
     """Drive load; returns the stats record (see module docstring).
 
     positions: ints (or hex strings) assumed PRESENT in the served DB —
@@ -167,18 +248,24 @@ def run_load(url: str, positions: list, *, duration: float = 5.0,
     url = url.rstrip("/")
     positions = [int(p, 0) if isinstance(p, str) else int(p)
                  for p in positions]
-    chunk_size = max(1, int(chunk_size))
+    positions = apply_dist(positions, dist, seed=seed)
+    chunk_size = 1 if mode == "get" else max(1, int(chunk_size))
     chunks = [
         positions[i:i + chunk_size]
         for i in range(0, len(positions), chunk_size)
     ] or [[0]]
     stats = _Stats(keep_records=out_jsonl is not None)
     stop = stop_event or threading.Event()
+    etags: dict = {}
+    if mode == "get":
+        target, extra = _get_loop, (etags,)
+    else:
+        target, extra = _worker_loop, ()
     threads = [
         threading.Thread(
-            target=_worker_loop,
+            target=target,
             args=(url, chunks, stats, stop, timeout,
-                  i * max(1, len(chunks) // max(1, concurrency))),
+                  i * max(1, len(chunks) // max(1, concurrency)), *extra),
             daemon=True,
         )
         for i in range(max(1, int(concurrency)))
@@ -197,15 +284,17 @@ def run_load(url: str, positions: list, *, duration: float = 5.0,
             "url": url,
             "duration_secs": round(elapsed, 3),
             "concurrency": int(concurrency),
-            "requests": stats.ok + stats.shed + stats.errors
-            + stats.dropped,
+            "requests": stats.ok + stats.not_modified + stats.shed
+            + stats.errors + stats.dropped,
             "ok": stats.ok,
+            "not_modified": stats.not_modified,
             "shed": stats.shed,
             "errors": stats.errors,
             "dropped": stats.dropped,
             "codes": dict(stats.codes),
             "mismatches": stats.mismatches,
-            "qps": round((stats.ok + stats.shed + stats.errors)
+            "qps": round((stats.ok + stats.not_modified + stats.shed
+                          + stats.errors)
                          / max(elapsed, 1e-9), 1),
             "p50_ms": round(percentile(lat, 0.50) * 1e3, 3),
             "p95_ms": round(percentile(lat, 0.95) * 1e3, 3),
@@ -247,6 +336,17 @@ def main(argv=None) -> int:
                    help="positions per request")
     p.add_argument("--timeout", type=float, default=10.0,
                    help="per-request client timeout, seconds")
+    p.add_argument("--dist", default="uniform", metavar="DIST",
+                   help='request distribution: "uniform" (default) or '
+                   '"zipf:<s>" — rank-weighted hot-head resample of the '
+                   "positions file (rank i drawn ∝ 1/i^s)")
+    p.add_argument("--get", action="store_true",
+                   help="drive conditional GET /query?p=... (one position "
+                   "per request, client-side ETag cache, 304s counted as "
+                   "not_modified) instead of POST batches")
+    p.add_argument("--seed", type=int, default=0,
+                   help="RNG seed for --dist resampling (two arms with "
+                   "the same seed replay the identical request stream)")
     p.add_argument("--slo-p99-ms", type=float, default=None,
                    help="gate: exit 1 when p99 latency exceeds this")
     p.add_argument("--max-dropped", type=int, default=None,
@@ -269,11 +369,17 @@ def main(argv=None) -> int:
     if not positions:
         print("error: no positions to query", file=sys.stderr)
         return 2
-    record = run_load(
-        args.url, positions, duration=args.duration,
-        concurrency=args.concurrency, chunk_size=args.chunk_size,
-        timeout=args.timeout, out_jsonl=args.out_jsonl,
-    )
+    try:
+        record = run_load(
+            args.url, positions, duration=args.duration,
+            concurrency=args.concurrency, chunk_size=args.chunk_size,
+            timeout=args.timeout, out_jsonl=args.out_jsonl,
+            dist=args.dist, mode="get" if args.get else "post",
+            seed=args.seed,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     gates_ok = True
     if args.slo_p99_ms is not None and record["p99_ms"] > args.slo_p99_ms:
         print(f"SLO VIOLATION: p99 {record['p99_ms']:.1f}ms > "
