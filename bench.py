@@ -806,6 +806,222 @@ def _serve_hot_bench() -> dict | None:
     return artifact
 
 
+def _registry_bench() -> dict | None:
+    """BENCH_REGISTRY=1: the DB-distribution robustness proof (ISSUE 19).
+
+    Solve-on-demand end to end, through a crash: a registry with an
+    empty catalog takes a POST /solve for a missing DB, the job runner
+    is SIGKILLed right after its claim record is durable
+    (`jobs.claim:kill:1` -> exit 77), a second runner reclaims the dead
+    claim and drives campaign -> export-db -> publish; a replica then
+    pulls the published epoch (checksums verified before the atomic
+    install), a fork-mode fleet serves it, a re-exported epoch B is
+    published and synced in under the fleet's rolling reload, and the
+    SAME query must answer identically from both epochs with the ETag
+    flipping exactly once. Gates: runner kill rc 77, job state
+    `running` after the kill and `done` after the resume, the epoch in
+    the sealed catalog, a verified install, `reloads_done == 1`, and
+    matching answers across the flip. Record lands in
+    BENCH_REGISTRY_OUT (BENCH_registry.json).
+
+    Runs in the PARENT (registry/pull/jobs are stdlib+numpy; the
+    solves happen in child processes) and must never kill the bench:
+    failures are recorded in the artifact, not raised.
+    """
+    if os.environ.get("BENCH_REGISTRY", "0") in ("0", "", "off"):
+        return None
+    import signal
+    import tempfile
+    import threading
+    import urllib.request
+
+    spec = os.environ.get("BENCH_REGISTRY_GAME", "subtract:total=10")
+    name = os.environ.get("BENCH_REGISTRY_NAME", "sub")
+    out_path = os.environ.get("BENCH_REGISTRY_OUT", "BENCH_registry.json")
+    deadline = _env_float("GAMESMAN_BENCH_DEADLINE", 3000.0)
+    artifact = {"game": spec, "name": name, "ok": False}
+    cli = [sys.executable, "-m", "gamesmanmpi_tpu.cli"]
+    env = dict(os.environ, GAMESMAN_PLATFORM="cpu")
+    env.pop("GAMESMAN_FAULTS", None)
+
+    def _get_json(url):
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    proc = None
+    srv = None
+    t0 = time.perf_counter()
+    try:
+        from gamesmanmpi_tpu.registry import (
+            JobQueue,
+            RegistryServer,
+            catalog_seal,
+            load_catalog,
+            publish_db,
+            pull_db,
+            sync_fleet,
+        )
+        from gamesmanmpi_tpu.registry.pull import ensure_db
+        from gamesmanmpi_tpu.resilience.faults import KILL_EXIT_CODE
+
+        with tempfile.TemporaryDirectory(prefix="bench_registry_") as td:
+            root = os.path.join(td, "registry")
+            queue = JobQueue(os.path.join(root, "jobs.jsonl"))
+            srv = RegistryServer(root, queue=queue)
+            srv.start()
+
+            # 1. Solve-on-demand: the DB does not exist -> queued job.
+            job = ensure_db(srv.url, name, spec)
+            artifact["enqueued"] = {
+                "status": job.get("status"), "job": job.get("id"),
+            }
+
+            # 2. Runner SIGKILLed right after its claim is durable.
+            kill = subprocess.run(
+                cli + ["registry", "run-jobs", "--root", root, "--once"],
+                env=dict(env, GAMESMAN_FAULTS="jobs.claim:kill:1"),
+                timeout=deadline, capture_output=True, text=True,
+            )
+            after_kill = list(
+                JobQueue(os.path.join(root, "jobs.jsonl")).jobs().values()
+            )
+            artifact["runner_kill"] = {
+                "rc": kill.returncode,
+                "job_state": after_kill[0]["state"] if after_kill else None,
+            }
+
+            # 3. The next runner reclaims the dead claim and finishes.
+            resume = subprocess.run(
+                cli + ["registry", "run-jobs", "--root", root, "--once"],
+                env=env, timeout=deadline, capture_output=True, text=True,
+            )
+            after = list(
+                JobQueue(os.path.join(root, "jobs.jsonl")).jobs().values()
+            )
+            cat = load_catalog(root)
+            artifact["runner_resume"] = {
+                "rc": resume.returncode,
+                "job_state": after[0]["state"] if after else None,
+                "published": name in cat["dbs"],
+                "catalog_sealed": cat["seal"] == catalog_seal(cat["dbs"]),
+            }
+            if resume.returncode != 0:
+                artifact["error"] = "resume runner failed: " \
+                    + resume.stderr[-1000:]
+                return artifact
+
+            # 4. Replica pull + fleet serve on the pulled epoch.
+            dest = os.path.join(td, "dbs")
+            pulled = pull_db(srv.url, name, dest)
+            artifact["pull"] = {
+                k: pulled[k] for k in
+                ("epoch", "installed", "resumed_files", "refetched_files")
+            }
+            manifest = os.path.join(td, "fleet.json")
+            with open(manifest, "w") as fh:
+                json.dump({"version": 1, "games": [
+                    {"name": name, "db": pulled["db"]}]}, fh)
+            proc = subprocess.Popen(
+                cli + ["serve", "--fleet-manifest", manifest, "--port",
+                       "0", "--workers", "2", "--control-port", "0"],
+                stdout=subprocess.PIPE, text=True, env=env,
+            )
+            got: list = []
+            t = threading.Thread(
+                target=lambda: got.append(proc.stdout.readline()),
+                daemon=True,
+            )
+            t.start()
+            t.join(120.0)
+            if not got or not got[0]:
+                artifact["error"] = "fleet printed no banner"
+                return artifact
+            banner = got[0]
+            port = int(banner.split("http://127.0.0.1:")[1].split(" ")[0])
+            cport = int(banner.split("http://127.0.0.1:")[2].split(" ")[0])
+            base = f"http://127.0.0.1:{port}"
+            control = f"http://127.0.0.1:{cport}"
+            ready = time.monotonic() + 180.0
+            while time.monotonic() < ready:
+                try:
+                    if _get_json(control + "/healthz")["status"] == "ok":
+                        break
+                except (OSError, ValueError, KeyError):
+                    pass
+                time.sleep(0.25)
+
+            def _query():
+                with urllib.request.urlopen(
+                        f"{base}/query?p=0x2", timeout=10) as resp:
+                    return resp.headers.get("ETag"), json.loads(resp.read())
+
+            etag_a, answer_a = _query()
+            artifact["serve"] = {"etag_a": etag_a, "answer_a": answer_a}
+
+            # 5. Epoch B (same content, compressed) rolls in under sync.
+            db_b = os.path.join(td, "db_b")
+            export = subprocess.run(
+                cli + ["export-db", spec, "--out", db_b, "--compress"],
+                env=env, timeout=deadline, capture_output=True, text=True,
+            )
+            if export.returncode != 0:
+                artifact["error"] = "epoch B export failed: " \
+                    + export.stderr[-1000:]
+                return artifact
+            publish_db(root, name, db_b)
+            sync = sync_fleet(srv.url, [name], manifest, dest,
+                              control_url=control)
+            artifact["sync"] = {
+                "status": sync["status"], "rolled": sync["rolled"],
+                "failed": sync["failed"],
+            }
+            flip = time.monotonic() + 60.0
+            status = {}
+            while time.monotonic() < flip:
+                status = _get_json(control + "/healthz")
+                if status.get("reloads_done") == 1 \
+                        and status.get("status") == "ok":
+                    break
+                time.sleep(0.25)
+            etag_b, answer_b = _query()
+            artifact["serve"].update(etag_b=etag_b, answer_b=answer_b)
+            artifact["reloads_done"] = status.get("reloads_done")
+            artifact["registry_sync"] = status.get("registry_sync")
+            artifact["ok"] = bool(
+                artifact["runner_kill"]["rc"] == KILL_EXIT_CODE
+                and artifact["runner_kill"]["job_state"] == "running"
+                and artifact["runner_resume"]["job_state"] == "done"
+                and artifact["runner_resume"]["published"]
+                and artifact["runner_resume"]["catalog_sealed"]
+                and pulled["installed"]
+                and sync["status"] == "rolled"
+                and status.get("reloads_done") == 1
+                and etag_a and etag_b and etag_a != etag_b
+                and answer_a == answer_b
+            )
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=60)
+            proc = None
+    except Exception as e:  # noqa: BLE001 - the bench must survive this
+        artifact["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        if srv is not None:
+            srv.stop()
+        artifact["secs_wall"] = round(time.perf_counter() - t0, 3)
+        try:
+            with open(out_path, "w") as fh:
+                json.dump(artifact, fh, indent=1)
+            print(f"registry bench: wrote {out_path} "
+                  f"(ok={artifact['ok']})", file=sys.stderr)
+        except OSError as e:
+            print(f"registry bench: cannot write {out_path}: {e}",
+                  file=sys.stderr)
+    return artifact
+
+
 def _store_bench() -> dict | None:
     """BENCH_STORE=1: the block-store I/O-overlap A/B (ISSUE 11).
 
@@ -1896,6 +2112,19 @@ def main() -> int:
                 record["serve_hot"][arm] = {
                     k: shs[arm].get(k) for k in ("qps", "p99_ms")
                 }
+    rb = _registry_bench()
+    if rb is not None:
+        # Summary only — the full crash/resume/flip record lives in the
+        # artifact file (BENCH_REGISTRY_OUT).
+        record["registry"] = {
+            "ok": rb.get("ok"),
+            "runner_kill_rc": (rb.get("runner_kill") or {}).get("rc"),
+            "resume_state": (rb.get("runner_resume") or {})
+            .get("job_state"),
+            "reloads_done": rb.get("reloads_done"),
+        }
+        if "error" in rb:
+            record["registry"]["error"] = rb["error"]
     print(json.dumps(record))
     return 0
 

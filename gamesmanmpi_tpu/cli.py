@@ -356,7 +356,7 @@ def _dump_flightrec(reason: str) -> None:
 #: Serving subcommands dispatched ahead of the flat solve parser. A game
 #: spec can never collide: specs are lowercase single tokens already taken
 #: by the registry, and module paths contain a '.' or '/'.
-_DB_COMMANDS = ("export-db", "serve", "query")
+_DB_COMMANDS = ("export-db", "serve", "query", "registry")
 
 
 def main(argv=None) -> int:
@@ -1049,6 +1049,50 @@ def _db_parser() -> argparse.ArgumentParser:
     pq.add_argument("db", help="DB directory (from export-db)")
     pq.add_argument("positions", nargs="+",
                     help="packed positions, decimal or 0x-hex")
+
+    pr = sub.add_parser(
+        "registry",
+        help="DB registry: publish epochs, serve the catalog, run "
+        "solve-on-demand jobs (docs/SERVING.md)",
+    )
+    rsub = pr.add_subparsers(dest="registry_cmd", required=True)
+
+    rserve = rsub.add_parser(
+        "serve",
+        help="serve the sha256-sealed catalog + blob streams over HTTP",
+    )
+    rserve.add_argument("--root", required=True,
+                        help="registry root directory (catalog.json + dbs/)")
+    rserve.add_argument("--host", default="127.0.0.1")
+    rserve.add_argument("--port", type=int, default=0,
+                        help="0 = ephemeral (the bound port is printed)")
+    rserve.add_argument(
+        "--jobs", action="store_true",
+        help="accept POST /solve: unregistered-game queries become "
+        "durable solve-on-demand jobs in <root>/jobs.jsonl",
+    )
+
+    rpub = rsub.add_parser(
+        "publish",
+        help="copy a DB into the registry and seal a new catalog epoch",
+    )
+    rpub.add_argument("db", help="DB directory (from export-db)")
+    rpub.add_argument("--root", required=True, help="registry root directory")
+    rpub.add_argument("--name", default=None,
+                      help="catalog name (default: the DB's game name)")
+
+    rrun = rsub.add_parser(
+        "run-jobs",
+        help="claim queued solve-on-demand jobs and drive each through "
+        "campaign solve -> export-db -> publish",
+    )
+    rrun.add_argument("--root", required=True, help="registry root directory")
+    rrun.add_argument("--work-dir", default=None,
+                      help="checkpoint/export scratch (default <root>/work)")
+    rrun.add_argument("--book-plies", type=int, default=0, metavar="N",
+                      help="also build an N-ply opening book before publish")
+    rrun.add_argument("--once", action="store_true",
+                      help="run at most one job, then exit")
     return p
 
 
@@ -1424,6 +1468,61 @@ def _cmd_query(args) -> int:
     return 0
 
 
+def _cmd_registry(args) -> int:
+    import pathlib
+    import signal
+    import threading
+
+    from gamesmanmpi_tpu.db.format import DbFormatError, read_manifest
+    from gamesmanmpi_tpu.registry.jobs import JobQueue, run_pending
+    from gamesmanmpi_tpu.registry.server import RegistryServer, publish_db
+
+    root = pathlib.Path(args.root)
+    if args.registry_cmd == "publish":
+        try:
+            name = args.name or str(read_manifest(args.db)["game"])
+            record = publish_db(root, name, args.db)
+        except (DbFormatError, ValueError, OSError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        print(json.dumps({"name": name, "epoch": record["epoch"],
+                          "files": len(record["files"])}))
+        return 0
+
+    if args.registry_cmd == "run-jobs":
+        queue = JobQueue(root / "jobs.jsonl")
+        work = pathlib.Path(args.work_dir) if args.work_dir else root / "work"
+        results = run_pending(queue, root, work,
+                              book_plies=args.book_plies, once=args.once,
+                              log=_jsonl_stderr)
+        print(json.dumps({"ran": len(results), "results": results},
+                         default=str))
+        return 0 if all(r["ok"] for r in results) else 1
+
+    # registry serve
+    queue = JobQueue(root / "jobs.jsonl") if args.jobs else None
+    srv = RegistryServer(root, host=args.host, port=args.port, queue=queue)
+    print(
+        f"registry [{root}] on {srv.url} "
+        f"({'with' if queue else 'no'} solve-on-demand queue)",
+        flush=True,
+    )
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    srv.start()
+    try:
+        stop.wait()
+    finally:
+        srv.stop()
+    return 0
+
+
+def _jsonl_stderr(record):
+    sys.stderr.write(json.dumps(record, default=str) + "\n")
+    sys.stderr.flush()
+
+
 def _db_main(argv) -> int:
     from gamesmanmpi_tpu.utils.platform import apply_platform_env
 
@@ -1436,6 +1535,8 @@ def _db_main(argv) -> int:
         return _cmd_export_db(args)
     if args.cmd == "serve":
         return _cmd_serve(args)
+    if args.cmd == "registry":
+        return _cmd_registry(args)
     return _cmd_query(args)
 
 

@@ -27,7 +27,10 @@ serving process ever mmaps it:
 
 ``db_stats`` folds the per-level size/ratio table (tools/check_db.py,
 bench BENCH_DB_COMPRESS); ``db_equal`` proves two DBs logically
-identical across storage versions (the compressed-migration gate).
+identical across storage versions (the compressed-migration gate);
+``db_equal_fast`` is its O(manifest) digest screen — same sealed
+sha256s means same content with zero decode, anything else falls back
+to the streamed compare.
 """
 
 from __future__ import annotations
@@ -481,6 +484,71 @@ def db_equal(dir_a, dir_b) -> list[str]:
             for r in readers:
                 r.close()
     return diffs
+
+
+def db_equal_fast(dir_a, dir_b):
+    """O(manifest) equality screen: compare the two DBs' sealed
+    per-level sha256 digests (plus identity fields, level sets, counts
+    and v2 block routing) without decoding a single payload byte.
+
+    -> ``(verdict, diffs)`` where verdict is
+
+    * ``"same"`` — identity fields, level structure, and every sealed
+      digest match: the stored bytes are identical, so the solved
+      content is too;
+    * ``"different"`` — the manifests disagree on identity, levels, or
+      counts: no decode can reconcile that;
+    * ``"unknown"`` — digests differ (or the sides use different
+      storage versions / codecs). Digest inequality is NOT a logical
+      verdict — the same solved table stored v1 vs v2 hashes
+      differently — so callers needing an answer fall back to the full
+      streamed :func:`db_equal` (tools/check_db.py ``--same-as`` does
+      exactly that; ``--deep`` skips the screen).
+
+    ``diffs`` names what disagreed (empty for ``"same"``).
+    """
+    dir_a, dir_b = pathlib.Path(dir_a), pathlib.Path(dir_b)
+    try:
+        ma, mb = read_manifest(dir_a), read_manifest(dir_b)
+    except DbFormatError as e:
+        return "different", [str(e)]
+    diffs = []
+    for field in ("game", "spec", "state_dtype", "sym", "spec_sha256"):
+        if ma.get(field) != mb.get(field):
+            diffs.append(f"{field}: {ma.get(field)!r} != {mb.get(field)!r}")
+    la, lb = set(ma["levels"]), set(mb["levels"])
+    for missing in sorted(la ^ lb, key=int):
+        diffs.append(f"level {missing}: present in only one DB")
+    if diffs:
+        return "different", diffs
+    needs_deep = []
+    for key in sorted(la, key=int):
+        ra, rb = ma["levels"][key], mb["levels"][key]
+        if int(ra["count"]) != int(rb["count"]):
+            diffs.append(
+                f"level {key}: {ra['count']} vs {rb['count']} positions"
+            )
+            continue
+        if level_is_blocked(ra) != level_is_blocked(rb):
+            needs_deep.append(
+                f"level {key}: storage differs (v1 vs blocked v2); "
+                "digests are not comparable"
+            )
+            continue
+        for kind in ("keys", "cells"):
+            if ra[f"{kind}_sha256"] != rb[f"{kind}_sha256"]:
+                needs_deep.append(
+                    f"level {key}: {kind} digests differ (content OR "
+                    "encoding — deep compare decides)"
+                )
+        if level_is_blocked(ra) and ra.get("first_keys") != \
+                rb.get("first_keys"):
+            needs_deep.append(f"level {key}: block routing differs")
+    if diffs:
+        return "different", diffs + needs_deep
+    if needs_deep:
+        return "unknown", needs_deep
+    return "same", []
 
 
 def verify_for_serving(directory, verbose=None) -> bool:

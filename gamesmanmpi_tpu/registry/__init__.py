@@ -1,0 +1,39 @@
+"""DB registry: trustworthy distribution of solved-position databases.
+
+The layer ABOVE one serving node (ISSUE 19): a registry server
+publishes immutable DB epochs as a sha256-sealed catalog
+(registry/server.py), replica nodes pull them with resumable ranged
+fetches into a quarantine staging dir and verify every byte before an
+atomic install + admission-gated rolling reload (registry/pull.py), and
+a query for a game nobody has solved yet becomes a durable job a
+campaign runner drives to a published DB (registry/jobs.py).
+
+Distribution is where correctness goes to die: a torn download, a
+half-installed replica, or a crashed publisher must always degrade to
+"the fleet keeps serving the old epoch", never to a wrong answer. Every
+failure shape here has a named fault point (resilience/faults.py
+``registry.*`` / ``jobs.claim``) and a chaos test
+(tests/test_resilience.py).
+"""
+
+from gamesmanmpi_tpu.registry.jobs import JobQueue, QueueRefused, run_pending
+from gamesmanmpi_tpu.registry.pull import PullError, pull_db, sync_fleet
+from gamesmanmpi_tpu.registry.server import (
+    RegistryServer,
+    catalog_seal,
+    load_catalog,
+    publish_db,
+)
+
+__all__ = [
+    "JobQueue",
+    "PullError",
+    "QueueRefused",
+    "RegistryServer",
+    "catalog_seal",
+    "load_catalog",
+    "publish_db",
+    "pull_db",
+    "run_pending",
+    "sync_fleet",
+]

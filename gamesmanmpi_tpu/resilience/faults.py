@@ -115,6 +115,27 @@ KNOWN_POINTS = {
                        "here is a liveness stall the supervisor kills)",
     "serve.reload": "supervisor: at the top of a rolling manifest "
                     "reload, before any worker is drained",
+    "registry.fetch": "registry pull client: after one ranged blob "
+                      "read lands in the staging file, before its "
+                      "checksum verify (a torn here is the "
+                      "torn-download shape the manifest sha catches; "
+                      "a transient is a flaky transport the retry "
+                      "supervisor must absorb)",
+    "registry.publish": "registry server: after the payload directory "
+                        "is installed, before the catalog seal (a kill "
+                        "here is the death-between-payload-and-seal "
+                        "shape — the old catalog must stay authoritative "
+                        "and a re-publish must converge)",
+    "registry.install": "registry pull client: after every staged file "
+                        "verified, before the atomic rename-install (a "
+                        "kill here leaves only the staging dir; the "
+                        "fleet keeps serving the old epoch and a re-pull "
+                        "resumes from verified bytes)",
+    "jobs.claim": "solve-on-demand runner: after a claim record is "
+                  "fsync'd to the job ledger, before the campaign "
+                  "starts (a kill here is the runner death the "
+                  "lease/dead-pid classifier must reclaim on the next "
+                  "runner's resume)",
 }
 
 

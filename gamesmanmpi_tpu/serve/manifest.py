@@ -26,6 +26,8 @@ import json
 import pathlib
 import re
 
+from gamesmanmpi_tpu.db.format import MANIFEST_NAME
+
 #: Routing keys must survive a URL path segment un-escaped.
 _NAME_RE = re.compile(r"^[A-Za-z0-9_.-]+$")
 
@@ -94,6 +96,25 @@ def load_fleet_manifest(path) -> list[FleetEntry]:
             raise ValueError(
                 f"fleet manifest {path}: games[{i}] ({name}): no such DB "
                 f"directory {db}"
+            )
+        # A directory without a readable DB manifest is a half-landed
+        # pull (or a typo'd path) — reject it HERE, naming the entry,
+        # before any worker is drained against it. The full integrity
+        # gate (db/check.verify_for_serving) still runs per worker;
+        # this is the cheap fail-early half.
+        dbm = db / MANIFEST_NAME
+        try:
+            present = dbm.is_file()
+        except OSError as e:  # unreadable parent (perms, stale mount)
+            raise ValueError(
+                f"fleet manifest {path}: games[{i}] ({name}): DB "
+                f"directory {db} is unreadable ({e})"
+            ) from None
+        if not present:
+            raise ValueError(
+                f"fleet manifest {path}: games[{i}] ({name}): {db} has "
+                f"no {MANIFEST_NAME} — not a finalized DB (half-landed "
+                "pull or export?)"
             )
         entries.append(FleetEntry(name, str(db)))
     return entries

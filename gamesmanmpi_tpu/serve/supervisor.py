@@ -242,6 +242,11 @@ class ServeSupervisor:
         self._roll_backup = None  # pre-roll (entries, readers); guarded-by: _lock
         self._rolling_back = False  # guarded-by: _lock
         self._reloads_done = 0  # guarded-by: _lock
+        # Last registry sync report (POST /registry-sync from the pull
+        # client, registry/pull.py): what epoch set the replica last
+        # tried to land and whether the roll happened. None until a
+        # sync ever reported.
+        self._registry_sync = None  # guarded-by: _lock
         # Fleet-wide trace ring: workers tail-sample per-request traces
         # (obs/qtrace.py) and ship newly kept ones on heartbeat beats —
         # the only per-worker channel, since all workers share one
@@ -433,6 +438,17 @@ class ServeSupervisor:
         self._reload_requested = True
         self._wake()
 
+    def note_registry_sync(self, info: dict) -> None:
+        """Record a registry pull client's sync report (shown in
+        /status as ``registry_sync``) — observability only; the roll
+        itself arrives via the normal request_reload path."""
+        keep = {
+            k: info.get(k)
+            for k in ("status", "epochs", "failed", "wall_time")
+        }
+        with self._lock:
+            self._registry_sync = keep
+
     def status(self) -> dict:
         """Fleet-level health snapshot (the control /healthz payload)."""
         now = time.monotonic()
@@ -484,6 +500,7 @@ class ServeSupervisor:
                 "slo_fast_burn": any(
                     s.slo.get("fast_burn") for s in self._slots
                 ),
+                "registry_sync": self._registry_sync,
             }
 
     def traces(self) -> dict:
@@ -1130,12 +1147,26 @@ class _ControlHandler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802 - http.server API
         sup = self.server.supervisor
-        # No body is read on any control POST — drop the connection so
-        # stray bytes can't desync a keep-alive socket.
+        # Only /registry-sync reads a (bounded) body; every other POST
+        # ignores it — so always drop the connection, and stray bytes
+        # can't desync a keep-alive socket.
         self.close_connection = True
         if self.path == "/reload":
             sup.request_reload()
             self._send_json(202, {"ok": True, "status": "reload requested"})
+        elif self.path == "/registry-sync":
+            try:
+                n = int(self.headers.get("Content-Length") or 0)
+                if not 0 < n <= 1 << 20:
+                    raise ValueError(f"bad Content-Length {n}")
+                info = json.loads(self.rfile.read(n))
+                if not isinstance(info, dict):
+                    raise ValueError("body must be a JSON object")
+            except (ValueError, OSError) as e:
+                self._send_json(400, {"error": f"bad sync report: {e}"})
+                return
+            sup.note_registry_sync(info)
+            self._send_json(200, {"ok": True})
         else:
             self._send_json(404, {"error": f"no such path {self.path!r}"})
 

@@ -1,0 +1,507 @@
+"""DB registry: signed catalog, crash-safe pull, solve-on-demand.
+
+Acceptance axes (ISSUE 19):
+
+* publish/catalog — a published DB becomes an immutable epoch in a
+  sha256-sealed catalog; tampering with the catalog fails the pull
+  client's seal check; re-publishing an unchanged DB is a no-op;
+* verified pull — every file is staged in quarantine, checksummed
+  (crc32 + sha256) BEFORE the atomic rename-install, and admitted
+  through verify_for_serving; rot is quarantined (`.corrupt`), never
+  installed; interrupted pulls resume from verified bytes;
+* fleet integration — a fork-mode CLI fleet serving epoch A keeps
+  answering with ZERO failed requests while epoch B is pulled,
+  verified, installed and rolled in (sync_fleet -> POST /reload); a
+  rotted epoch is quarantined with the fleet untouched;
+* solve-on-demand — a query for an unregistered game becomes a durable
+  deduped job (fsync'd append-only ledger) that a runner drives through
+  campaign -> export -> publish; admission control bounds queue depth;
+  the ledger survives torn tails and dead claims (classify-and-resume;
+  the SIGKILL shapes live in tests/test_resilience.py).
+
+Satellites: fleet-manifest half-landed-DB rejection, db_equal_fast
+digest screen + check_db --same-as/--deep, load_gen soak progress.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from gamesmanmpi_tpu.db import export_result
+from gamesmanmpi_tpu.db.check import check_db, db_equal, db_equal_fast
+from gamesmanmpi_tpu.db.format import MANIFEST_NAME, file_sha256
+from gamesmanmpi_tpu.games import get_game
+from gamesmanmpi_tpu.registry.jobs import JobQueue, QueueRefused
+from gamesmanmpi_tpu.registry.pull import (
+    PullError,
+    ensure_db,
+    fetch_catalog,
+    pull_db,
+    sync_fleet,
+)
+from gamesmanmpi_tpu.registry.server import (
+    RegistryServer,
+    catalog_seal,
+    load_catalog,
+    publish_db,
+)
+from gamesmanmpi_tpu.serve.manifest import load_fleet_manifest
+from gamesmanmpi_tpu.solve import Solver
+
+from helpers import REPO, load_module
+
+_CLI = [sys.executable, "-m", "gamesmanmpi_tpu.cli"]
+_SPEC = "subtract:total=10,moves=1-2"
+
+
+def _get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _wait_for(pred, timeout=60.0, interval=0.05, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = pred()
+        if out:
+            return out
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture(scope="module")
+def sub_result():
+    """One solve, shared by every export in this module."""
+    return Solver(get_game(_SPEC)).solve()
+
+
+@pytest.fixture(scope="module")
+def sub_db(sub_result, tmp_path_factory):
+    """Epoch A: the plain (v1) subtract DB."""
+    d = tmp_path_factory.mktemp("regdbA") / "sub"
+    export_result(sub_result, d, _SPEC)
+    return d
+
+
+@pytest.fixture(scope="module")
+def sub_db_v2(sub_result, tmp_path_factory):
+    """Epoch B: the SAME solved content, block-compressed — different
+    stored bytes (different epoch), identical answers."""
+    d = tmp_path_factory.mktemp("regdbB") / "sub"
+    export_result(sub_result, d, _SPEC, compress=True)
+    return d
+
+
+@pytest.fixture()
+def registry(tmp_path):
+    srv = RegistryServer(tmp_path / "registry")
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+# ------------------------------------------------------ publish / catalog
+
+
+def test_publish_seals_catalog_and_is_idempotent(tmp_path, sub_db):
+    root = tmp_path / "reg"
+    rec = publish_db(root, "sub", sub_db)
+    assert rec["epoch"] == file_sha256(sub_db / MANIFEST_NAME)
+    assert {f["name"] for f in rec["files"]} \
+        >= {MANIFEST_NAME, "level_0000.keys.npy"}
+    cat = load_catalog(root)
+    assert cat["seal"] == catalog_seal(cat["dbs"])
+    assert cat["dbs"]["sub"]["epoch"] == rec["epoch"]
+    # Published payload is a copy: a valid DB in its own right. The
+    # record's path is root-relative (the catalog must survive the
+    # registry root moving).
+    assert check_db(root / rec["path"]) == []
+    # Same DB again: no new epoch, no catalog churn.
+    again = publish_db(root, "sub", sub_db)
+    assert again["epoch"] == rec["epoch"]
+    assert load_catalog(root) == cat
+
+
+def test_catalog_http_and_tamper_detection(registry, tmp_path, sub_db):
+    publish_db(registry.root, "sub", sub_db)
+    doc = fetch_catalog(registry.url)
+    assert set(doc["dbs"]) == {"sub"}
+    status, man = _get(f"{registry.url}/db/sub/manifest")
+    assert status == 200 and man["name"] == "sub" and man["files"]
+    # Unknown DB: 404 that tells the client solve-on-demand exists.
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(f"{registry.url}/db/nope/manifest")
+    assert e.value.code == 404
+    # A tampered catalog (rotted disk, MITM, truncated write) fails the
+    # pull client's seal check — no silent wrong-epoch pull.
+    cat_path = registry.root / "catalog.json"
+    doc = json.loads(cat_path.read_text())
+    doc["dbs"]["sub"]["epoch"] = "0" * 64
+    cat_path.write_text(json.dumps(doc))
+    with pytest.raises(PullError, match="seal"):
+        fetch_catalog(registry.url)
+
+
+# ------------------------------------------------------------------- pull
+
+
+def test_pull_installs_verified_and_reruns_noop(registry, tmp_path, sub_db):
+    publish_db(registry.root, "sub", sub_db)
+    dest = tmp_path / "replica"
+    rec = pull_db(registry.url, "sub", dest)
+    assert rec["installed"]
+    assert rec["epoch"] == file_sha256(sub_db / MANIFEST_NAME)
+    assert check_db(rec["db"]) == []
+    # Identical content to the source, proven by digest alone.
+    assert db_equal_fast(sub_db, rec["db"]) == ("same", [])
+    again = pull_db(registry.url, "sub", dest)
+    assert not again["installed"]
+    assert again["db"] == rec["db"]
+
+
+def test_pull_refetches_rotted_staging_bytes(registry, tmp_path, sub_db):
+    """Garbage pre-staged in quarantine (a torn earlier pull, cosmic
+    rays, a liar of a filesystem) must be detected by checksum and
+    refetched — never installed."""
+    publish_db(registry.root, "sub", sub_db)
+    epoch12 = file_sha256(sub_db / MANIFEST_NAME)[:12]
+    dest = tmp_path / "replica"
+    stage = dest / ".registry_tmp" / f"sub@{epoch12}"
+    stage.mkdir(parents=True)
+    # Same size as the real file, wrong bytes: the resume fast path
+    # can't skip it, the checksum catches it, trial 2 refetches clean.
+    real = (sub_db / "level_0000.keys.npy").read_bytes()
+    (stage / "level_0000.keys.npy").write_bytes(b"\xff" * len(real))
+    rec = pull_db(registry.url, "sub", dest)
+    assert rec["installed"]
+    assert rec["refetched_files"] >= 1
+    assert check_db(rec["db"]) == []
+    # The quarantined garbage did not survive into the install.
+    import pathlib
+
+    assert not list(pathlib.Path(rec["db"]).glob("*.corrupt"))
+
+
+def test_pull_quarantines_epoch_that_fails_admission(
+        registry, tmp_path, sub_db):
+    """A DB whose files all match their published checksums but whose
+    CONTENT fails the serving gate (the publisher sealed rot) must end
+    quarantined, not installed — the last line of defense."""
+    import numpy as np
+
+    rotted = tmp_path / "rotted"
+    import shutil
+
+    shutil.copytree(sub_db, rotted)
+    # Rot the payload (zeroed cells decode to UNDECIDED — a solver-bug
+    # shape), then re-seal its digest in the manifest so every
+    # transport-level checksum passes and only verify_for_serving can
+    # object.
+    cells_file = rotted / "level_0003.cells.npy"
+    np.save(cells_file.with_suffix(""),
+            np.zeros_like(np.load(cells_file)))
+    man = json.loads((rotted / MANIFEST_NAME).read_text())
+    man["levels"]["3"]["cells_sha256"] = file_sha256(cells_file)
+    (rotted / MANIFEST_NAME).write_text(json.dumps(man))
+    publish_db(registry.root, "sub", rotted)
+    dest = tmp_path / "replica"
+    with pytest.raises(PullError, match="quarantin"):
+        pull_db(registry.url, "sub", dest)
+    installs = [d for d in dest.iterdir() if not d.name.startswith(".")]
+    assert all(d.name.endswith(".corrupt") for d in installs), installs
+
+
+# ------------------------------------------------- satellites: validation
+
+
+def test_fleet_manifest_rejects_half_landed_db(tmp_path):
+    """A manifest entry pointing at a directory with no DB manifest (a
+    half-landed pull) must fail validation NAMING the entry, before any
+    worker is touched."""
+    empty = tmp_path / "dbs" / "sub"
+    empty.mkdir(parents=True)
+    manifest = tmp_path / "fleet.json"
+    manifest.write_text(json.dumps({
+        "version": 1, "games": [{"name": "sub", "db": "dbs/sub"}],
+    }))
+    with pytest.raises(ValueError) as e:
+        load_fleet_manifest(manifest)
+    msg = str(e.value)
+    assert "games[0]" in msg and "sub" in msg and MANIFEST_NAME in msg
+
+
+def test_db_equal_fast_verdicts(tmp_path, sub_db, sub_db_v2):
+    # Identical bytes: digest screen alone proves equality.
+    twin = tmp_path / "twin"
+    import shutil
+
+    shutil.copytree(sub_db, twin)
+    assert db_equal_fast(sub_db, twin) == ("same", [])
+    # Same content, different storage: inconclusive by design — and the
+    # deep compare it defers to says "identical".
+    verdict, diffs = db_equal_fast(sub_db, sub_db_v2)
+    assert verdict == "unknown"
+    assert diffs
+    assert db_equal(sub_db, sub_db_v2) == []
+    # Different game: the manifests alone settle it.
+    other = tmp_path / "other"
+    export_result(
+        Solver(get_game("subtract:total=6,moves=1-2")).solve(), other,
+        "subtract:total=6,moves=1-2",
+    )
+    verdict, diffs = db_equal_fast(sub_db, other)
+    assert verdict == "different"
+    assert diffs
+
+
+def test_check_db_cli_same_as_fast_then_deep(sub_db, sub_db_v2, capsys):
+    check_db_cli = load_module(REPO / "tools" / "check_db.py")
+    # Identical twin: fast path decides, no decode.
+    assert check_db_cli.main(
+        [str(sub_db), "--same-as", str(sub_db), "--quiet"]) == 0
+    # v1 vs v2 twin: screen is inconclusive, deep compare passes.
+    assert check_db_cli.main(
+        [str(sub_db), "--same-as", str(sub_db_v2), "--quiet"]) == 0
+    # --deep forces the streamed compare outright.
+    assert check_db_cli.main(
+        [str(sub_db), "--same-as", str(sub_db_v2), "--deep",
+         "--quiet"]) == 0
+    capsys.readouterr()
+
+
+def test_load_gen_soak_emits_progress(tmp_path):
+    """Soak mode: periodic cumulative snapshots while the load runs —
+    pointed at a dead port so every request classifies as dropped and
+    the test needs no server."""
+    load_gen = load_module(REPO / "tools" / "load_gen.py")
+    snaps = []
+    rec = load_gen.run_load(
+        "http://127.0.0.1:9", [1, 2, 3], duration=1.0, concurrency=2,
+        timeout=0.2, progress_secs=0.25, progress=snaps.append,
+    )
+    assert rec["dropped"] > 0 and rec["ok"] == 0
+    assert len(snaps) >= 2
+    assert {"t_secs", "requests", "qps", "p99_ms", "errors", "dropped",
+            "mismatches"} <= set(snaps[0])
+    assert snaps[-1]["requests"] >= snaps[0]["requests"]
+
+
+# ------------------------------------------------------- solve-on-demand
+
+
+def test_job_queue_durable_dedup_admission(tmp_path, monkeypatch):
+    q = JobQueue(tmp_path / "jobs.jsonl")
+    job = q.enqueue("subtract:total=6,moves=1-2", name="sub6")
+    assert job["state"] == "pending"
+    # Dedup: same (name, spec) is the same job, not a second solve.
+    assert q.enqueue("subtract:total=6,moves=1-2",
+                     name="sub6")["id"] == job["id"]
+    assert q.depth() == 1
+    # State is ledger replay: a fresh handle sees the same queue.
+    assert JobQueue(tmp_path / "jobs.jsonl").depth() == 1
+    # A torn tail line (death mid-append) is skipped, earlier state kept.
+    with open(tmp_path / "jobs.jsonl", "a") as fh:
+        fh.write('{"op": "enqueue", "job": "tornton')
+    assert q.depth() == 1
+    # Admission: depth cap refuses new work, dedup still answers.
+    monkeypatch.setenv("GAMESMAN_JOBS_MAX_DEPTH", "1")
+    with pytest.raises(QueueRefused):
+        q.enqueue("subtract:total=7,moves=1-2", name="sub7")
+    assert q.enqueue("subtract:total=6,moves=1-2",
+                     name="sub6")["id"] == job["id"]
+    monkeypatch.setenv("GAMESMAN_JOBS_DISK_FLOOR_MB", "1e9")
+    monkeypatch.setenv("GAMESMAN_JOBS_MAX_DEPTH", "64")
+    with pytest.raises(QueueRefused, match="disk"):
+        q.enqueue("subtract:total=8,moves=1-2", name="sub8")
+
+
+def test_job_queue_reclaims_dead_claims_and_caps_attempts(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("GAMESMAN_JOBS_MAX_ATTEMPTS", "2")
+    q = JobQueue(tmp_path / "jobs.jsonl")
+    job = q.enqueue("subtract:total=6,moves=1-2")
+    # A pid that is provably dead by claim time.
+    dead = subprocess.Popen([sys.executable, "-c", "pass"])
+    dead.wait()
+    got = q.claim(pid=dead.pid)
+    assert got["id"] == job["id"] and got["attempts"] == 1
+    # The claim's pid is dead: the next claim reclaims the SAME job.
+    got2 = q.claim(pid=dead.pid)
+    assert got2["id"] == job["id"] and got2["attempts"] == 2
+    # Attempts exhausted: the job fails terminally instead of looping.
+    assert q.claim(pid=dead.pid) is None
+    assert q.jobs()[job["id"]]["state"] == "failed"
+    # release() puts a live claim back to pending for a later runner.
+    job2 = q.enqueue("subtract:total=7,moves=1-2")
+    live = q.claim()
+    assert live["id"] == job2["id"]
+    q.release(job2["id"], error="step blew up")
+    assert q.jobs()[job2["id"]]["state"] == "pending"
+
+
+def test_registry_solve_endpoint_queues_and_bounds(tmp_path, monkeypatch):
+    monkeypatch.setenv("GAMESMAN_JOBS_MAX_DEPTH", "1")
+    root = tmp_path / "reg"
+    srv = RegistryServer(root, queue=JobQueue(root / "jobs.jsonl"))
+    srv.start()
+    try:
+        # ensure_db: manifest 404 + a spec in hand -> queued job.
+        out = ensure_db(srv.url, "sub6", spec="subtract:total=6,moves=1-2")
+        assert out["status"] == "pending" and out["id"]
+        # Same spec again: the SAME job (dedup), not a 429.
+        again = ensure_db(srv.url, "sub6",
+                          spec="subtract:total=6,moves=1-2")
+        assert again["id"] == out["id"]
+        status, jobs = _get(f"{srv.url}/jobs")
+        assert status == 200 and jobs["depth"] == 1
+        # Queue full: 429, the thundering herd degrades politely.
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(f"{srv.url}/solve",
+                  {"name": "sub7", "spec": "subtract:total=7,moves=1-2"})
+        assert e.value.code == 429
+    finally:
+        srv.stop()
+
+
+# ------------------------------------- the fleet epoch-flip acceptance
+
+
+def test_fleet_serves_old_epoch_while_pulling_new_under_load(
+        tmp_path, sub_db, sub_db_v2):
+    """THE ISSUE 19 gate: a fork-mode CLI fleet on epoch A answers a
+    query hammer with zero failures while epoch B is pulled, verified,
+    installed and rolled in; the served epoch (ETag) flips exactly once;
+    a rotted epoch C is then quarantined with the fleet untouched."""
+    load_gen = load_module(REPO / "tools" / "load_gen.py")
+    root = tmp_path / "registry"
+    publish_db(root, "sub", sub_db)
+    srv = RegistryServer(root)
+    srv.start()
+    dest = tmp_path / "dbs"
+    pulled_a = pull_db(srv.url, "sub", dest)
+    manifest = tmp_path / "fleet.json"
+    manifest.write_text(json.dumps({
+        "version": 1, "games": [{"name": "sub", "db": pulled_a["db"]}],
+    }))
+    env = dict(os.environ, GAMESMAN_PLATFORM="cpu")
+    env.pop("GAMESMAN_FAULTS", None)
+    proc = subprocess.Popen(
+        _CLI + ["serve", "--fleet-manifest", str(manifest), "--port", "0",
+                "--workers", "2", "--control-port", "0"],
+        stdout=subprocess.PIPE, text=True, env=env, cwd=str(REPO),
+    )
+    try:
+        banner = proc.stdout.readline()
+        assert "serving fleet" in banner, banner
+        port = int(banner.split("http://127.0.0.1:")[1].split(" ")[0])
+        cport = int(banner.split("http://127.0.0.1:")[2].split(" ")[0])
+        base = f"http://127.0.0.1:{port}"
+        control = f"http://127.0.0.1:{cport}"
+        _wait_for(
+            lambda: _get(control + "/healthz")[1]["status"] == "ok",
+            timeout=120, what="fleet ready",
+        )
+
+        # One-game fleet: the single route is also the default route, so
+        # the bare /query endpoints (load_gen's shape) hit game "sub".
+        def _etag():
+            with urllib.request.urlopen(
+                    f"{base}/query?p=0xa", timeout=10) as resp:
+                return resp.headers.get("ETag")
+
+        etag_a = _etag()
+        assert etag_a
+
+        # Epoch B appears upstream while the hammer runs.
+        publish_db(root, "sub", sub_db_v2)
+        stop = threading.Event()
+        result = {}
+
+        def _hammer():
+            result.update(load_gen.run_load(
+                base, list(range(11)), duration=60,
+                concurrency=4, chunk_size=4, timeout=10, stop_event=stop,
+            ))
+
+        t = threading.Thread(target=_hammer)
+        t.start()
+        try:
+            time.sleep(0.5)
+            sync = sync_fleet(srv.url, ["sub"], manifest, dest,
+                              control_url=control)
+            assert sync["status"] == "rolled", sync
+            _wait_for(
+                lambda: (s := _get(control + "/healthz")[1])
+                ["reloads_done"] == 1 and s["status"] == "ok",
+                timeout=120, what="rolling reload onto epoch B",
+            )
+            time.sleep(0.5)
+        finally:
+            stop.set()
+            t.join(timeout=60)
+        # Zero failed requests across the pull + verify + install + roll.
+        assert result["ok"] > 0
+        assert result["errors"] == 0
+        assert result["dropped"] == 0
+        assert result["mismatches"] == 0
+
+        # The served epoch flipped exactly once: A -> B.
+        st = _get(control + "/healthz")[1]
+        assert st["reloads_done"] == 1
+        etag_b = _etag()
+        assert etag_b and etag_b != etag_a
+        assert file_sha256(sub_db_v2 / MANIFEST_NAME)[:12] in \
+            json.loads(manifest.read_text())["games"][0]["db"]
+        # The supervisor recorded the sync (control POST /registry-sync).
+        assert st["registry_sync"]["status"] == "rolled"
+        assert "sub" in st["registry_sync"]["epochs"]
+
+        # Rotted epoch C: checksums pass, admission fails -> quarantine,
+        # fleet stays healthy on B.
+        import shutil
+
+        import numpy as np
+
+        rotted = tmp_path / "rotted"
+        shutil.copytree(sub_db, rotted)
+        cells_file = rotted / "level_0002.cells.npy"
+        np.save(cells_file.with_suffix(""),
+                np.zeros_like(np.load(cells_file)))
+        man = json.loads((rotted / MANIFEST_NAME).read_text())
+        man["levels"]["2"]["cells_sha256"] = file_sha256(cells_file)
+        (rotted / MANIFEST_NAME).write_text(json.dumps(man))
+        publish_db(root, "sub", rotted)
+        sync = sync_fleet(srv.url, ["sub"], manifest, dest,
+                          control_url=control)
+        assert sync["status"] == "nothing_pulled", sync
+        assert sync["failed"] and \
+            "admission gate" in sync["failed"][0]["error"], sync
+        st = _get(control + "/healthz")[1]
+        assert st["status"] == "ok"
+        assert st["reloads_done"] == 1  # no second flip
+        assert _etag() == etag_b
+        assert any(d.name.endswith(".corrupt") for d in dest.iterdir())
+
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        srv.stop()

@@ -1572,3 +1572,178 @@ def test_chaos_reload_fault_fails_closed_fleet_untouched(tmp_path):
         assert st["last_reload_error"] is None
     finally:
         sup.stop()
+
+
+# --------------------------------------------------- registry chaos matrix
+#
+# DB distribution (ISSUE 19) fault points, every shape the pull/publish/
+# solve-on-demand paths claim to survive, run through real subprocesses:
+#
+#   registry.fetch:torn      torn download mid-range -> resumed pull
+#   registry.install:kill    SIGKILL before rename-install -> no install
+#   registry.publish:kill    death after payload, before catalog seal
+#   jobs.claim:kill          runner SIGKILL after the fsync'd claim
+#
+# The invariant in all four: the fleet-visible state (catalog, installed
+# epoch, job ledger) either did not change or converges on retry —
+# never a half-landed epoch a worker could serve from.
+
+
+@pytest.fixture(scope="module")
+def registry_db(tmp_path_factory):
+    """Tiny subtract DB: the registry chaos tests' published artifact."""
+    from gamesmanmpi_tpu.db import export_result
+
+    spec = "subtract:total=10,moves=1-2"
+    d = tmp_path_factory.mktemp("regdb") / "sub"
+    export_result(Solver(get_game(spec)).solve(), d, spec)
+    return d
+
+
+def _registry_env(**extra):
+    env = dict(os.environ, GAMESMAN_PLATFORM="cpu")
+    env.pop("GAMESMAN_FAULTS", None)
+    env.update(extra)
+    return env
+
+
+def test_chaos_torn_download_resumes_to_verified_install(
+        registry_db, tmp_path):
+    """registry.fetch chaos: a download torn mid-range (file truncated,
+    process killed) leaves only quarantined staging bytes; re-running
+    the pull resumes from the verified prefix and installs a DB that
+    passes the full integrity gate."""
+    from gamesmanmpi_tpu.db.check import check_db
+    from gamesmanmpi_tpu.registry.server import RegistryServer, publish_db
+
+    root = tmp_path / "registry"
+    publish_db(root, "sub", registry_db)
+    srv = RegistryServer(root)
+    srv.start()
+    try:
+        dest = tmp_path / "replica"
+        cmd = [sys.executable, str(REPO / "tools" / "pull_db.py"),
+               srv.url, "sub", "--dest", str(dest), "--json"]
+        torn = subprocess.run(
+            cmd, env=_registry_env(GAMESMAN_FAULTS="registry.fetch:torn:2"),
+            capture_output=True, text=True, cwd=str(REPO), timeout=120,
+        )
+        assert torn.returncode == faults.TORN_EXIT_CODE, torn.stderr[-2000:]
+        # Nothing installed; the partial bytes live only in staging.
+        assert not [d for d in dest.iterdir()
+                    if not d.name.startswith(".")]
+        assert list((dest / ".registry_tmp").rglob("*"))
+        clean = subprocess.run(
+            cmd, env=_registry_env(), capture_output=True, text=True,
+            cwd=str(REPO), timeout=120,
+        )
+        assert clean.returncode == 0, clean.stderr[-2000:]
+        rec = json.loads(clean.stdout)["pulled"][0]
+        assert rec["installed"]
+        # The fully-verified file from before the tear was NOT refetched.
+        assert rec["resumed_files"] >= 1
+        assert check_db(rec["db"]) == []
+    finally:
+        srv.stop()
+
+
+def test_chaos_kill_before_install_keeps_replica_clean(
+        registry_db, tmp_path):
+    """registry.install chaos: SIGKILL after every staged file verified
+    but before the atomic rename leaves NO installed epoch (a fleet
+    manifest can never name it); the re-run reuses every verified
+    staged file and installs."""
+    from gamesmanmpi_tpu.registry.server import RegistryServer, publish_db
+
+    root = tmp_path / "registry"
+    rec = publish_db(root, "sub", registry_db)
+    srv = RegistryServer(root)
+    srv.start()
+    try:
+        dest = tmp_path / "replica"
+        cmd = [sys.executable, str(REPO / "tools" / "pull_db.py"),
+               srv.url, "sub", "--dest", str(dest), "--json"]
+        killed = subprocess.run(
+            cmd,
+            env=_registry_env(GAMESMAN_FAULTS="registry.install:kill:1"),
+            capture_output=True, text=True, cwd=str(REPO), timeout=120,
+        )
+        assert killed.returncode == faults.KILL_EXIT_CODE, \
+            killed.stderr[-2000:]
+        assert not [d for d in dest.iterdir()
+                    if not d.name.startswith(".")]
+        rerun = subprocess.run(
+            cmd, env=_registry_env(), capture_output=True, text=True,
+            cwd=str(REPO), timeout=120,
+        )
+        assert rerun.returncode == 0, rerun.stderr[-2000:]
+        out = json.loads(rerun.stdout)["pulled"][0]
+        assert out["installed"]
+        # Every file was already staged + verified: zero refetches.
+        assert out["resumed_files"] == len(rec["files"])
+        assert out["refetched_files"] == 0
+    finally:
+        srv.stop()
+
+
+def test_chaos_publish_kill_keeps_old_catalog_authoritative(
+        registry_db, tmp_path):
+    """registry.publish chaos: the publisher dying AFTER the payload
+    directory lands but BEFORE the catalog seal must leave the old
+    catalog authoritative (replicas keep pulling the old epoch); a
+    re-publish of the same DB converges to a sealed catalog."""
+    from gamesmanmpi_tpu.registry.server import catalog_seal, load_catalog
+
+    root = tmp_path / "registry"
+    cmd = _CLI + ["registry", "publish", str(registry_db),
+                  "--root", str(root), "--name", "sub"]
+    killed = subprocess.run(
+        cmd, env=_registry_env(GAMESMAN_FAULTS="registry.publish:kill:1"),
+        capture_output=True, text=True, cwd=str(REPO), timeout=120,
+    )
+    assert killed.returncode == faults.KILL_EXIT_CODE, killed.stderr[-2000:]
+    # Payload landed, but the catalog never sealed the new epoch.
+    assert list((root / "dbs" / "sub").iterdir())
+    cat = load_catalog(root)
+    assert cat["dbs"] == {}
+    assert cat["seal"] == catalog_seal({})
+    republish = subprocess.run(
+        cmd, env=_registry_env(), capture_output=True, text=True,
+        cwd=str(REPO), timeout=120,
+    )
+    assert republish.returncode == 0, republish.stderr[-2000:]
+    cat = load_catalog(root)
+    assert set(cat["dbs"]) == {"sub"}
+    assert cat["seal"] == catalog_seal(cat["dbs"])
+
+
+def test_chaos_runner_sigkill_at_claim_resumes_to_published_db(tmp_path):
+    """jobs.claim chaos: the solve-on-demand runner SIGKILLed right
+    after its claim record is fsync'd leaves a running job with a dead
+    pid; the next runner's classify-and-resume reclaims it and drives
+    the job all the way to a published catalog epoch."""
+    from gamesmanmpi_tpu.registry.jobs import JobQueue
+    from gamesmanmpi_tpu.registry.server import load_catalog
+
+    root = tmp_path / "registry"
+    queue = JobQueue(root / "jobs.jsonl")
+    job = queue.enqueue("subtract:total=5,moves=1-2", name="sub5")
+    cmd = _CLI + ["registry", "run-jobs", "--root", str(root), "--once"]
+    killed = subprocess.run(
+        cmd, env=_registry_env(GAMESMAN_FAULTS="jobs.claim:kill:1"),
+        capture_output=True, text=True, cwd=str(REPO), timeout=180,
+    )
+    assert killed.returncode == faults.KILL_EXIT_CODE, killed.stderr[-2000:]
+    # The claim is durable: the ledger shows a running job whose pid is
+    # dead — exactly what the reclaim classifier looks for.
+    state = queue.jobs()[job["id"]]
+    assert state["state"] == "running"
+    resumed = subprocess.run(
+        cmd, env=_registry_env(), capture_output=True, text=True,
+        cwd=str(REPO), timeout=600,
+    )
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    out = json.loads(resumed.stdout)
+    assert out["results"][0]["ok"], out
+    assert queue.jobs()[job["id"]]["state"] == "done"
+    assert "sub5" in load_catalog(root)["dbs"]

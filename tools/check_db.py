@@ -18,8 +18,13 @@ per-level size/ratio summary table prints (suppressed by --quiet):
 
 --same-as proves this DB logically identical (same levels, keys, cells)
 to another directory regardless of storage version — the migration gate
-for a compressed re-export. --stats-json dumps the db_stats record for
-machine consumers (bench.py's BENCH_DB_COMPRESS gate).
+for a compressed re-export. It screens with the sealed manifest sha256
+digests first (matching digests = identical stored bytes, no decode at
+all) and only streams the full decoded compare when the screen is
+inconclusive — e.g. the two sides use different storage versions, where
+digest inequality says nothing about the solved content. --deep forces
+the streamed compare unconditionally. --stats-json dumps the db_stats
+record for machine consumers (bench.py's BENCH_DB_COMPRESS gate).
 
 When the manifest records an opening book (book.gmb), the structural
 pass checks its seal/parse/sortedness — and then EVERY entry is
@@ -83,24 +88,50 @@ def main(argv=None) -> int:
     p.add_argument("--same-as", default=None, metavar="OTHER_DB",
                    help="additionally require logical equality with "
                    "another DB directory (storage-version-agnostic; "
-                   "the v1-vs-compressed migration gate)")
+                   "the v1-vs-compressed migration gate). Fast path: "
+                   "the sealed manifest sha256s are compared first — "
+                   "matching digests prove equality with zero decode; "
+                   "only an inconclusive screen falls back to the full "
+                   "streamed compare")
+    p.add_argument("--deep", action="store_true",
+                   help="with --same-as: skip the manifest-digest fast "
+                   "path and always run the full streamed decode "
+                   "compare (paranoia mode — also proves the digests "
+                   "themselves were honest)")
     p.add_argument("--skip-book-probe", action="store_true",
                    help="skip the opening-book deep re-probe (the only "
                    "check that builds game kernels); the structural "
                    "seal/parse check still runs")
     args = p.parse_args(argv)
 
-    from gamesmanmpi_tpu.db.check import check_db, db_equal, db_stats
+    from gamesmanmpi_tpu.db.check import (
+        check_db,
+        db_equal,
+        db_equal_fast,
+        db_stats,
+    )
     from gamesmanmpi_tpu.db.format import DbFormatError, read_manifest
 
     problems = check_db(
         args.db_dir, verbose=None if args.quiet else print
     )
     if args.same_as:
-        problems += [
-            f"differs from {args.same_as}: {d}"
-            for d in db_equal(args.db_dir, args.same_as)
-        ]
+        verdict = "unknown"
+        if not args.deep:
+            verdict, fast_diffs = db_equal_fast(args.db_dir, args.same_as)
+            if verdict == "same" and not args.quiet:
+                print(f"same-as {args.same_as}: manifest digests match "
+                      "(fast path)")
+            elif verdict == "different":
+                problems += [
+                    f"differs from {args.same_as}: {d}" for d in fast_diffs
+                ]
+        if verdict == "unknown":
+            # Inconclusive (or --deep): stream the actual tables.
+            problems += [
+                f"differs from {args.same_as}: {d}"
+                for d in db_equal(args.db_dir, args.same_as)
+            ]
     if not problems and not args.skip_book_probe:
         try:
             has_book = bool(read_manifest(args.db_dir).get("book"))

@@ -34,6 +34,10 @@ dominates — the shape real game traffic has, and the one that exercises
 the serving hot path (opening book, shared block cache, batcher dedup).
 ``--get`` switches to single-position conditional GETs with a client-
 side ETag cache, measuring the edge-cacheable form of the same answers.
+``--duration-secs N`` is soak mode: the same load for N wall-clock
+seconds with a cumulative ``[load_gen] t=..s requests=.. qps=..
+p99=..ms`` progress line every ``--progress-secs`` — so latency drift
+over a long run is visible live — ending in the usual summary record.
 
 Answers are accumulated per position (value/remoteness/best of the last
 successful response) and exposed for oracle comparison; ``mismatches``
@@ -232,7 +236,8 @@ def run_load(url: str, positions: list, *, duration: float = 5.0,
              concurrency: int = 4, chunk_size: int = 8,
              timeout: float = 10.0, stop_event=None,
              out_jsonl: str | None = None, dist: str | None = None,
-             mode: str = "post", seed: int = 0) -> dict:
+             mode: str = "post", seed: int = 0,
+             progress_secs: float | None = None, progress=None) -> dict:
     """Drive load; returns the stats record (see module docstring).
 
     positions: ints (or hex strings) assumed PRESENT in the served DB —
@@ -244,6 +249,13 @@ def run_load(url: str, positions: list, *, duration: float = 5.0,
     {trace_id, kind, code, latency_ms, mismatch} — so an outlier seen
     from the CLIENT side can be joined to its server-side sampled trace
     by trace_id (docs/SERVING.md "Debugging a slow query").
+
+    progress_secs: soak mode — every that-many seconds a cumulative
+    progress snapshot ({t_secs, requests, qps, p99_ms, errors, dropped,
+    mismatches}) goes to ``progress`` (a callable; default prints one
+    ``[load_gen]`` line to stderr), so an hours-long run shows drift
+    (a leak, a degrading cache) AS it happens instead of only in the
+    final record.
     """
     url = url.rstrip("/")
     positions = [int(p, 0) if isinstance(p, str) else int(p)
@@ -273,7 +285,34 @@ def run_load(url: str, positions: list, *, duration: float = 5.0,
     t0 = time.perf_counter()
     for t in threads:
         t.start()
-    stop.wait(duration)
+    if progress_secs and progress_secs > 0:
+        emit = progress if progress is not None else _print_progress
+        deadline = t0 + duration
+        while not stop.is_set():
+            now = time.perf_counter()
+            if now >= deadline:
+                break
+            stop.wait(min(float(progress_secs), deadline - now))
+            if stop.is_set() or time.perf_counter() >= deadline:
+                break
+            with stats.lock:
+                lat = sorted(stats.latencies)
+                snap = {
+                    "t_secs": round(time.perf_counter() - t0, 1),
+                    "requests": stats.ok + stats.not_modified + stats.shed
+                    + stats.errors + stats.dropped,
+                    "qps": round(
+                        (stats.ok + stats.not_modified + stats.shed
+                         + stats.errors)
+                        / max(time.perf_counter() - t0, 1e-9), 1),
+                    "p99_ms": round(percentile(lat, 0.99) * 1e3, 3),
+                    "errors": stats.errors,
+                    "dropped": stats.dropped,
+                    "mismatches": stats.mismatches,
+                }
+            emit(snap)
+    else:
+        stop.wait(duration)
     stop.set()
     for t in threads:
         t.join(timeout=timeout + 5)
@@ -311,6 +350,16 @@ def run_load(url: str, positions: list, *, duration: float = 5.0,
     return record
 
 
+def _print_progress(snap: dict) -> None:
+    print(
+        f"[load_gen] t={snap['t_secs']:.0f}s requests={snap['requests']} "
+        f"qps={snap['qps']:.1f} p99={snap['p99_ms']:.1f}ms "
+        f"errors={snap['errors']} dropped={snap['dropped']} "
+        f"mismatches={snap['mismatches']}",
+        file=sys.stderr, flush=True,
+    )
+
+
 def _read_positions(path: str) -> list:
     out = []
     with open(path) as fh:
@@ -331,6 +380,17 @@ def main(argv=None) -> int:
                    help="file of packed positions (decimal or 0x-hex, one "
                    "per line, # comments) known to be in the DB")
     p.add_argument("--duration", type=float, default=5.0)
+    p.add_argument("--duration-secs", type=float, default=None,
+                   metavar="SECS",
+                   help="soak mode: run for this many wall-clock seconds "
+                   "(overrides --duration) with a cumulative progress "
+                   "line every --progress-secs — qps/p99 drift over an "
+                   "hours-long run shows up live, not just in the final "
+                   "summary record")
+    p.add_argument("--progress-secs", type=float, default=5.0,
+                   metavar="SECS",
+                   help="soak progress-line interval (with "
+                   "--duration-secs; default 5)")
     p.add_argument("--concurrency", type=int, default=4)
     p.add_argument("--chunk-size", type=int, default=8,
                    help="positions per request")
@@ -370,12 +430,15 @@ def main(argv=None) -> int:
         print("error: no positions to query", file=sys.stderr)
         return 2
     try:
+        soak = args.duration_secs is not None
         record = run_load(
-            args.url, positions, duration=args.duration,
+            args.url, positions,
+            duration=args.duration_secs if soak else args.duration,
             concurrency=args.concurrency, chunk_size=args.chunk_size,
             timeout=args.timeout, out_jsonl=args.out_jsonl,
             dist=args.dist, mode="get" if args.get else "post",
             seed=args.seed,
+            progress_secs=args.progress_secs if soak else None,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)

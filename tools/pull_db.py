@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Pull DB epochs from a gamesman registry onto this replica.
+
+The replica half of DB distribution (ISSUE 19, docs/SERVING.md): fetch
+the signed catalog, download each requested DB's blocks with resumable
+ranged reads into a quarantine staging dir, verify every byte
+(crc32 + sha256 against the published manifest) BEFORE the atomic
+rename-install, and — with ``--fleet-manifest`` — land the new epochs
+in the fleet manifest and trigger a rolling reload on the serving
+supervisor, which keeps answering from the old epoch until the new one
+passes its admission gate.
+
+    # one-shot: install nim@<epoch> under ./dbs/
+    python tools/pull_db.py http://registry:9200 nim --dest ./dbs
+
+    # replica sync: pull, rewrite fleet manifest, rolling-reload
+    python tools/pull_db.py http://registry:9200 nim subtract \
+        --dest ./dbs --fleet-manifest fleet.json \
+        --control-url http://127.0.0.1:9100
+
+Returns 0 on success, 1 when any pull or the reload failed (the fleet
+is left serving its old epoch), 2 on usage errors. Interrupted runs are
+safe to re-run: verified staged bytes are resumed, not re-fetched.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from gamesmanmpi_tpu.registry.pull import (  # noqa: E402
+    PullError,
+    ensure_db,
+    pull_db,
+    sync_fleet,
+)
+
+
+def _log(record):
+    sys.stderr.write(json.dumps(record, default=str) + "\n")
+    sys.stderr.flush()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="pull verified DB epochs from a gamesman registry",
+    )
+    ap.add_argument("registry", help="registry base URL, e.g. http://host:9200")
+    ap.add_argument("names", nargs="+", help="DB names to pull")
+    ap.add_argument("--dest", default="dbs",
+                    help="install root; DBs land as <dest>/<name>@<epoch>")
+    ap.add_argument("--fleet-manifest", default=None,
+                    help="fleet manifest to rewrite with the pulled epochs")
+    ap.add_argument("--control-url", default=None,
+                    help="supervisor control URL to POST /reload after a "
+                         "manifest landing (requires --fleet-manifest)")
+    ap.add_argument("--solve", metavar="SPEC", default=None,
+                    help="if the (single) name is not in the catalog, queue "
+                         "a solve-on-demand job for this game spec instead "
+                         "of failing")
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="per-request timeout (default "
+                         "GAMESMAN_REGISTRY_TIMEOUT_SECS)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the result record as JSON on stdout")
+    args = ap.parse_args(argv)
+
+    if args.control_url and not args.fleet_manifest:
+        ap.error("--control-url requires --fleet-manifest")
+    if args.solve and len(args.names) != 1:
+        ap.error("--solve takes exactly one name")
+
+    try:
+        if args.fleet_manifest:
+            result = sync_fleet(
+                args.registry, args.names, args.fleet_manifest, args.dest,
+                control_url=args.control_url, timeout=args.timeout,
+                log=_log,
+            )
+            ok = result["status"] in ("rolled", "manifest_landed") or (
+                result["status"] == "nothing_pulled" and not result["failed"]
+            )
+        elif args.solve:
+            result = ensure_db(
+                args.registry, args.names[0], spec=args.solve,
+                dest_root=args.dest, timeout=args.timeout, log=_log,
+            )
+            ok = True
+        else:
+            pulls = []
+            ok = True
+            for name in args.names:
+                try:
+                    pulls.append(
+                        pull_db(args.registry, name, args.dest,
+                                timeout=args.timeout, log=_log)
+                    )
+                except PullError as e:
+                    _log({"phase": "registry_pull", "name": name,
+                          "error": str(e)})
+                    ok = False
+            result = {"pulled": pulls}
+    except PullError as e:
+        _log({"phase": "registry_pull", "error": str(e)})
+        result, ok = {"error": str(e)}, False
+
+    if args.json:
+        print(json.dumps(result, indent=2, default=str))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
