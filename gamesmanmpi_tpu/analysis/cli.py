@@ -17,6 +17,38 @@ from gamesmanmpi_tpu.analysis.runner import run_project
 DEFAULT_BASELINE = "lint_baseline.json"
 
 
+def to_sarif(result) -> dict:
+    """Minimal SARIF 2.1.0 log for CI annotation. Only *new* findings
+    become results — baselined/suppressed dispositions stay a
+    gamesman-lint concept; exit-code semantics are unchanged."""
+    rule_ids = sorted({d.id for d in result.new})
+    return {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "gamesman-lint",
+                "informationUri": "docs/ANALYSIS.md",
+                "rules": [{"id": rid} for rid in rule_ids],
+            }},
+            "results": [{
+                "ruleId": d.id,
+                "level": "error",
+                "message": {"text": d.message},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": d.path},
+                        "region": {"startLine": d.line},
+                    },
+                }],
+            } for d in result.new],
+        }],
+    }
+
+
 def _changed_lint_targets(root: str, base_ref: str) -> list:
     """Root-relative paths of lint-scope files changed vs ``base_ref``
     (committed diffs + working tree + untracked). Raises RuntimeError
@@ -97,7 +129,7 @@ def main(argv=None) -> int:
         help="base ref for --changed-only (default: HEAD)",
     )
     ap.add_argument(
-        "--format", choices=("text", "json"), default="text",
+        "--format", choices=("text", "json", "sarif"), default="text",
         help="diagnostic output format",
     )
     ap.add_argument(
@@ -169,7 +201,9 @@ def main(argv=None) -> int:
         )
         return 0
 
-    if args.format == "json":
+    if args.format == "sarif":
+        print(json.dumps(to_sarif(result), indent=2))
+    elif args.format == "json":
         payload = {
             "new": [d.to_json() for d in result.new],
             "baselined": [d.to_json() for d in result.baselined],

@@ -26,6 +26,7 @@ from gamesmanmpi_tpu.analysis import (
     metrics_parity,
     spans_parity,
     spmd,
+    wire,
 )
 from gamesmanmpi_tpu.analysis.diagnostics import (
     Diagnostic,
@@ -50,6 +51,7 @@ CHECKERS = (
     lifecycle.check,
     atomic_write.check,
     gamespec.check,
+    wire.check,
 )
 
 

@@ -1,0 +1,1111 @@
+"""gamesman-lint GM10xx: whole-fleet wire-contract analysis.
+
+The fleet speaks hand-written HTTP/TCP — query server, supervisor
+control port, DB registry, per-rank status servers, coordination
+barriers — with no type system between client and server call sites.
+This family extracts both halves of the contract statically and checks
+them against each other:
+
+* **server side** — every ``BaseHTTPRequestHandler`` subclass yields a
+  route table (string compares / ``startswith`` on the request path in
+  each ``do_*`` dispatch closure), the status codes it emits (constant
+  first args to the ``_send*``/``send_response`` helpers), the response
+  headers it sets, and the JSON payload keys it produces (dict
+  literals). The coordination server contributes its ``op`` vocabulary
+  (``req.get("op") == "..."`` compares).
+* **client side** — every ``urlopen``/``http.client``/
+  ``create_connection`` call site (and every call into a *wire-fetch*
+  wrapper: a function whose body contains both an outbound primitive
+  and ``json.loads``), with method, extractable path constants, status
+  codes branched on (``e.code``/``resp.status`` compares), JSON keys
+  consumed (subscript/`.get` reads on names fed from the wire), and
+  timeout arguments.
+
+| id     | finding                                                     |
+|--------|-------------------------------------------------------------|
+| GM1001 | client route/method (or coordination op) no server defines  |
+| GM1002 | status-code parity: client branches on a code no server     |
+|        | emits / server sheds 304/429/503 no client handles          |
+| GM1003 | outbound network call without an explicit finite timeout    |
+| GM1004 | declared response-header contract violated (``# wire:``)    |
+| GM1005 | cross-process JSON key parity: a consumed key no producer   |
+|        | ever writes                                                 |
+| GM1006 | endpoint docs parity: route undocumented in the             |
+|        | SERVING.md/OBSERVABILITY.md endpoint tables, or a           |
+|        | documented endpoint no server defines                       |
+
+The ``# wire:`` annotation convention (placed on the ``class``/``def``
+line or the comment line above, like ``# guarded-by:``):
+
+* on a handler class — response-header rules the class promises:
+  ``etag-cache-control`` (any response carrying ``ETag`` must carry
+  ``Cache-Control``), ``503-retry-after`` / ``429-retry-after`` (shed
+  responses must carry ``Retry-After``), ``echo-traceparent`` (the
+  class echoes the request's ``traceparent``).
+* on a function — wire roles the extractor cannot infer:
+  ``producer`` (its dict literals / ``.send(**kw)`` keys cross a
+  process boundary), ``consumer`` (its parameters and
+  ``json.loads`` reads come off the wire), ``fetch`` (returns a wire-decoded dict; callers'
+  assignments from it are tracked like ``json.loads``).
+
+Deliberate narrowness (false negatives over false positives): paths
+are only extracted where a ``/``-leading string constant is visible in
+the URL expression; key consumption is only tracked through direct
+assignments/loops from ``json.loads``/wire-fetch calls (a read through
+``retry_call(lambda: ...)`` is invisible); coordination ``op`` literals
+are only collected from modules that open sockets themselves (the job
+ledger's ``{"op": ...}`` records never touch the network).
+
+GM1004/GM1005/GM1006 checks are *opt-in by evidence*: with no handler
+classes there is no route table to check against, with no producers no
+key pool, with no endpoint-table rows no docs contract — the checkers
+stay silent rather than guess.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from gamesmanmpi_tpu.analysis.diagnostics import Diagnostic, directive_lines
+from gamesmanmpi_tpu.analysis.project import (
+    Project,
+    SourceFile,
+    attr_chain,
+)
+
+_WIRE_RE = re.compile(r"#\s*wire:\s*([A-Za-z0-9_,\- ]+)")
+
+#: Header rules a handler class may declare.
+HANDLER_RULES = frozenset(
+    {"etag-cache-control", "503-retry-after", "429-retry-after",
+     "echo-traceparent"}
+)
+#: Role tokens a function may declare.
+ROLE_TOKENS = frozenset({"producer", "consumer", "fetch"})
+
+#: Response-emitting call names inside handler classes. The leading-
+#: underscore names are the repo's send helpers (serve/server.py
+#: idiom); the bare ones are the stdlib API itself.
+_SEND_FINALS = frozenset(
+    {"_send_json", "_send_text", "_send_status", "_send",
+     "send_response", "send_error"}
+)
+#: Outbound primitives GM1003 demands an explicit timeout on, mapped to
+#: the positional index their ``timeout`` parameter lives at.
+_PRIMITIVES = {
+    "urlopen": 2,  # urlopen(url, data=None, timeout=...)
+    "create_connection": 1,  # create_connection(address, timeout=...)
+    "HTTPConnection": 2,  # HTTPConnection(host, port=None, timeout=...)
+    "HTTPSConnection": 2,
+}
+#: Codes the stdlib http.server machinery emits on its own (malformed
+#: request line, oversized headers, unsupported method/version) — part
+#: of every handler's de-facto contract even though no dispatch source
+#: line mentions them.
+IMPLICIT_CODES = frozenset({400, 408, 414, 431, 501, 505})
+#: Server-initiated backpressure/staleness codes a fleet client must
+#: understand (GM1002's server->client direction).
+_SHED_CODES = (304, 429, 503)
+
+_HTTP_VERBS = frozenset({"GET", "POST", "PUT", "DELETE", "HEAD", "PATCH"})
+
+
+# --------------------------------------------------------------- helpers
+
+
+def _const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _const_int(node) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+def _final(call: ast.Call) -> str:
+    chain = attr_chain(call.func)
+    return chain[-1] if chain else ""
+
+
+def _is_json_loads(call: ast.Call) -> bool:
+    chain = attr_chain(call.func)
+    return bool(chain) and chain[-1] == "loads"
+
+
+def _dict_keys(node: ast.Dict) -> Set[str]:
+    out: Set[str] = set()
+    for k in node.keys:
+        s = _const_str(k) if k is not None else None
+        if s is not None:
+            out.add(s)
+    return out
+
+
+def _wire_tokens(lines: List[str], lineno: int) -> Optional[List[str]]:
+    """``# wire:`` tokens attached to a def/class line, or None."""
+    for text in directive_lines(lines, lineno):
+        m = _WIRE_RE.search(text)
+        if m:
+            return [t for t in re.split(r"[,\s]+", m.group(1).strip())
+                    if t]
+    return None
+
+
+# ------------------------------------------------------ server extraction
+
+
+class ServerClass:
+    """The statically extracted contract of one handler class."""
+
+    def __init__(self, rel: str, name: str, line: int):
+        self.rel = rel
+        self.name = name
+        self.line = line
+        #: (method, path, is_prefix) -> first source line.
+        self.routes: Dict[Tuple[str, str, bool], int] = {}
+        #: emitted status code -> first source line.
+        self.codes: Dict[int, int] = {}
+        #: a dispatch method passes a non-constant code to a send
+        #: helper — the code set is open, skip emitted-code checks.
+        self.open_codes = False
+        #: ``send_header("Name", ...)`` literals anywhere in the class,
+        #: lowercased.
+        self.header_names: Set[str] = set()
+        #: ``# wire:`` rule tokens on the class.
+        self.rules: Set[str] = set()
+        #: (line, code-or-None, header-keys-or-None) per send call; the
+        #: header set is None when a non-literal ``headers=`` argument
+        #: could not be resolved to a dict literal.
+        self.send_sites: List[Tuple[int, Optional[int],
+                                    Optional[Set[str]]]] = []
+        #: JSON keys this class writes (dict literals + subscript
+        #: assignments anywhere in its body).
+        self.produced: Set[str] = set()
+        #: every dict literal in the class, for etag-cache-control.
+        self.dicts: List[Tuple[int, Set[str]]] = []
+
+
+def _class_methods(cls: ast.ClassDef) -> Dict[str, ast.FunctionDef]:
+    out: Dict[str, ast.FunctionDef] = {}
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = node
+    return out
+
+
+def _dispatch_closure(methods: Dict[str, ast.FunctionDef],
+                      entry: str) -> List[ast.FunctionDef]:
+    """``entry`` plus every same-class method reachable through
+    ``self.<name>`` references (calls AND callback mentions — the
+    ``_run_traced(self._handle_post)`` shape)."""
+    seen = {entry}
+    queue = [entry]
+    while queue:
+        fn = methods.get(queue.pop())
+        if fn is None:
+            continue
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr in methods
+                and node.attr not in seen
+            ):
+                seen.add(node.attr)
+                queue.append(node.attr)
+    return [methods[n] for n in seen if n in methods]
+
+
+def _routes_in(fn: ast.FunctionDef) -> List[Tuple[str, bool, int]]:
+    """(path, is_prefix, line) for every request-path compare in fn."""
+    out = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                and isinstance(node.ops[0], ast.Eq):
+            for side in (node.left, node.comparators[0]):
+                s = _const_str(side)
+                if s is not None and s.startswith("/"):
+                    out.append((s.partition("?")[0], False, node.lineno))
+        elif isinstance(node, ast.Call) and node.args \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "startswith":
+            s = _const_str(node.args[0])
+            if s is not None and s.startswith("/"):
+                out.append((s.partition("?")[0], True, node.lineno))
+    return out
+
+
+def _send_headers(call: ast.Call,
+                  enclosing: ast.FunctionDef) -> Optional[Set[str]]:
+    """Lowercased header names a send call attaches: the ``headers=``
+    dict literal, a same-function name assigned a dict literal, or the
+    third positional arg of ``_send_status(code, headers)``. Returns an
+    empty set when no headers argument exists, None when one exists but
+    cannot be resolved to a literal."""
+    hdr_expr = None
+    for kw in call.keywords:
+        if kw.arg == "headers":
+            hdr_expr = kw.value
+    if hdr_expr is None and _final(call) == "_send_status" \
+            and len(call.args) >= 2:
+        hdr_expr = call.args[1]
+    if hdr_expr is None:
+        return set()
+    if isinstance(hdr_expr, ast.Dict):
+        return {k.lower() for k in _dict_keys(hdr_expr)}
+    if isinstance(hdr_expr, ast.Name):
+        for node in ast.walk(enclosing):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == hdr_expr.id
+                and isinstance(node.value, ast.Dict)
+            ):
+                return {k.lower() for k in _dict_keys(node.value)}
+    return None
+
+
+def _subscript_assign_keys(scope: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    s = _const_str(t.slice)
+                    if s is not None:
+                        out.add(s)
+    return out
+
+
+def extract_server_classes(tree: ast.AST, lines: List[str],
+                           rel: str) -> List[ServerClass]:
+    """Every ``BaseHTTPRequestHandler`` subclass in ``tree`` with its
+    extracted contract. Pure AST — reused by the runtime witness
+    (analysis/wirecheck.py), which must not load the whole project."""
+    out: List[ServerClass] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        is_handler = any(
+            (chain := attr_chain(base)) is not None
+            and chain[-1] == "BaseHTTPRequestHandler"
+            for base in node.bases
+        )
+        if not is_handler:
+            continue
+        sc = ServerClass(rel, node.name, node.lineno)
+        tokens = _wire_tokens(lines, node.lineno)
+        if tokens:
+            sc.rules = set(tokens)
+        methods = _class_methods(node)
+        for name, fn in methods.items():
+            if name.startswith("do_"):
+                verb = name[3:].upper()
+                for member in _dispatch_closure(methods, name):
+                    for path, prefix, line in _routes_in(member):
+                        sc.routes.setdefault((verb, path, prefix), line)
+        for name, fn in methods.items():
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                final = _final(sub)
+                if final == "send_header" and sub.args:
+                    s = _const_str(sub.args[0])
+                    if s is not None:
+                        sc.header_names.add(s.lower())
+                if final not in _SEND_FINALS:
+                    continue
+                arg0 = sub.args[0] if sub.args else None
+                codes: List[int] = []
+                if isinstance(arg0, ast.IfExp):
+                    for branch in (arg0.body, arg0.orelse):
+                        c = _const_int(branch)
+                        if c is not None:
+                            codes.append(c)
+                else:
+                    c = _const_int(arg0)
+                    if c is not None:
+                        codes.append(c)
+                if codes:
+                    for c in codes:
+                        sc.codes.setdefault(c, sub.lineno)
+                    sc.send_sites.append(
+                        (sub.lineno, codes[0], _send_headers(sub, fn))
+                    )
+                elif name not in _SEND_FINALS:
+                    # A dispatch method forwarding a computed code: the
+                    # emitted-code set is open. (The same shape inside a
+                    # ``_send*`` helper is just the forwarding itself.)
+                    sc.open_codes = True
+        sc.produced |= _subscript_assign_keys(node)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Dict):
+                keys = _dict_keys(sub)
+                sc.produced |= keys
+                if keys:
+                    sc.dicts.append((sub.lineno, {k.lower()
+                                                  for k in keys}))
+        out.append(sc)
+    return out
+
+
+# ------------------------------------------------------ client extraction
+
+
+class ClientCall:
+    def __init__(self, rel: str, line: int, method: str, path: str,
+                 prefix: bool):
+        self.rel = rel
+        self.line = line
+        self.method = method
+        self.path = path
+        self.prefix = prefix
+
+
+def _url_pieces(expr) -> List[Tuple[str, Optional[str]]]:
+    if isinstance(expr, ast.JoinedStr):
+        out: List[Tuple[str, Optional[str]]] = []
+        for v in expr.values:
+            s = _const_str(v)
+            out.append(("const", s) if s is not None else ("var", None))
+        return out
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        return _url_pieces(expr.left) + _url_pieces(expr.right)
+    s = _const_str(expr)
+    if s is not None:
+        return [("const", s)]
+    return [("var", None)]
+
+
+def _path_from_url(expr) -> Optional[Tuple[str, bool]]:
+    """(path, is_prefix) from a URL expression, or None when no
+    ``/``-leading path constant is visible."""
+    if expr is None:
+        return None
+    pieces = _url_pieces(expr)
+    for i, (kind, text) in enumerate(pieces):
+        if kind != "const" or text is None:
+            continue
+        if "://" in text:
+            after = text.split("://", 1)[1]
+            slash = after.find("/")
+            if slash < 0:
+                continue  # scheme+host piece only; path comes later
+            text = after[slash:]
+        elif not text.startswith("/"):
+            continue
+        path, q, _rest = text.partition("?")
+        prefix = not q and any(k == "var" for k, _ in pieces[i + 1:])
+        return path, prefix
+    return None
+
+
+def _request_method(call: ast.Call) -> str:
+    """Method of a ``urllib.request.Request(...)`` constructor."""
+    for kw in call.keywords:
+        if kw.arg == "method":
+            s = _const_str(kw.value)
+            if s is not None:
+                return s.upper()
+        if kw.arg == "data" and not (
+            isinstance(kw.value, ast.Constant) and kw.value.value is None
+        ):
+            return "POST"
+    if len(call.args) >= 2:
+        return "POST"
+    return "GET"
+
+
+class _FnInfo:
+    """Per-function wire facts gathered in one walk."""
+
+    def __init__(self, src: SourceFile, qualname: str, node,
+                 lint_scope: bool):
+        self.src = src
+        self.qualname = qualname
+        self.node = node
+        self.lint_scope = lint_scope
+        self.tokens = _wire_tokens(src.lines, node.lineno) or []
+        self.has_primitive = False
+        self.has_loads = False
+        self.request_method = "GET"
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                final = _final(sub)
+                if final in _PRIMITIVES:
+                    self.has_primitive = True
+                if final == "Request":
+                    self.request_method = _request_method(sub)
+                if _is_json_loads(sub):
+                    self.has_loads = True
+
+    @property
+    def is_fetch(self) -> bool:
+        return "fetch" in self.tokens or (
+            self.has_primitive and self.has_loads
+        )
+
+
+class _Extraction:
+    """Everything GM1001-GM1006 consume, built in one project pass."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.servers: List[ServerClass] = []
+        self.clients: List[ClientCall] = []
+        #: (code, exact, rel, line) for client `e.code`/`resp.status`
+        #: compares. ``exact`` False = a ``>=`` open range.
+        self.client_codes: List[Tuple[int, bool, str, int]] = []
+        self.produced: Set[str] = set()
+        #: (key, rel, line) consumed reads.
+        self.consumed: List[Tuple[str, str, int]] = []
+        self.coord_server_ops: Set[str] = set()
+        #: (op, rel, line) dict-literal ops from socket modules.
+        self.coord_client_ops: List[Tuple[str, str, int]] = []
+        self.bad_tokens: List[Diagnostic] = []
+        self._fns: Dict[str, _FnInfo] = {}  # "rel::qualname" -> info
+        self._module_fns: Dict[str, Dict[str, str]] = {}
+        self._build()
+
+    # -- function index -------------------------------------------------
+
+    def _iter_defs(self, src: SourceFile):
+        """(qualname, class name, node) for every def, the call-graph
+        registration order (collect_only files are not in the call
+        graph, so the walk is done locally)."""
+
+        def visit(body, prefix, cls):
+            stack = list(body)
+            while stack:
+                node = stack.pop(0)
+                if isinstance(node, ast.ClassDef):
+                    visit(node.body, f"{prefix}{node.name}.", node.name)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{node.name}"
+                    yield_list.append((qual, cls, node))
+                    visit(node.body, f"{qual}.", cls)
+                else:
+                    stack.extend(
+                        c for c in ast.iter_child_nodes(node)
+                        if isinstance(c, ast.stmt)
+                    )
+
+        yield_list: list = []
+        visit(src.tree.body, "", None)
+        return yield_list
+
+    def _build(self) -> None:
+        project = self.project
+        cg = project.callgraph()  # shared, memoized (built exactly once)
+        handlers_by_rel: Dict[str, Set[str]] = {}
+        sources = [(s, True) for s in project.files] + [
+            (s, False) for s in project.collect_only
+        ]
+        for src, lint_scope in sources:
+            if src.tree is None:
+                continue
+            classes = extract_server_classes(src.tree, src.lines, src.rel)
+            self.servers.extend(classes)
+            handlers_by_rel[src.rel] = {c.name for c in classes}
+            for qual, cls, node in self._iter_defs(src):
+                info = _FnInfo(src, qual, node, lint_scope)
+                self._fns[f"{src.rel}::{qual}"] = info
+                self._module_fns.setdefault(src.rel, {})[qual] = (
+                    f"{src.rel}::{qual}"
+                )
+            self._collect_module(src, handlers_by_rel[src.rel])
+        self._cg = cg
+        for key, info in self._fns.items():
+            self._collect_fn(key, info,
+                             handlers_by_rel.get(info.src.rel, set()))
+        self._collect_annotation_errors(handlers_by_rel)
+
+    # -- resolution -----------------------------------------------------
+
+    def _resolve_fetch(self, info: _FnInfo,
+                       call: ast.Call) -> Optional[_FnInfo]:
+        chain = attr_chain(call.func)
+        if not chain:
+            return None
+        src = info.src
+        key = None
+        if info.lint_scope:
+            key = self._cg.resolve(src, info.qualname.split("."),
+                                   list(chain))
+        if key is None:
+            # collect-only files (and anything the call graph cannot
+            # see): local top-level names + from-imports.
+            if len(chain) == 1:
+                key = self._module_fns.get(src.rel, {}).get(chain[0])
+                if key is None:
+                    frm = self._from_imports(src).get(chain[0])
+                    if frm is not None:
+                        mod, attr = frm
+                        rel = self._module_rel(mod)
+                        if rel is not None:
+                            key = self._module_fns.get(rel, {}).get(attr)
+            elif chain[0] in ("self", "cls") and len(chain) == 2:
+                for qual, fkey in self._module_fns.get(src.rel,
+                                                       {}).items():
+                    if qual.endswith(f".{chain[1]}"):
+                        key = fkey
+                        break
+        if key is None:
+            return None
+        target = self._fns.get(key)
+        return target if target is not None and target.is_fetch else None
+
+    def _from_imports(self, src: SourceFile) -> Dict[str, tuple]:
+        cache = getattr(src, "_wire_from_imports", None)
+        if cache is None:
+            cache = {}
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.ImportFrom) and node.module \
+                        and node.level == 0:
+                    for alias in node.names:
+                        cache[alias.asname or alias.name] = (
+                            node.module, alias.name
+                        )
+            src._wire_from_imports = cache  # type: ignore[attr-defined]
+        return cache
+
+    def _module_rel(self, dotted: str) -> Optional[str]:
+        rel = dotted.replace(".", "/") + ".py"
+        if rel in self._module_fns:
+            return rel
+        init = dotted.replace(".", "/") + "/__init__.py"
+        return init if init in self._module_fns else None
+
+    # -- per-module facts ----------------------------------------------
+
+    def _collect_module(self, src: SourceFile,
+                        handler_names: Set[str]) -> None:
+        has_socket = False
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call):
+                final = _final(node)
+                if final in ("create_connection", "socket"):
+                    has_socket = True
+                # coordination server vocabulary: X.get("op") == "lit"
+            if isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                    and isinstance(node.ops[0], (ast.Eq, ast.NotEq)):
+                for a, b in ((node.left, node.comparators[0]),
+                             (node.comparators[0], node.left)):
+                    if (
+                        isinstance(a, ast.Call)
+                        and isinstance(a.func, ast.Attribute)
+                        and a.func.attr == "get"
+                        and a.args
+                        and _const_str(a.args[0]) == "op"
+                    ):
+                        s = _const_str(b)
+                        if s is not None:
+                            self.coord_server_ops.add(s)
+                # client status-code branches: e.code / resp.status
+                chain = attr_chain(node.left)
+                if chain and len(chain) >= 2 \
+                        and chain[-1] in ("code", "status"):
+                    c = _const_int(node.comparators[0])
+                    if c is not None:
+                        self.client_codes.append(
+                            (c, True, src.rel, node.lineno)
+                        )
+            elif isinstance(node, ast.Compare) and len(node.ops) == 1:
+                chain = attr_chain(node.left)
+                if chain and len(chain) >= 2 \
+                        and chain[-1] in ("code", "status"):
+                    op, comp = node.ops[0], node.comparators[0]
+                    if isinstance(op, ast.GtE):
+                        c = _const_int(comp)
+                        if c is not None:
+                            self.client_codes.append(
+                                (c, False, src.rel, node.lineno)
+                            )
+                    elif isinstance(op, ast.In) \
+                            and isinstance(comp, (ast.Tuple, ast.Set,
+                                                  ast.List)):
+                        for elt in comp.elts:
+                            c = _const_int(elt)
+                            if c is not None:
+                                self.client_codes.append(
+                                    (c, True, src.rel, node.lineno)
+                                )
+        if has_socket:
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Dict):
+                    for k, v in zip(node.keys, node.values):
+                        if k is not None and _const_str(k) == "op":
+                            s = _const_str(v)
+                            if s is not None:
+                                self.coord_client_ops.append(
+                                    (s, src.rel, node.lineno)
+                                )
+
+    # -- per-function facts --------------------------------------------
+
+    def _collect_fn(self, key: str, info: _FnInfo,
+                    handler_names: Set[str]) -> None:
+        fetch_calls: List[ast.Call] = []
+        fetch_of: Dict[ast.Call, _FnInfo] = {}
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Call):
+                target = self._resolve_fetch(info, node)
+                if target is not None:
+                    fetch_calls.append(node)
+                    fetch_of[node] = target
+        in_handler = info.qualname.split(".")[0] in handler_names
+        is_producer = (
+            in_handler or info.has_primitive or bool(fetch_calls)
+            or "producer" in info.tokens
+        )
+        is_consumer = (
+            in_handler or info.has_primitive or bool(fetch_calls)
+            or "consumer" in info.tokens
+        )
+        if is_producer and not in_handler:
+            # handler classes pool their keys via extract_server_classes
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.Dict):
+                    self.produced |= _dict_keys(node)
+                elif isinstance(node, ast.Call):
+                    chain = attr_chain(node.func)
+                    if chain and chain[-1] == "send":
+                        for kw in node.keywords:
+                            if kw.arg:
+                                self.produced.add(kw.arg)
+            self.produced |= _subscript_assign_keys(info.node)
+        if is_consumer:
+            self._collect_consumption(info, fetch_of)
+        self._collect_routes(info, fetch_of)
+
+    def _collect_routes(self, info: _FnInfo,
+                        fetch_of: Dict[ast.Call, _FnInfo]) -> None:
+        seen: Set[int] = set()
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call) or node.lineno in seen:
+                continue
+            final = _final(node)
+            url_expr = None
+            method = None
+            if final == "urlopen" and node.args:
+                url_expr = node.args[0]
+                method = "GET"
+                if isinstance(url_expr, ast.Name):
+                    req = self._local_request(info.node, url_expr.id)
+                    if req is not None:
+                        method = _request_method(req)
+                        url_expr = req.args[0] if req.args else None
+                elif isinstance(url_expr, ast.Call) \
+                        and _final(url_expr) == "Request":
+                    method = _request_method(url_expr)
+                    url_expr = (url_expr.args[0] if url_expr.args
+                                else None)
+            elif node in fetch_of:
+                url_expr = node.args[0] if node.args else None
+                method = fetch_of[node].request_method
+            elif final == "request" and len(node.args) >= 2:
+                verb = _const_str(node.args[0])
+                if verb is not None and verb.upper() in _HTTP_VERBS:
+                    method = verb.upper()
+                    url_expr = node.args[1]
+            if url_expr is None or method is None:
+                continue
+            got = _path_from_url(url_expr)
+            if got is None:
+                continue
+            path, prefix = got
+            seen.add(node.lineno)
+            self.clients.append(
+                ClientCall(info.src.rel, node.lineno, method, path,
+                           prefix)
+            )
+
+    @staticmethod
+    def _local_request(fn, name: str) -> Optional[ast.Call]:
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name
+                and isinstance(node.value, ast.Call)
+                and _final(node.value) == "Request"
+            ):
+                return node.value
+        return None
+
+    def _collect_consumption(self, info: _FnInfo,
+                             fetch_of: Dict[ast.Call, _FnInfo]) -> None:
+        wire_names: Set[str] = set()
+        if "consumer" in info.tokens:
+            # An annotated consumer's parameters ARE the wire payload
+            # (the supervisor's _on_msg(slot, msg, now) shape, where
+            # json.loads happens in the read loop one frame up).
+            args = info.node.args
+            for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                if a.arg not in ("self", "cls"):
+                    wire_names.add(a.arg)
+
+        def is_wire(expr) -> bool:
+            if isinstance(expr, ast.Name):
+                return expr.id in wire_names
+            if isinstance(expr, ast.Call):
+                if _is_json_loads(expr) or expr in fetch_of:
+                    return True
+                # w.get("k") chains stay on the wire
+                if isinstance(expr.func, ast.Attribute) \
+                        and expr.func.attr == "get":
+                    return is_wire(expr.func.value)
+                return False
+            if isinstance(expr, ast.Subscript):
+                return is_wire(expr.value)
+            return False
+
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(info.node):
+                tgt = val = None
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    tgt, val = node.targets[0].id, node.value
+                elif isinstance(node, (ast.For, ast.comprehension)):
+                    t = node.target
+                    if isinstance(t, ast.Name):
+                        tgt, val = t.id, node.iter
+                if tgt is None or tgt in wire_names or val is None:
+                    continue
+                if is_wire(val):
+                    wire_names.add(tgt)
+                    changed = True
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and is_wire(node.value):
+                s = _const_str(node.slice)
+                if s is not None:
+                    self.consumed.append((s, info.src.rel, node.lineno))
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "get" and node.args \
+                    and is_wire(node.func.value):
+                s = _const_str(node.args[0])
+                if s is not None:
+                    self.consumed.append((s, info.src.rel, node.lineno))
+
+    # -- annotation validation -----------------------------------------
+
+    def _collect_annotation_errors(
+            self, handlers_by_rel: Dict[str, Set[str]]) -> None:
+        for src, lint_scope in [(s, True) for s in self.project.files]:
+            if src.tree is None:
+                continue
+            handler_names = handlers_by_rel.get(src.rel, set())
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.ClassDef):
+                    allowed = (
+                        HANDLER_RULES | {"producer", "consumer"}
+                        if node.name in handler_names else ROLE_TOKENS
+                    )
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    allowed = ROLE_TOKENS
+                else:
+                    continue
+                tokens = _wire_tokens(src.lines, node.lineno)
+                for t in tokens or []:
+                    if t not in allowed:
+                        self.bad_tokens.append(Diagnostic(
+                            src.rel, node.lineno, "GM1004",
+                            f"unknown or misplaced '# wire:' token "
+                            f"{t!r} (allowed here: "
+                            f"{', '.join(sorted(allowed))})",
+                        ))
+
+
+# ----------------------------------------------------------- docs tables
+
+_DOC_ROW_RE = re.compile(
+    r"^\s*\|\s*(GET|POST|PUT|DELETE|HEAD|PATCH)\s*\|\s*([^|]+)\|"
+)
+
+
+def _doc_rows(text: str, rel: str) -> List[Tuple[str, str, bool, str,
+                                                 int]]:
+    """(method, path, is_prefix, rel, line) per endpoint-table row."""
+    out = []
+    for i, line in enumerate(text.splitlines(), 1):
+        m = _DOC_ROW_RE.match(line)
+        if not m:
+            continue
+        cell = m.group(2).strip().strip("`").strip()
+        if not cell.startswith("/"):
+            continue
+        cut = cell.find("<")
+        if cut >= 0:
+            out.append((m.group(1), cell[:cut], True, rel, i))
+        else:
+            out.append((m.group(1), cell, False, rel, i))
+    return out
+
+
+def _paths_overlap(p1: str, pre1: bool, p2: str, pre2: bool) -> bool:
+    if not pre1 and not pre2:
+        return p1 == p2
+    if pre1 and not pre2:
+        return p2.startswith(p1)
+    if pre2 and not pre1:
+        return p1.startswith(p2)
+    return p1.startswith(p2) or p2.startswith(p1)
+
+
+# --------------------------------------------------------------- checkers
+
+
+def _check_routes(ex: _Extraction) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    if ex.servers:
+        table = [
+            (method, path, prefix)
+            for sc in ex.servers
+            for (method, path, prefix) in sc.routes
+        ]
+        for call in ex.clients:
+            ok = any(
+                call.method == m
+                and _paths_overlap(call.path, call.prefix, p, pre)
+                for (m, p, pre) in table
+            )
+            if not ok:
+                diags.append(Diagnostic(
+                    call.rel, call.line, "GM1001",
+                    f"client calls {call.method} "
+                    f"{call.path}{'...' if call.prefix else ''} but no "
+                    f"server defines that route/method",
+                ))
+    if ex.coord_server_ops:
+        seen: Set[Tuple[str, str, int]] = set()
+        for op, rel, line in ex.coord_client_ops:
+            if op not in ex.coord_server_ops \
+                    and (op, rel, line) not in seen:
+                seen.add((op, rel, line))
+                diags.append(Diagnostic(
+                    rel, line, "GM1001",
+                    f"wire op {op!r} is sent but no coordination "
+                    f"server compares against it",
+                ))
+    return diags
+
+
+def _check_status_parity(ex: _Extraction) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    if not ex.servers:
+        return diags
+    emitted: Set[int] = set()
+    any_open = False
+    for sc in ex.servers:
+        emitted |= set(sc.codes)
+        any_open = any_open or sc.open_codes
+    emitted |= IMPLICIT_CODES
+    if not any_open:
+        seen: Set[Tuple[str, int, int]] = set()
+        for code, exact, rel, line in ex.client_codes:
+            if exact and code not in emitted \
+                    and (rel, line, code) not in seen:
+                seen.add((rel, line, code))
+                diags.append(Diagnostic(
+                    rel, line, "GM1002",
+                    f"client branches on HTTP {code}, which no server "
+                    f"ever emits",
+                ))
+    if ex.clients and ex.client_codes:
+        exacts = {c for c, exact, _r, _l in ex.client_codes if exact}
+        floors = [c for c, exact, _r, _l in ex.client_codes
+                  if not exact]
+        for shed in _SHED_CODES:
+            handled = shed in exacts or any(shed >= f for f in floors)
+            if handled:
+                continue
+            for sc in ex.servers:
+                if shed in sc.codes:
+                    diags.append(Diagnostic(
+                        sc.rel, sc.codes[shed], "GM1002",
+                        f"server emits HTTP {shed} but no client "
+                        f"branches on it (unhandled-error path)",
+                    ))
+                    break
+    return diags
+
+
+def _check_timeouts(project: Project) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for src in list(project.files) + list(project.collect_only):
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            final = _final(node)
+            if final not in _PRIMITIVES:
+                continue
+            timeout = None
+            for kw in node.keywords:
+                if kw.arg == "timeout":
+                    timeout = kw.value
+            pos = _PRIMITIVES[final]
+            if timeout is None and len(node.args) > pos:
+                timeout = node.args[pos]
+            if timeout is None or (
+                isinstance(timeout, ast.Constant)
+                and timeout.value is None
+            ):
+                diags.append(Diagnostic(
+                    src.rel, node.lineno, "GM1003",
+                    f"outbound {final}() without an explicit finite "
+                    f"timeout — a dead peer hangs this call forever",
+                ))
+    return diags
+
+
+def _check_headers(ex: _Extraction) -> List[Diagnostic]:
+    diags = list(ex.bad_tokens)
+    for sc in ex.servers:
+        rules = sc.rules & HANDLER_RULES
+        if not rules:
+            continue
+        for rule, code in (("503-retry-after", 503),
+                           ("429-retry-after", 429)):
+            if rule not in rules:
+                continue
+            for line, sent, headers in sc.send_sites:
+                if sent != code or headers is None:
+                    continue
+                if "retry-after" not in headers:
+                    diags.append(Diagnostic(
+                        sc.rel, line, "GM1004",
+                        f"{sc.name} promises {rule} but this {code} "
+                        f"response carries no Retry-After header",
+                    ))
+        if "etag-cache-control" in rules:
+            for line, sent, headers in sc.send_sites:
+                if headers and "etag" in headers \
+                        and "cache-control" not in headers:
+                    diags.append(Diagnostic(
+                        sc.rel, line, "GM1004",
+                        f"{sc.name}: response sets ETag without "
+                        f"Cache-Control — edge caches will guess the "
+                        f"TTL",
+                    ))
+            for line, keys in sc.dicts:
+                if "etag" in keys and "cache-control" not in keys:
+                    diags.append(Diagnostic(
+                        sc.rel, line, "GM1004",
+                        f"{sc.name}: header dict sets ETag without "
+                        f"Cache-Control — edge caches will guess the "
+                        f"TTL",
+                    ))
+        if "echo-traceparent" in rules \
+                and "traceparent" not in sc.header_names:
+            diags.append(Diagnostic(
+                sc.rel, sc.line, "GM1004",
+                f"{sc.name} promises echo-traceparent but never sends "
+                f"a traceparent header",
+            ))
+    return diags
+
+
+def _check_key_parity(ex: _Extraction) -> List[Diagnostic]:
+    produced = set(ex.produced)
+    for sc in ex.servers:
+        produced |= sc.produced
+    if not produced:
+        return []
+    diags: List[Diagnostic] = []
+    seen: Set[Tuple[str, str, int]] = set()
+    for key, rel, line in ex.consumed:
+        if key in produced or (key, rel, line) in seen:
+            continue
+        seen.add((key, rel, line))
+        diags.append(Diagnostic(
+            rel, line, "GM1005",
+            f"wire payload key {key!r} is consumed here but no "
+            f"producer dict ever writes it",
+        ))
+    return diags
+
+
+def _check_docs(ex: _Extraction, project: Project) -> List[Diagnostic]:
+    serving_rel = "docs/SERVING.md"
+    try:
+        serving_text = (project.root / serving_rel).read_text(
+            encoding="utf-8", errors="replace"
+        )
+    except OSError:
+        serving_text = ""
+    rows = _doc_rows(serving_text, serving_rel)
+    rows += _doc_rows(project.observability_md, "docs/OBSERVABILITY.md")
+    diags: List[Diagnostic] = []
+    if rows:
+        documented: Set[Tuple[str, str, str, bool]] = set()
+        for sc in ex.servers:
+            for (method, path, prefix), line in sorted(
+                sc.routes.items(), key=lambda kv: kv[1]
+            ):
+                dedup = (sc.rel, method, path, prefix)
+                if dedup in documented:
+                    continue
+                documented.add(dedup)
+                ok = any(
+                    method == m
+                    and _paths_overlap(path, prefix, p, pre)
+                    for (m, p, pre, _r, _l) in rows
+                )
+                if not ok:
+                    diags.append(Diagnostic(
+                        sc.rel, line, "GM1006",
+                        f"{sc.name} serves {method} "
+                        f"{path}{'...' if prefix else ''} but the "
+                        f"endpoint tables in docs/SERVING.md / "
+                        f"docs/OBSERVABILITY.md do not document it",
+                    ))
+    if rows and ex.servers:
+        table = [
+            (method, path, prefix)
+            for sc in ex.servers
+            for (method, path, prefix) in sc.routes
+        ]
+        for m, p, pre, rel, line in rows:
+            ok = any(
+                m == method and _paths_overlap(p, pre, path, prefix)
+                for (method, path, prefix) in table
+            )
+            if not ok:
+                diags.append(Diagnostic(
+                    rel, line, "GM1006",
+                    f"documented endpoint {m} "
+                    f"{p}{'...' if pre else ''} matches no extracted "
+                    f"server route",
+                ))
+    return diags
+
+
+def check(project: Project) -> List[Diagnostic]:
+    ex = _Extraction(project)
+    diags: List[Diagnostic] = []
+    diags.extend(_check_routes(ex))
+    diags.extend(_check_status_parity(ex))
+    diags.extend(_check_timeouts(project))
+    diags.extend(_check_headers(ex))
+    diags.extend(_check_key_parity(ex))
+    diags.extend(_check_docs(ex, project))
+    return diags

@@ -1,0 +1,274 @@
+"""Runtime wire-conformance witness: the dynamic half of GM10xx.
+
+The static wire checkers (analysis/wire.py) extract each handler
+class's contract — the status codes its dispatch can emit and the
+response-header rules it declares via ``# wire:`` — from source. This
+module validates that model against *live responses*. With
+``GAMESMAN_WIRECHECK=1`` in the environment (or an explicit
+:func:`install`), the ``BaseHTTPRequestHandler`` send path is wrapped:
+every response a watched handler class finishes (``end_headers``) is
+checked against the statically extracted contract, and a status code
+outside the extracted set, a 503/429 shed without ``Retry-After``, an
+``ETag`` without ``Cache-Control``, or a swallowed inbound
+``traceparent`` is recorded as a violation. :func:`assert_conformant`
+turns the session's violations into a test failure.
+
+Wiring: ``tests/conftest.py`` installs the witness when
+``GAMESMAN_WIRECHECK=1`` and asserts conformance at session teardown
+(exit 3 on violations, like lockdep); ``tests/test_lint.py`` holds the
+unit tests — a live server driven under a scoped :class:`witness`, and
+a violation test against a deliberately non-conformant fixture
+handler.
+
+Contracts are loaded by re-parsing the four fleet server modules with
+:func:`analysis.wire.extract_server_classes` — a pure AST pass, no
+project load, so install costs milliseconds at conftest import. Codes
+the stdlib ``http.server`` machinery emits on its own (malformed
+request line, oversized headers: ``wire.IMPLICIT_CODES``) are always
+allowed.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler
+from typing import Dict, List, Optional, Set
+
+from gamesmanmpi_tpu.analysis import wire
+
+#: The fleet server modules whose handler classes are watched, relative
+#: to the package root's parent (the repo layout the witness runs in).
+WATCHED_MODULES = (
+    "gamesmanmpi_tpu/serve/server.py",
+    "gamesmanmpi_tpu/serve/supervisor.py",
+    "gamesmanmpi_tpu/registry/server.py",
+    "gamesmanmpi_tpu/obs/status.py",
+)
+
+
+class WireConformanceError(AssertionError):
+    """A live response fell outside the statically extracted contract."""
+
+
+class Contract:
+    """What one handler class is allowed to do on the wire."""
+
+    def __init__(self, codes: Optional[Set[int]], rules: Set[str]):
+        #: allowed status codes; None = the static extractor saw a
+        #: computed code (open set) and code checking is skipped.
+        self.codes = codes
+        self.rules = set(rules)
+
+
+def load_repo_contracts() -> Dict[str, Contract]:
+    """Class-name -> :class:`Contract` for every watched fleet module,
+    by pure AST extraction (shared with gamesman-lint)."""
+    root = pathlib.Path(wire.__file__).resolve().parents[2]
+    out: Dict[str, Contract] = {}
+    for rel in WATCHED_MODULES:
+        path = root / rel
+        try:
+            text = path.read_text(encoding="utf-8", errors="replace")
+            tree = ast.parse(text)
+        except (OSError, SyntaxError):
+            continue
+        for sc in wire.extract_server_classes(
+            tree, text.splitlines(), rel
+        ):
+            out[sc.name] = Contract(
+                None if sc.open_codes else set(sc.codes),
+                sc.rules & wire.HANDLER_RULES,
+            )
+    return out
+
+
+class _Installed:
+    active: bool = False
+    contracts: Optional[Dict[str, Contract]] = None
+
+
+_ORIG_SEND_RESPONSE = BaseHTTPRequestHandler.send_response
+_ORIG_SEND_HEADER = BaseHTTPRequestHandler.send_header
+_ORIG_END_HEADERS = BaseHTTPRequestHandler.end_headers
+
+_LOCK = threading.Lock()
+_VIOLATIONS: List[str] = []
+#: handler class names that answered at least one checked response —
+#: the coverage observable (a clean run over zero responses proves
+#: nothing).
+_CHECKED: Set[str] = set()
+
+
+def _record(msg: str) -> None:
+    with _LOCK:
+        _VIOLATIONS.append(msg)
+
+
+def _send_response(self, code, message=None):
+    self._wirecheck_code = int(code)
+    self._wirecheck_headers = set()
+    return _ORIG_SEND_RESPONSE(self, code, message)
+
+
+def _send_header(self, keyword, value):
+    pending = getattr(self, "_wirecheck_headers", None)
+    if pending is not None:
+        pending.add(str(keyword).lower())
+    return _ORIG_SEND_HEADER(self, keyword, value)
+
+
+def _end_headers(self):
+    try:
+        _validate(self)
+    finally:
+        self._wirecheck_code = None
+        self._wirecheck_headers = None
+    return _ORIG_END_HEADERS(self)
+
+
+def _validate(handler) -> None:
+    contracts = _Installed.contracts or {}
+    cname = type(handler).__name__
+    contract = contracts.get(cname)
+    code = getattr(handler, "_wirecheck_code", None)
+    headers = getattr(handler, "_wirecheck_headers", None)
+    if contract is None or code is None or headers is None:
+        return
+    with _LOCK:
+        _CHECKED.add(cname)
+    where = f"{cname} {getattr(handler, 'path', '?')}"
+    if contract.codes is not None and code not in contract.codes \
+            and code not in wire.IMPLICIT_CODES:
+        _record(
+            f"{where}: live status {code} is outside the statically "
+            f"extracted set {sorted(contract.codes)}"
+        )
+    for rule, shed in (("503-retry-after", 503),
+                       ("429-retry-after", 429)):
+        if rule in contract.rules and code == shed \
+                and "retry-after" not in headers:
+            _record(
+                f"{where}: {shed} shed without Retry-After "
+                f"(class promises {rule})"
+            )
+    if "etag-cache-control" in contract.rules and "etag" in headers \
+            and "cache-control" not in headers:
+        _record(f"{where}: ETag without Cache-Control")
+    if "echo-traceparent" in contract.rules:
+        try:
+            inbound = handler.headers.get("traceparent")
+        except AttributeError:
+            inbound = None
+        if inbound and "traceparent" not in headers:
+            _record(
+                f"{where}: inbound traceparent was not echoed "
+                f"(class promises echo-traceparent)"
+            )
+
+
+def install(contracts: Optional[Dict[str, Contract]] = None) -> None:
+    """Wrap the handler send path (idempotent). ``contracts`` overrides
+    the repo-extracted map — the violation tests' hook."""
+    if contracts is not None:
+        _Installed.contracts = dict(contracts)
+    elif _Installed.contracts is None:
+        _Installed.contracts = load_repo_contracts()
+    if _Installed.active:
+        return
+    _Installed.active = True
+    BaseHTTPRequestHandler.send_response = _send_response
+    BaseHTTPRequestHandler.send_header = _send_header
+    BaseHTTPRequestHandler.end_headers = _end_headers
+
+
+def uninstall() -> None:
+    if not _Installed.active:
+        return
+    BaseHTTPRequestHandler.send_response = _ORIG_SEND_RESPONSE
+    BaseHTTPRequestHandler.send_header = _ORIG_SEND_HEADER
+    BaseHTTPRequestHandler.end_headers = _ORIG_END_HEADERS
+    _Installed.active = False
+    _Installed.contracts = None
+
+
+def reset() -> None:
+    with _LOCK:
+        _VIOLATIONS.clear()
+        _CHECKED.clear()
+
+
+def violations() -> List[str]:
+    with _LOCK:
+        return list(_VIOLATIONS)
+
+
+def checked_classes() -> List[str]:
+    """Handler classes that answered at least one checked response."""
+    with _LOCK:
+        return sorted(_CHECKED)
+
+
+def assert_conformant() -> None:
+    vio = violations()
+    if vio:
+        raise WireConformanceError(
+            "live response(s) outside the static wire contract:\n  "
+            + "\n  ".join(vio)
+        )
+
+
+def enabled_by_env() -> bool:
+    # Raw default-free read, like lockdep: this runs at conftest
+    # import; the knob is documented in CONFIG.md.
+    from gamesmanmpi_tpu.utils.env import env_str
+
+    return env_str("GAMESMAN_WIRECHECK", "0") == "1"
+
+
+class witness:
+    """Context manager for tests: install + clean slate on entry,
+    conformance assertion (optional) on exit.
+
+    Nestable over a session-wide install (GAMESMAN_WIRECHECK=1 via
+    conftest): prior installation state, contract map, and recorded
+    violations are snapshotted on entry and restored on exit, so a
+    scoped witness never blinds the session witness.
+
+    >>> with wirecheck.witness():
+    ...     drive_live_server()
+    """
+
+    def __init__(self, contracts: Optional[Dict[str, Contract]] = None,
+                 check: bool = True):
+        self.contracts = contracts
+        self.check = check
+
+    def __enter__(self):
+        self._was_active = _Installed.active
+        self._prev_contracts = _Installed.contracts
+        with _LOCK:
+            self._prev_violations = list(_VIOLATIONS)
+            self._prev_checked = set(_CHECKED)
+        if self.contracts is not None:
+            _Installed.contracts = dict(self.contracts)
+        install()
+        reset()
+        return sys.modules[__name__]
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        try:
+            if exc_type is None and self.check:
+                assert_conformant()
+        finally:
+            if not self._was_active:
+                uninstall()
+            else:
+                _Installed.contracts = self._prev_contracts
+            with _LOCK:
+                _VIOLATIONS.clear()
+                _VIOLATIONS.extend(self._prev_violations)
+                _CHECKED.clear()
+                _CHECKED.update(self._prev_checked)

@@ -98,9 +98,12 @@ class JobQueue:
             fh.flush()
             os.fsync(fh.fileno())
 
+    # wire: producer
     def _replay(self) -> dict:
         """Ledger -> {job_id: job dict}. A torn tail line (death
-        mid-append) is skipped, exactly like the campaign ledger."""
+        mid-append) is skipped, exactly like the campaign ledger.
+        Job records cross the wire verbatim (``POST /solve`` responses,
+        ``/jobs`` snapshots), hence the producer annotation."""
         jobs: dict = {}
         if not self.path.exists():
             return jobs

@@ -190,6 +190,7 @@ def publish_db(root, name: str, src_dir, registry=None) -> dict:
     return record
 
 
+# wire: 429-retry-after
 class _RegistryHandler(BaseHTTPRequestHandler):
     server_version = "gamesman-registry/1"
     protocol_version = "HTTP/1.1"
@@ -200,12 +201,14 @@ class _RegistryHandler(BaseHTTPRequestHandler):
 
     # self.server is the _RegistryHTTPServer below.
 
-    def _send_json(self, code: int, payload: dict) -> None:
+    def _send_json(self, code: int, payload: dict, headers=None) -> None:
         body = json.dumps(payload).encode()
         try:
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
         except (BrokenPipeError, ConnectionResetError):
@@ -346,7 +349,11 @@ class _RegistryHandler(BaseHTTPRequestHandler):
                 self._send_json(400, {"error": str(e)})
                 return
             except QueueRefused as e:
-                self._send_json(429, {"error": str(e)})
+                # Refusal is load shedding, not failure: tell pull
+                # clients when to come back instead of letting them
+                # hammer a full queue.
+                self._send_json(429, {"error": str(e)},
+                                headers={"Retry-After": "5"})
                 return
             self._send_json(202, {"ok": True, **job})
         else:

@@ -182,6 +182,7 @@ class CoordinatorServer:
                 name="gamesman-coord-conn", daemon=True,
             ).start()
 
+    # wire: producer, consumer
     def _serve_one(self, conn: socket.socket) -> None:
         try:
             conn.settimeout(self.deadline + 5.0)
@@ -217,6 +218,7 @@ class CoordinatorServer:
             except OSError:
                 pass
 
+    # wire: producer
     def _propose(self, conn, epoch: str, rank: int, verdict: str) -> None:
         if verdict not in (OK, RETRY, ABORT):
             _send_json(conn, {"error": f"bad verdict {verdict!r}"})
@@ -266,6 +268,7 @@ class CoordinatorServer:
         return waiters
 
     @staticmethod
+    # wire: producer
     def _reply_and_close(conn, decision: str, reason: str) -> None:
         try:
             _send_json(conn, {"decision": decision, "reason": reason})
@@ -343,6 +346,7 @@ class EpochBarrier:
                     ) from e
                 time.sleep(0.05)
 
+    # wire: producer, consumer
     def propose(self, tag: str, verdict: str) -> str:
         """Propose ``verdict`` for this rank's next epoch round; return
         the fleet's decision (``ok``/``retry``/``abort``). Raises
@@ -394,6 +398,7 @@ class EpochBarrier:
                 f"(decision={decision})"
             )
 
+    # wire: fetch
     def _one_shot(self, req: dict) -> dict:
         """One request/reply exchange outside the round protocol (the
         address-book ops — no sequence number, no consensus)."""

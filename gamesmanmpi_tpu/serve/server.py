@@ -100,6 +100,7 @@ class _Route:
         self.batcher = None  # attached by QueryServer AFTER the bind
 
 
+# wire: etag-cache-control, 503-retry-after, echo-traceparent
 class _Handler(BaseHTTPRequestHandler):
     server_version = "gamesman-serve/1"
     protocol_version = "HTTP/1.1"
@@ -636,6 +637,7 @@ class _QueryHTTPServer(ThreadingHTTPServer):
             return "degraded"
         return "ok"
 
+    # wire: producer
     def healthz(self) -> dict:
         """The /healthz payload. Three states, one field: "ok" (serving
         normally), "degraded" (some reader's circuit breaker open —

@@ -449,6 +449,7 @@ class ServeSupervisor:
         with self._lock:
             self._registry_sync = keep
 
+    # wire: producer
     def status(self) -> dict:
         """Fleet-level health snapshot (the control /healthz payload)."""
         now = time.monotonic()
@@ -680,6 +681,7 @@ class ServeSupervisor:
         if eof:
             self._on_pipe_eof(slot, now)
 
+    # wire: consumer
     def _on_msg(self, slot, msg: dict, now: float) -> None:
         kind = msg.get("type")
         if kind == "hello":

@@ -154,8 +154,11 @@ def _start_orphan_watch(wid: int) -> None:
     ).start()
 
 
+# wire: producer
 def run_worker(cfg: dict, listen_sock, pipe_fd: int) -> int:
-    """The worker body; returns the process exit code, never raises."""
+    """The worker body; returns the process exit code, never raises.
+    Every ``pipe.send(...)`` keyword and beat-dict key here crosses the
+    supervisor pipe as JSON, hence the producer annotation."""
     from gamesmanmpi_tpu.obs import MetricsRegistry
     from gamesmanmpi_tpu.resilience import faults
 

@@ -33,6 +33,16 @@ from gamesmanmpi_tpu.analysis import lockdep  # noqa: E402
 if lockdep.enabled_by_env():
     lockdep.install()
 
+# Runtime wire-conformance witness (docs/ANALYSIS.md "wirecheck"):
+# under GAMESMAN_WIRECHECK=1 every live response from a watched fleet
+# handler is checked against the statically extracted GM10xx contract
+# (status codes, Retry-After/Cache-Control/traceparent rules), and a
+# violation fails the run at session teardown.
+from gamesmanmpi_tpu.analysis import wirecheck  # noqa: E402
+
+if wirecheck.enabled_by_env():
+    wirecheck.install()
+
 
 def pytest_sessionfinish(session, exitstatus):
     if lockdep.enabled_by_env():
@@ -42,4 +52,12 @@ def pytest_sessionfinish(session, exitstatus):
             import sys
 
             print(f"\nGAMESMAN_LOCKDEP: {e}", file=sys.stderr)
+            session.exitstatus = 3
+    if wirecheck.enabled_by_env():
+        try:
+            wirecheck.assert_conformant()
+        except wirecheck.WireConformanceError as e:
+            import sys
+
+            print(f"\nGAMESMAN_WIRECHECK: {e}", file=sys.stderr)
             session.exitstatus = 3

@@ -16,7 +16,11 @@ import os
 import shutil
 import subprocess
 import textwrap
+import threading
 import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
 
@@ -1978,3 +1982,625 @@ def test_gm1xx_non_callback_callee_still_traced(tmp_path):
     """})
     _, got = findings(tmp_path)
     assert got == [("GM105", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
+
+
+# ------------------------------------------- ISSUE 20: GM10xx wire contracts
+
+# A miniature fleet handler in the repo's serve/server.py idiom: a
+# `_send_json` helper (forwarding a computed code does NOT open the
+# class's code set) and string-compare route dispatch in do_GET.
+_WIRE_SRV = """
+    import json
+    from http.server import BaseHTTPRequestHandler
+
+    class _FixtureHandler(BaseHTTPRequestHandler):
+        def _send_json(self, code, payload, headers=None):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send_json(200, {"status": "ok"})
+            else:
+                self._send_json(404, {"error": "no route"})
+"""
+
+
+def test_gm1001_client_route_no_server_defines(tmp_path):
+    build_project(tmp_path, {
+        "srv.py": _WIRE_SRV,
+        "cli.py": """
+            from urllib.request import urlopen
+
+            BASE = "http://127.0.0.1:9"
+
+            def probe():
+                with urlopen(BASE + "/nope", timeout=2) as r:  # MARK
+                    return r.status
+        """,
+    })
+    _, got = findings(tmp_path)
+    assert got == [("GM1001", "pkg/cli.py", mark_line(tmp_path, "pkg/cli.py"))]
+
+
+def test_gm1001_clean_when_route_exists(tmp_path):
+    build_project(tmp_path, {
+        "srv.py": _WIRE_SRV,
+        "cli.py": """
+            from urllib.request import urlopen
+
+            BASE = "http://127.0.0.1:9"
+
+            def probe():
+                with urlopen(BASE + "/healthz", timeout=2) as r:
+                    return r.status
+        """,
+    })
+    _, got = findings(tmp_path)
+    assert got == [], got
+
+
+def test_gm1001_unknown_coordination_op(tmp_path):
+    """The op vocabulary direction: a dict-literal `op` sent from a
+    module that opens sockets must be one some coordination server
+    compares against (the job ledger's {"op": ...} records, in modules
+    with no sockets, are exempt by design)."""
+    build_project(tmp_path, {
+        "coord_srv.py": """
+            def serve_one(req):
+                if req.get("op") == "ping":
+                    return {"ok": True}
+                return {"ok": False}
+        """,
+        "coord_cli.py": """
+            import json
+            import socket
+
+            def call(addr):
+                conn = socket.create_connection(addr, timeout=2)
+                try:
+                    conn.sendall(json.dumps({"op": "pingg"}).encode())  # MARK
+                finally:
+                    conn.close()
+        """,
+    })
+    _, got = findings(tmp_path)
+    assert got == [
+        ("GM1001", "pkg/coord_cli.py", mark_line(tmp_path, "pkg/coord_cli.py"))
+    ]
+    # The fixed spelling is clean.
+    build_project(tmp_path, {"coord_cli.py": """
+        import json
+        import socket
+
+        def call(addr):
+            conn = socket.create_connection(addr, timeout=2)
+            try:
+                conn.sendall(json.dumps({"op": "ping"}).encode())
+            finally:
+                conn.close()
+    """})
+    _, got = findings(tmp_path)
+    assert got == [], got
+
+
+def test_gm1002_client_branch_on_unemitted_code(tmp_path):
+    build_project(tmp_path, {
+        "srv.py": _WIRE_SRV,
+        "cli.py": """
+            import urllib.error
+            from urllib.request import urlopen
+
+            def probe(base):
+                try:
+                    with urlopen(base + "/healthz", timeout=2) as r:
+                        return r.status
+                except urllib.error.HTTPError as e:
+                    if e.code == 418:  # MARK
+                        return -1
+                    raise
+        """,
+    })
+    _, got = findings(tmp_path)
+    assert got == [("GM1002", "pkg/cli.py", mark_line(tmp_path, "pkg/cli.py"))]
+
+
+def test_gm1002_server_shed_code_unhandled(tmp_path):
+    """The other direction: a server that sheds with 503 while no
+    client anywhere branches on it — the backpressure path would
+    surface as a generic unhandled error."""
+    build_project(tmp_path, {
+        "srv.py": """
+            import json
+            from http.server import BaseHTTPRequestHandler
+
+            class _FixtureHandler(BaseHTTPRequestHandler):
+                def _send_json(self, code, payload, headers=None):
+                    body = json.dumps(payload).encode()
+                    self.send_response(code)
+                    for k, v in (headers or {}).items():
+                        self.send_header(k, v)
+                    self.end_headers()
+                    self.wfile.write(body)
+
+                def do_GET(self):
+                    if self.path == "/healthz":
+                        self._send_json(200, {"status": "ok"})
+                    elif self.path == "/busy":
+                        self._send_json(503, {"error": "busy"})  # MARK
+                    else:
+                        self._send_json(404, {"error": "no route"})
+        """,
+        "cli.py": """
+            import urllib.error
+            from urllib.request import urlopen
+
+            def probe(base):
+                try:
+                    with urlopen(base + "/healthz", timeout=2) as r:
+                        return r.status
+                except urllib.error.HTTPError as e:
+                    if e.code == 404:
+                        return None
+                    raise
+        """,
+    })
+    _, got = findings(tmp_path)
+    assert got == [("GM1002", "pkg/srv.py", mark_line(tmp_path, "pkg/srv.py"))]
+    # A client handling the shed code (the `in (404, 503)` membership
+    # shape) closes the gap.
+    build_project(tmp_path, {"cli.py": """
+        import urllib.error
+        from urllib.request import urlopen
+
+        def probe(base):
+            try:
+                with urlopen(base + "/healthz", timeout=2) as r:
+                    return r.status
+            except urllib.error.HTTPError as e:
+                if e.code in (404, 503):
+                    return None
+                raise
+    """})
+    _, got = findings(tmp_path)
+    assert got == [], got
+
+
+def test_gm1003_outbound_call_without_timeout(tmp_path):
+    """Both the missing-argument and the explicit timeout=None shapes
+    hang forever on a dead peer."""
+    build_project(tmp_path, {"mod.py": """
+        from urllib.request import urlopen
+
+        def probe():
+            return urlopen("http://db-registry:8940/catalog")  # MARK
+
+        def probe_none(url):
+            return urlopen(url, timeout=None)  # MARK2
+    """})
+    _, got = findings(tmp_path)
+    assert got == [
+        ("GM1003", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py")),
+        ("GM1003", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py", "MARK2")),
+    ]
+
+
+def test_gm1003_clean_with_finite_timeout(tmp_path):
+    build_project(tmp_path, {"mod.py": """
+        import socket
+        from http.client import HTTPConnection
+        from urllib.request import urlopen
+
+        def probe(url, addr):
+            with urlopen(url, timeout=5) as r:
+                r.read()
+            with socket.create_connection(addr, 2) as conn:
+                conn.sendall(b"ping")
+            return HTTPConnection("peer", 80, 3)
+    """})
+    _, got = findings(tmp_path)
+    assert got == [], got
+
+
+def test_gm1004_shed_without_retry_after(tmp_path):
+    build_project(tmp_path, {"srv.py": """
+        import json
+        from http.server import BaseHTTPRequestHandler
+
+        # wire: 503-retry-after
+        class _Shedding(BaseHTTPRequestHandler):
+            def _send_json(self, code, payload, headers=None):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/work":
+                    self._send_json(503, {"error": "busy"})  # MARK
+                else:
+                    self._send_json(200, {"status": "ok"})
+    """})
+    _, got = findings(tmp_path)
+    assert got == [("GM1004", "pkg/srv.py", mark_line(tmp_path, "pkg/srv.py"))]
+    # Attaching the promised header satisfies the declared contract.
+    build_project(tmp_path, {"srv.py": """
+        import json
+        from http.server import BaseHTTPRequestHandler
+
+        # wire: 503-retry-after
+        class _Shedding(BaseHTTPRequestHandler):
+            def _send_json(self, code, payload, headers=None):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/work":
+                    self._send_json(503, {"error": "busy"},
+                                    headers={"Retry-After": "2"})
+                else:
+                    self._send_json(200, {"status": "ok"})
+    """})
+    _, got = findings(tmp_path)
+    assert got == [], got
+
+
+def test_gm1004_etag_dict_without_cache_control(tmp_path):
+    build_project(tmp_path, {"srv.py": """
+        import json
+        from http.server import BaseHTTPRequestHandler
+
+        # wire: etag-cache-control
+        class _Caching(BaseHTTPRequestHandler):
+            def _headers(self, tag):
+                return {"ETag": tag, "Vary": "Accept"}  # MARK
+
+            def do_GET(self):
+                self.send_response(200)
+                self.end_headers()
+    """})
+    _, got = findings(tmp_path)
+    assert got == [("GM1004", "pkg/srv.py", mark_line(tmp_path, "pkg/srv.py"))]
+    build_project(tmp_path, {"srv.py": """
+        import json
+        from http.server import BaseHTTPRequestHandler
+
+        # wire: etag-cache-control
+        class _Caching(BaseHTTPRequestHandler):
+            def _headers(self, tag):
+                return {"ETag": tag, "Cache-Control": "max-age=30"}
+
+            def do_GET(self):
+                self.send_response(200)
+                self.end_headers()
+    """})
+    _, got = findings(tmp_path)
+    assert got == [], got
+
+
+def test_gm1004_echo_traceparent_never_sent(tmp_path):
+    build_project(tmp_path, {"srv.py": """
+        from http.server import BaseHTTPRequestHandler
+
+        # wire: echo-traceparent
+        class _Tracing(BaseHTTPRequestHandler):  # MARK
+            def do_GET(self):
+                self.send_response(200)
+                self.end_headers()
+    """})
+    _, got = findings(tmp_path)
+    assert got == [("GM1004", "pkg/srv.py", mark_line(tmp_path, "pkg/srv.py"))]
+    build_project(tmp_path, {"srv.py": """
+        from http.server import BaseHTTPRequestHandler
+
+        # wire: echo-traceparent
+        class _Tracing(BaseHTTPRequestHandler):
+            def do_GET(self):
+                self.send_response(200)
+                tp = self.headers.get("traceparent")
+                if tp:
+                    self.send_header("traceparent", tp)
+                self.end_headers()
+    """})
+    _, got = findings(tmp_path)
+    assert got == [], got
+
+
+def test_gm1004_unknown_wire_token(tmp_path):
+    build_project(tmp_path, {"mod.py": """
+        # wire: bogus
+        def helper():  # MARK
+            return 1
+    """})
+    _, got = findings(tmp_path)
+    assert got == [("GM1004", "pkg/mod.py", mark_line(tmp_path, "pkg/mod.py"))]
+
+
+def test_gm1005_consumed_key_never_produced(tmp_path):
+    build_project(tmp_path, {
+        "srv.py": """
+            # wire: producer
+            def reply():
+                return {"status": "ok", "epoch": 3}
+        """,
+        "cli.py": """
+            import json
+            from urllib.request import urlopen
+
+            def fetch_status(base):
+                with urlopen(base + "/status", timeout=2) as r:
+                    payload = json.loads(r.read())
+                return payload["generation"]  # MARK
+        """,
+    })
+    _, got = findings(tmp_path)
+    assert got == [("GM1005", "pkg/cli.py", mark_line(tmp_path, "pkg/cli.py"))]
+    # Keys the producer actually writes are clean, subscript or .get.
+    build_project(tmp_path, {"cli.py": """
+        import json
+        from urllib.request import urlopen
+
+        def fetch_status(base):
+            with urlopen(base + "/status", timeout=2) as r:
+                payload = json.loads(r.read())
+            return payload["epoch"], payload.get("status")
+    """})
+    _, got = findings(tmp_path)
+    assert got == [], got
+
+
+def test_gm1005_consumer_annotation_seeds_parameters(tmp_path):
+    """The supervisor's `_on_msg(slot, msg, now)` shape: json.loads
+    happens one frame up, so the `# wire: consumer` annotation makes
+    the function's parameters wire payloads."""
+    build_project(tmp_path, {
+        "srv.py": """
+            # wire: producer
+            def reply():
+                return {"beat": 1}
+        """,
+        "sup.py": """
+            # wire: consumer
+            def on_msg(slot, msg):
+                return msg["missing"]  # MARK
+        """,
+    })
+    _, got = findings(tmp_path)
+    assert got == [("GM1005", "pkg/sup.py", mark_line(tmp_path, "pkg/sup.py"))]
+
+
+_OBS_TABLE = (
+    "## Status endpoints\n\n"
+    "| Method | Path | Codes |\n"
+    "|---|---|---|\n"
+    "| GET | `/healthz` | 200 |\n"
+)
+
+
+def test_gm1006_route_missing_from_endpoint_tables(tmp_path):
+    build_project(tmp_path, {"srv.py": """
+        import json
+        from http.server import BaseHTTPRequestHandler
+
+        class _FixtureHandler(BaseHTTPRequestHandler):
+            def _send_json(self, code, payload, headers=None):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send_json(200, {"status": "ok"})
+                elif self.path == "/extra":  # MARK
+                    self._send_json(200, {"extra": 1})
+                else:
+                    self._send_json(404, {"error": "no route"})
+    """}, observability_md=_OBS_TABLE)
+    _, got = findings(tmp_path)
+    assert got == [("GM1006", "pkg/srv.py", mark_line(tmp_path, "pkg/srv.py"))]
+
+
+def test_gm1006_documented_endpoint_no_server_defines(tmp_path):
+    md = _OBS_TABLE + "| GET | `/gone` | 200 |\n"
+    build_project(tmp_path, {"srv.py": _WIRE_SRV}, observability_md=md)
+    _, got = findings(tmp_path)
+    line = md.splitlines().index("| GET | `/gone` | 200 |") + 1
+    assert got == [("GM1006", "docs/OBSERVABILITY.md", line)]
+
+
+def test_wire_clean_fleet_fixture(tmp_path):
+    """A consistent miniature fleet — routes (exact and `<name>`-prefix)
+    documented, codes handled both ways, keys produced before consumed —
+    lints clean across the whole GM10xx family."""
+    md = (
+        "| Method | Path | Codes |\n"
+        "|---|---|---|\n"
+        "| GET | `/healthz` | 200 404 |\n"
+        "| GET | `/db/<name>` | 200 404 |\n"
+    )
+    build_project(tmp_path, {
+        "srv.py": """
+            import json
+            from http.server import BaseHTTPRequestHandler
+
+            class _FixtureHandler(BaseHTTPRequestHandler):
+                def _send_json(self, code, payload, headers=None):
+                    body = json.dumps(payload).encode()
+                    self.send_response(code)
+                    for k, v in (headers or {}).items():
+                        self.send_header(k, v)
+                    self.end_headers()
+                    self.wfile.write(body)
+
+                def do_GET(self):
+                    if self.path == "/healthz":
+                        self._send_json(200, {"status": "ok", "epoch": 2})
+                    elif self.path.startswith("/db/"):
+                        self._send_json(200, {"blob": "x"})
+                    else:
+                        self._send_json(404, {"error": "no route"})
+        """,
+        "cli.py": """
+            import json
+            import urllib.error
+            from urllib.request import urlopen
+
+            def fetch_status(base):
+                try:
+                    with urlopen(base + "/healthz", timeout=2) as r:
+                        payload = json.loads(r.read())
+                    return payload["epoch"]
+                except urllib.error.HTTPError as e:
+                    if e.code == 404:
+                        return None
+                    raise
+        """,
+    }, observability_md=md)
+    _, got = findings(tmp_path)
+    assert got == [], got
+
+
+# ----------------------------------- wirecheck: the runtime wire witness
+
+
+def test_wirecheck_contracts_cover_fleet_handlers():
+    """The witness's statically loaded contracts reach every fleet
+    handler class, with the repo's declared header rules intact."""
+    from gamesmanmpi_tpu.analysis import wirecheck
+
+    contracts = wirecheck.load_repo_contracts()
+    assert {"_Handler", "_ControlHandler", "_RegistryHandler",
+            "_StatusHandler"} <= set(contracts)
+    h = contracts["_Handler"]
+    assert h.codes is not None and 503 in h.codes and 304 in h.codes
+    assert {"503-retry-after", "etag-cache-control",
+            "echo-traceparent"} <= h.rules
+    assert "429-retry-after" in contracts["_RegistryHandler"].rules
+
+
+def test_wirecheck_witness_records_violations():
+    """A live handler shedding 503 without Retry-After and emitting an
+    uncontracted code is caught by the runtime witness; the scoped
+    `witness` raises at exit when asked to check."""
+    from gamesmanmpi_tpu.analysis import wirecheck
+
+    class Naughty(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_GET(self):
+            body = b"{}"
+            self.send_response(503)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    was_installed = wirecheck._Installed.active
+    contracts = {"Naughty": wirecheck.Contract({200}, {"503-retry-after"})}
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Naughty)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{srv.server_port}"
+
+    def drive():
+        try:
+            urllib.request.urlopen(base + "/x", timeout=10)
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+
+    try:
+        with wirecheck.witness(contracts=contracts, check=False) as wc:
+            drive()
+            vio = wc.violations()
+            assert any("outside the statically extracted set" in v
+                       for v in vio), vio
+            assert any("Retry-After" in v for v in vio), vio
+            assert wc.checked_classes() == ["Naughty"]
+            with pytest.raises(wirecheck.WireConformanceError):
+                wc.assert_conformant()
+        # check=True (the default) turns the violation into a failure
+        # at scope exit — the shape conftest uses at session teardown.
+        with pytest.raises(wirecheck.WireConformanceError):
+            with wirecheck.witness(contracts=contracts):
+                drive()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+    # The scoped witness restored the prior installation state (it may
+    # be nested inside a session-wide GAMESMAN_WIRECHECK=1 install).
+    assert wirecheck._Installed.active == was_installed
+    assert (BaseHTTPRequestHandler.end_headers
+            is wirecheck._end_headers) == was_installed
+
+
+def test_wirecheck_real_registry_server_conforms(tmp_path):
+    """The repo-extracted contracts hold against a live fleet server:
+    a real RegistryServer answers 200 and 404 under the witness with
+    zero violations — and the class is proven CHECKED, so the clean
+    result is coverage, not silence."""
+    from gamesmanmpi_tpu.analysis import wirecheck
+    from gamesmanmpi_tpu.registry.server import RegistryServer
+
+    srv = RegistryServer(tmp_path / "registry")
+    srv.start()
+    try:
+        with wirecheck.witness() as wc:
+            with urllib.request.urlopen(
+                    srv.url + "/healthz", timeout=30) as r:
+                assert json.loads(r.read())["status"] == "ok"
+            try:
+                urllib.request.urlopen(srv.url + "/nope", timeout=30)
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+            assert wc.violations() == []
+            assert "_RegistryHandler" in wc.checked_classes()
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------- SARIF output
+
+
+def test_cli_sarif_format_round_trip(tmp_path, capsys):
+    """--format=sarif mirrors the json findings (id, path, line) in
+    SARIF 2.1.0 with unchanged exit semantics."""
+    build_project(tmp_path, {"mod.py": """
+        import os
+        X = os.environ.get("PATH")
+    """}, config_md=CONFIG_HEADER)
+    rc = lint_main(["--root", str(tmp_path), "--format", "sarif"])
+    sarif = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "gamesman-lint"
+    rc2 = lint_main(["--root", str(tmp_path), "--format", "json"])
+    plain = json.loads(capsys.readouterr().out)["new"]
+    assert rc2 == 1 and plain
+    assert [
+        (r["ruleId"],
+         r["locations"][0]["physicalLocation"]["artifactLocation"]["uri"],
+         r["locations"][0]["physicalLocation"]["region"]["startLine"])
+        for r in run["results"]
+    ] == [(d["id"], d["path"], d["line"]) for d in plain]
+    assert all(r["level"] == "error" and r["message"]["text"]
+               for r in run["results"])
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} \
+        == {r["ruleId"] for r in run["results"]}
+    # Accepting the findings into the baseline empties the SARIF log
+    # without changing the exit contract.
+    assert lint_main(["--root", str(tmp_path), "--update-baseline"]) == 0
+    rc3 = lint_main(["--root", str(tmp_path), "--format", "sarif"])
+    out3 = json.loads(capsys.readouterr().out)
+    assert rc3 == 0 and out3["runs"][0]["results"] == []

@@ -302,6 +302,60 @@ def test_load_gen_soak_emits_progress(tmp_path):
     assert snaps[-1]["requests"] >= snaps[0]["requests"]
 
 
+def test_load_gen_honors_retry_after_on_503(tmp_path):
+    """A 503 carrying Retry-After is its own outcome class
+    (shed_retried) and the thread actually sleeps the advertised delay
+    before its next request; a malformed header degrades to a plain
+    shed with no sleep."""
+    import http.server
+
+    load_gen = load_module(REPO / "tools" / "load_gen.py")
+
+    class _Shedding(http.server.BaseHTTPRequestHandler):
+        retry_after = "10"  # capped to 5 s — longer than the run
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def _shed(self):
+            length = int(self.headers.get("Content-Length") or 0)
+            if length:
+                self.rfile.read(length)
+            body = b'{"error": "draining"}'
+            self.send_response(503)
+            if self.retry_after is not None:
+                self.send_header("Retry-After", self.retry_after)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        do_GET = _shed
+        do_POST = _shed
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Shedding)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{srv.server_port}"
+    try:
+        rec = load_gen.run_load(
+            base, [1, 2, 3], duration=0.5, concurrency=2, timeout=10,
+        )
+        assert rec["shed_retried"] >= 1 and rec["shed"] == 0
+        assert rec["errors"] == 0 and rec["mismatches"] == 0
+        # The honored (capped 5 s > duration) sleep parks each thread
+        # after its first shed instead of hammering the draining server.
+        assert rec["shed_retried"] <= 2 * 2
+        assert rec["requests"] == rec["shed_retried"] + rec["dropped"]
+        # Malformed header: classification falls back to plain shed.
+        _Shedding.retry_after = "later"
+        rec2 = load_gen.run_load(
+            base, [1, 2, 3], duration=0.3, concurrency=2, timeout=10,
+        )
+        assert rec2["shed"] >= 1 and rec2["shed_retried"] == 0
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
 # ------------------------------------------------------- solve-on-demand
 
 
@@ -370,11 +424,14 @@ def test_registry_solve_endpoint_queues_and_bounds(tmp_path, monkeypatch):
         assert again["id"] == out["id"]
         status, jobs = _get(f"{srv.url}/jobs")
         assert status == 200 and jobs["depth"] == 1
-        # Queue full: 429, the thundering herd degrades politely.
+        # Queue full: 429, the thundering herd degrades politely — and
+        # carries Retry-After, the header the class's wire contract
+        # (`# wire: 429-retry-after`, GM1004) promises on every shed.
         with pytest.raises(urllib.error.HTTPError) as e:
             _post(f"{srv.url}/solve",
                   {"name": "sub7", "spec": "subtract:total=7,moves=1-2"})
         assert e.value.code == 429
+        assert float(e.value.headers.get("Retry-After")) > 0
     finally:
         srv.stop()
 

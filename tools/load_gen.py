@@ -21,6 +21,12 @@ Classification per request:
   workload is visible in the record rather than inflating ok;
 * ``shed``     — HTTP 503 (deadline / load shed / breaker / draining):
   the server DEGRADED POLITELY; a well-behaved client retries;
+* ``shed_retried`` — an HTTP 503 whose ``Retry-After`` header this
+  client HONORED: the thread sleeps the advertised delay (bounded,
+  delta-seconds form only) before its next request — the harness is
+  the well-behaved client the serving docs promise, and honoring
+  backpressure is its own class so a shed storm is visible as such
+  rather than hammering a draining worker;
 * ``errors``   — any other HTTP status, or a 200 carrying per-position
   errors/misses (would be wrong answers — the harness treats them as
   failures, not noise);
@@ -119,6 +125,7 @@ class _Stats:
         self.ok = 0  # guarded-by: lock
         self.not_modified = 0  # guarded-by: lock (conditional-GET 304s)
         self.shed = 0  # guarded-by: lock
+        self.shed_retried = 0  # guarded-by: lock (503 + honored Retry-After)
         self.errors = 0  # guarded-by: lock
         self.dropped = 0  # guarded-by: lock
         self.codes = {}  # guarded-by: lock
@@ -153,6 +160,28 @@ class _Stats:
                     if secs is not None else None,
                     "mismatch": mismatch,
                 })
+
+
+#: Upper bound on an honored Retry-After sleep: a server advertising a
+#: huge delay must not park a load thread for the whole run.
+_RETRY_AFTER_CAP_SECS = 5.0
+
+
+def _retry_after_secs(err) -> float | None:
+    """The bounded sleep a 503's Retry-After asks for, or None when the
+    header is absent/unparseable (only the delta-seconds form counts —
+    the HTTP-date form is not worth a clock comparison here)."""
+    try:
+        raw = err.headers.get("Retry-After")
+    except AttributeError:
+        return None
+    if raw is None:
+        return None
+    try:
+        secs = float(raw)
+    except (TypeError, ValueError):
+        return None
+    return max(0.0, min(secs, _RETRY_AFTER_CAP_SECS))
 
 
 def _get_loop(url: str, chunks: list, stats: _Stats, stop: threading.Event,
@@ -192,8 +221,14 @@ def _get_loop(url: str, chunks: list, stats: _Stats, stop: threading.Event,
             if e.code == 304:
                 stats.note("not_modified", 304, secs, trace_id=trace_id)
             else:
-                stats.note("shed" if e.code == 503 else "errors", e.code,
-                           secs, trace_id=trace_id)
+                delay = _retry_after_secs(e) if e.code == 503 else None
+                if delay is not None:
+                    stats.note("shed_retried", e.code, secs,
+                               trace_id=trace_id)
+                    stop.wait(delay)
+                else:
+                    stats.note("shed" if e.code == 503 else "errors",
+                               e.code, secs, trace_id=trace_id)
         except Exception:  # noqa: BLE001 - URLError/socket/timeout: dropped
             stats.note("dropped", "conn", None, trace_id=trace_id)
 
@@ -226,8 +261,13 @@ def _worker_loop(url: str, chunks: list, stats: _Stats, stop: threading.Event,
                        results if clean else None, trace_id=trace_id)
         except urllib.error.HTTPError as e:
             secs = time.perf_counter() - t0
-            stats.note("shed" if e.code == 503 else "errors", e.code, secs,
-                       trace_id=trace_id)
+            delay = _retry_after_secs(e) if e.code == 503 else None
+            if delay is not None:
+                stats.note("shed_retried", e.code, secs, trace_id=trace_id)
+                stop.wait(delay)
+            else:
+                stats.note("shed" if e.code == 503 else "errors", e.code,
+                           secs, trace_id=trace_id)
         except Exception:  # noqa: BLE001 - URLError/socket/timeout: dropped
             stats.note("dropped", "conn", None, trace_id=trace_id)
 
@@ -300,10 +340,10 @@ def run_load(url: str, positions: list, *, duration: float = 5.0,
                 snap = {
                     "t_secs": round(time.perf_counter() - t0, 1),
                     "requests": stats.ok + stats.not_modified + stats.shed
-                    + stats.errors + stats.dropped,
+                    + stats.shed_retried + stats.errors + stats.dropped,
                     "qps": round(
                         (stats.ok + stats.not_modified + stats.shed
-                         + stats.errors)
+                         + stats.shed_retried + stats.errors)
                         / max(time.perf_counter() - t0, 1e-9), 1),
                     "p99_ms": round(percentile(lat, 0.99) * 1e3, 3),
                     "errors": stats.errors,
@@ -324,16 +364,17 @@ def run_load(url: str, positions: list, *, duration: float = 5.0,
             "duration_secs": round(elapsed, 3),
             "concurrency": int(concurrency),
             "requests": stats.ok + stats.not_modified + stats.shed
-            + stats.errors + stats.dropped,
+            + stats.shed_retried + stats.errors + stats.dropped,
             "ok": stats.ok,
             "not_modified": stats.not_modified,
             "shed": stats.shed,
+            "shed_retried": stats.shed_retried,
             "errors": stats.errors,
             "dropped": stats.dropped,
             "codes": dict(stats.codes),
             "mismatches": stats.mismatches,
             "qps": round((stats.ok + stats.not_modified + stats.shed
-                          + stats.errors)
+                          + stats.shed_retried + stats.errors)
                          / max(elapsed, 1e-9), 1),
             "p50_ms": round(percentile(lat, 0.50) * 1e3, 3),
             "p95_ms": round(percentile(lat, 0.95) * 1e3, 3),
